@@ -1,0 +1,34 @@
+"""Test harness config.
+
+TPU-free CI per SURVEY.md §4(d): JAX runs on the CPU backend with 8 virtual
+host devices so pjit/shard_map sharding logic is exercised multi-"device"
+without hardware. Env must be set before jax is first imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def shm_dir(tmp_path_factory):
+    """A private shm-backed dir per test (falls back to tmp if /dev/shm
+    is unavailable)."""
+    import tempfile
+
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    d = tempfile.mkdtemp(prefix="vep_test_", dir=base)
+    yield d
+    import shutil
+
+    shutil.rmtree(d, ignore_errors=True)

@@ -1,0 +1,138 @@
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.bus import FrameMeta, MemoryFrameBus, open_bus
+
+
+def _make_buses(kind, shm_dir):
+    if kind == "memory":
+        bus = MemoryFrameBus()
+        return bus, bus  # same object: in-proc
+    producer = open_bus("shm", shm_dir)
+    consumer = open_bus("shm", shm_dir)
+    return producer, consumer
+
+
+@pytest.fixture(params=["memory", "shm"])
+def buses(request, shm_dir):
+    return _make_buses(request.param, shm_dir)
+
+
+class TestFrameBus:
+    def test_publish_read_roundtrip(self, buses):
+        prod, cons = buses
+        prod.create_stream("cam1", 64 * 48 * 3)
+        img = np.arange(64 * 48 * 3, dtype=np.uint8).reshape(48, 64, 3)
+        meta = FrameMeta(timestamp_ms=42, pts=7, is_keyframe=True, frame_type="I",
+                         packet=3, keyframe_cnt=1)
+        seq = prod.publish("cam1", img, meta)
+        frame = cons.read_latest("cam1")
+        assert frame is not None and frame.seq == seq
+        np.testing.assert_array_equal(frame.data, img)
+        assert frame.meta.timestamp_ms == 42
+        assert frame.meta.is_keyframe and frame.meta.frame_type == "I"
+        assert frame.meta.packet == 3
+
+    def test_latest_wins_and_cursor(self, buses):
+        # Reference semantics: newest XREAD message wins, cursor advances
+        # (grpc_api.go:205-222).
+        prod, cons = buses
+        prod.create_stream("cam1", 1024)
+        img = np.zeros((4, 4, 3), dtype=np.uint8)
+        for i in range(10):
+            prod.publish("cam1", img, FrameMeta(timestamp_ms=i))
+        f = cons.read_latest("cam1")
+        assert f.meta.timestamp_ms == 9
+        assert cons.read_latest("cam1", min_seq=f.seq) is None
+        prod.publish("cam1", img, FrameMeta(timestamp_ms=99))
+        f2 = cons.read_latest("cam1", min_seq=f.seq)
+        assert f2.meta.timestamp_ms == 99
+
+    def test_missing_stream(self, buses):
+        _, cons = buses
+        assert cons.read_latest("ghost") is None
+
+    def test_streams_and_drop(self, buses):
+        prod, cons = buses
+        prod.create_stream("a", 64)
+        prod.create_stream("b", 64)
+        assert cons.streams() == ["a", "b"]
+        prod.drop_stream("a")
+        assert cons.streams() == ["b"]
+
+    def test_kv_contract(self, buses):
+        # Control-key contract parity (RedisConstants.go:18-27).
+        prod, cons = buses
+        prod.touch_query("cam1", now_ms=1234)
+        assert cons.last_query_ms("cam1") == 1234
+        prod.set_keyframe_only("cam1", True)
+        assert cons.keyframe_only("cam1")
+        prod.set_keyframe_only("cam1", False)
+        assert not cons.keyframe_only("cam1")
+        prod.set_proxy_rtmp("cam1", True)
+        assert cons.proxy_rtmp("cam1")
+        assert any(k.startswith("last_access_time_cam1") for k in cons.kv_keys())
+        prod.hdel_all("last_access_time_cam1")
+        assert cons.last_query_ms("cam1") is None
+
+    def test_hash_fields_coexist(self, buses):
+        prod, cons = buses
+        prod.touch_query("cam1", now_ms=5)
+        prod.set_proxy_rtmp("cam1", True)
+        h = cons.hgetall("last_access_time_cam1")
+        assert h["last_query"] == "5" and h["proxy_rtmp"] == "true"
+
+
+class TestShmSpecific:
+    def test_cross_process_publish(self, shm_dir):
+        """A real second process publishes; the parent reads — the actual
+        worker->server topology."""
+        code = textwrap.dedent(f"""
+            import numpy as np, sys
+            sys.path.insert(0, {repr(sys.path[0])})
+            from video_edge_ai_proxy_tpu.bus import open_bus, FrameMeta
+            bus = open_bus("shm", {shm_dir!r})
+            bus.create_stream("pcam", 32*32*3)
+            img = np.full((32, 32, 3), 7, dtype=np.uint8)
+            bus.publish("pcam", img, FrameMeta(timestamp_ms=777))
+            bus.kv_set("hello", "from-child")
+        """)
+        subprocess.run([sys.executable, "-c", code], check=True, timeout=60)
+        bus = open_bus("shm", shm_dir)
+        frame = bus.read_latest("pcam")
+        assert frame is not None and frame.meta.timestamp_ms == 777
+        assert frame.data.shape == (32, 32, 3) and (frame.data == 7).all()
+        assert bus.kv_get("hello") == "from-child"
+
+    def test_ring_wrap_consistency(self, shm_dir):
+        """Writer laps a slow reader; reader must still return a consistent
+        (seq, payload) pair, never torn data."""
+        prod = open_bus("shm", shm_dir)
+        cons = open_bus("shm", shm_dir)
+        prod.create_stream("cam", 1000, slots=2)
+        for i in range(50):
+            img = np.full((10, 10, 3), i % 256, dtype=np.uint8)
+            prod.publish("cam", img, FrameMeta(timestamp_ms=i))
+            f = cons.read_latest("cam")
+            assert f is not None
+            assert (f.data == f.meta.timestamp_ms % 256).all()
+
+    def test_oversize_publish_rejected(self, shm_dir):
+        prod = open_bus("shm", shm_dir)
+        prod.create_stream("cam", 100)
+        with pytest.raises(OSError):
+            prod.publish("cam", np.zeros((100, 100, 3), np.uint8), FrameMeta())
+
+    def test_large_frame_grows_reader_buffer(self, shm_dir):
+        prod = open_bus("shm", shm_dir)
+        cons = open_bus("shm", shm_dir)
+        cons._buf = np.empty(16, dtype=np.uint8)  # force regrow path
+        prod.create_stream("cam", 1920 * 1080 * 3)
+        img = np.random.randint(0, 255, (1080, 1920, 3), dtype=np.uint8)
+        prod.publish("cam", img, FrameMeta())
+        f = cons.read_latest("cam")
+        np.testing.assert_array_equal(f.data, img)

@@ -1,0 +1,319 @@
+import json
+import time
+
+import grpc
+import pytest
+
+from video_edge_ai_proxy_tpu.bus import MemoryFrameBus, open_bus
+from video_edge_ai_proxy_tpu.proto import pb, pb_grpc
+from video_edge_ai_proxy_tpu.serve import (
+    NotFound,
+    ProcessError,
+    ProcessManager,
+    SettingsManager,
+    Storage,
+    StreamProcess,
+)
+from video_edge_ai_proxy_tpu.utils.config import Config
+
+
+class TestStorage:
+    """Parity with the reference's only Go tests (storage_test.go:27-94):
+    Put/Get roundtrip and prefix scan over a real embedded store."""
+
+    def test_put_get_roundtrip(self, tmp_path):
+        s = Storage(str(tmp_path / "t.db"))
+        s.put("/rtspprocess/", "cam1", b"hello")
+        assert s.get("/rtspprocess/", "cam1") == b"hello"
+        s.close()
+
+    def test_prefix_scan(self, tmp_path):
+        s = Storage(str(tmp_path / "t.db"))
+        for i in range(10):
+            s.put("/rtspprocess/", f"cam{i}", str(i).encode())
+        s.put("/settings/", "default", b"x")
+        found = s.list("/rtspprocess/")
+        assert len(found) == 10 and found["cam3"] == b"3"
+        s.close()
+
+    def test_missing_raises(self, tmp_path):
+        s = Storage(str(tmp_path / "t.db"))
+        with pytest.raises(NotFound):
+            s.get("/p/", "nope")
+        s.close()
+
+    def test_delete(self, tmp_path):
+        s = Storage(str(tmp_path / "t.db"))
+        s.put("/p/", "k", b"v")
+        s.delete("/p/", "k")
+        assert s.get_or_none("/p/", "k") is None
+        s.close()
+
+    def test_persistence_across_reopen(self, tmp_path):
+        path = str(tmp_path / "t.db")
+        s = Storage(path)
+        s.put("/p/", "k", b"v")
+        s.close()
+        s2 = Storage(path)
+        assert s2.get("/p/", "k") == b"v"
+        s2.close()
+
+
+class TestSettings:
+    def test_default_then_overwrite(self, tmp_path):
+        s = Storage(str(tmp_path / "t.db"))
+        mgr = SettingsManager(s)
+        assert mgr.edge_credentials() == ("", "")
+        mgr.overwrite("key1", "secret1")
+        assert mgr.edge_credentials() == ("key1", "secret1")
+        # Fresh manager reads persisted record.
+        assert SettingsManager(s).edge_credentials() == ("key1", "secret1")
+        s.close()
+
+
+def synth_url(frames=0):
+    extra = f"&frames={frames}" if frames else ""
+    return f"test://pattern?w=64&h=48&fps=30&gop=5{extra}"
+
+
+@pytest.fixture()
+def pm(tmp_path, shm_dir):
+    bus = open_bus("shm", shm_dir)
+    storage = Storage(str(tmp_path / "reg.db"))
+    manager = ProcessManager(storage, bus, shm_dir=shm_dir)
+    yield manager, bus, storage
+    manager.close()
+    bus.close()
+    storage.close()
+
+
+def wait_for(cond, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestProcessManager:
+    def test_start_spawns_worker_and_publishes(self, pm):
+        manager, bus, _ = pm
+        manager.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+        bus.touch_query("cam1")  # decode everything
+        assert wait_for(lambda: bus.read_latest("cam1") is not None)
+        record = manager.info("cam1")
+        assert record.state.running and record.state.pid > 0
+        manager.stop("cam1")
+        assert manager.list() == []
+
+    def test_duplicate_start_conflicts(self, pm):
+        manager, _, _ = pm
+        manager.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+        with pytest.raises(ProcessError):
+            manager.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+
+    def test_stop_unknown_raises(self, pm):
+        manager, _, _ = pm
+        with pytest.raises(ProcessError):
+            manager.stop("ghost")
+
+    def test_default_name_is_md5(self, pm):
+        import hashlib
+
+        manager, _, _ = pm
+        url = synth_url()
+        record = manager.start(StreamProcess(rtsp_endpoint=url))
+        assert record.name == hashlib.md5(url.encode()).hexdigest()
+
+    def test_restart_policy_always(self, pm, monkeypatch):
+        """Worker exits (bounded lifetime) -> supervisor restarts it
+        (Docker RestartPolicy-always parity, rtsp_process_manager.go:76)."""
+        monkeypatch.setenv("vep_max_frames", "5")
+        manager, bus, _ = pm
+        manager.start(
+            StreamProcess(name="cam1", rtsp_endpoint=synth_url())
+        )
+        assert wait_for(
+            lambda: manager.info("cam1").state.failing_streak >= 1, timeout=30
+        )
+
+    def test_eof_reconnect_forever(self, pm):
+        """A source that runs dry does NOT kill the worker — it loops waiting
+        for the camera to return (reference rtsp_to_rtmp.py:186-187)."""
+        manager, bus, _ = pm
+        manager.start(
+            StreamProcess(name="cam1", rtsp_endpoint=synth_url(frames=5))
+        )
+        assert wait_for(lambda: bus.read_latest("cam1") is not None)
+        time.sleep(2.5)  # several EOF/reopen cycles
+        record = manager.info("cam1")
+        assert record.state.running and record.state.failing_streak == 0
+
+    def test_registry_resume(self, pm, shm_dir, tmp_path):
+        manager, bus, storage = pm
+        manager.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+        manager.shutdown_workers()
+        # New manager over the same storage: resume re-spawns.
+        manager2 = ProcessManager(storage, bus, shm_dir=shm_dir)
+        try:
+            assert manager2.resume() == 1
+            assert wait_for(lambda: manager2.info("cam1").state.running)
+        finally:
+            manager2.close()
+
+    def test_info_includes_log_tail(self, pm):
+        manager, bus, _ = pm
+        manager.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+        assert wait_for(
+            lambda: manager.info("cam1").logs is not None
+            and any("ingest worker up" in l for l in manager.info("cam1").logs["stdout"])
+        )
+
+
+@pytest.fixture()
+def server(tmp_path, shm_dir):
+    from video_edge_ai_proxy_tpu.serve.server import Server
+
+    cfg = Config()
+    cfg.bus.shm_dir = shm_dir
+    cfg.annotation.endpoint = "http://127.0.0.1:1/annotate"  # fail fast, no egress
+    srv = Server(cfg, data_dir=str(tmp_path), grpc_port=0, rest_port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class TestEndToEnd:
+    """M0 slice (SURVEY.md §7): synthetic source -> ingest worker ->
+    shm bus -> gRPC VideoLatestImage -> client sees frames."""
+
+    def test_full_slice(self, server):
+        import urllib.request
+
+        rest = f"http://127.0.0.1:{server._rest.bound_port}"
+
+        # settings (REST) — needed for Annotate edge-key check
+        req = urllib.request.Request(
+            rest + "/api/v1/settings",
+            data=json.dumps({"edge_key": "k", "edge_secret": "s"}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+
+        # start a camera (REST)
+        req = urllib.request.Request(
+            rest + "/api/v1/process",
+            data=json.dumps(
+                {"name": "cam1", "rtsp_endpoint": synth_url()}
+            ).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+
+        with urllib.request.urlopen(rest + "/api/v1/processlist") as resp:
+            processes = json.loads(resp.read())
+        assert [p["name"] for p in processes] == ["cam1"]
+
+        channel = grpc.insecure_channel(f"127.0.0.1:{server.bound_grpc_port}")
+        stub = pb_grpc.ImageStub(channel)
+
+        # ListStreams
+        assert wait_for(
+            lambda: any(
+                s.name == "cam1" and s.running
+                for s in stub.ListStreams(pb.ListStreamRequest())
+            )
+        )
+
+        # VideoLatestImage: the reference example pattern
+        # (examples/basic_usage.py / opencv_display.py:43-53).
+        def requests(n=40):
+            for _ in range(n):
+                yield pb.VideoFrameRequest(device_id="cam1")
+                time.sleep(0.02)
+
+        got = None
+        for frame in stub.VideoLatestImage(requests()):
+            got = frame
+            break
+        assert got is not None
+        assert got.width == 64 and got.height == 48
+        assert len(got.data) == 64 * 48 * 3
+        dims = [(d.name, d.size) for d in got.shape.dim]
+        assert dims == [("height", 48), ("width", 64), ("channels", 3)]
+
+        # Annotate: ack-on-enqueue
+        resp = stub.Annotate(
+            pb.AnnotateRequest(
+                device_name="cam1",
+                type="moving",
+                start_timestamp=int(time.time() * 1000),
+            )
+        )
+        assert resp.device_name == "cam1" and resp.type == "moving"
+        assert server.annotations.published == 1
+
+        # Annotate outside the ±7d window is rejected (grpc_annotation_api.go:26-33)
+        with pytest.raises(grpc.RpcError) as err:
+            stub.Annotate(
+                pb.AnnotateRequest(device_name="cam1", type="x", start_timestamp=1)
+            )
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+        # Proxy toggle writes the control key the worker polls
+        resp = stub.Proxy(pb.ProxyRequest(device_id="cam1", passthrough=True))
+        assert resp.passthrough
+        assert server.bus.proxy_rtmp("cam1")
+
+        # Storage toggle requires an RTMP endpoint -> FAILED_PRECONDITION here
+        with pytest.raises(grpc.RpcError) as err:
+            stub.Storage(pb.StorageRequest(device_id="cam1", start=True))
+        assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+        # stop camera (REST)
+        req = urllib.request.Request(
+            rest + "/api/v1/process/cam1", method="DELETE"
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(rest + "/api/v1/processlist") as resp:
+            assert json.loads(resp.read()) == []
+        channel.close()
+
+    def test_per_connection_cursors(self, server):
+        """Two clients on one camera each get frames — the reference's shared
+        deviceMap cursor race (grpc_api.go:42,182) is fixed by design."""
+        import urllib.request
+
+        rest = f"http://127.0.0.1:{server._rest.bound_port}"
+        req = urllib.request.Request(
+            rest + "/api/v1/process",
+            data=json.dumps({"name": "c2", "rtsp_endpoint": synth_url()}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+
+        channel = grpc.insecure_channel(f"127.0.0.1:{server.bound_grpc_port}")
+        stub = pb_grpc.ImageStub(channel)
+
+        def fetch_one():
+            def gen():
+                for _ in range(80):
+                    yield pb.VideoFrameRequest(device_id="c2")
+                    time.sleep(0.02)
+
+            for frame in stub.VideoLatestImage(gen()):
+                return frame
+            return None
+
+        f1 = fetch_one()
+        f2 = fetch_one()
+        assert f1 is not None and f2 is not None
+        channel.close()

@@ -1,0 +1,77 @@
+from video_edge_ai_proxy_tpu.proto import pb
+from video_edge_ai_proxy_tpu.uplink import AnnotationQueue, annotation_to_cloud
+
+
+class TestAnnotationQueue:
+    def test_batching_respects_max(self):
+        batches = []
+        q = AnnotationQueue(lambda b: batches.append(b) or True, max_batch_size=3)
+        for i in range(7):
+            q.publish(bytes([i]))
+        while q.drain_once():
+            pass
+        assert [len(b) for b in batches] == [3, 3, 1]
+        assert q.acked == 7
+
+    def test_reject_requeues_in_order(self):
+        # Reject -> requeue -> next drain succeeds (annotation_consumer.go:33-52,93).
+        fail = {"on": True}
+        seen = []
+
+        def handler(batch):
+            if fail["on"]:
+                return False
+            seen.extend(batch)
+            return True
+
+        q = AnnotationQueue(handler, max_batch_size=10)
+        for i in range(4):
+            q.publish(bytes([i]))
+        assert q.drain_once() == 0
+        assert q.depth() == 4
+        fail["on"] = False
+        q.requeue_rejected()
+        assert q.drain_once() == 4
+        assert seen == [bytes([i]) for i in range(4)]
+
+    def test_unacked_limit_sheds(self):
+        q = AnnotationQueue(lambda b: True, unacked_limit=5)
+        results = [q.publish(b"x") for i in range(8)]
+        assert results == [True] * 5 + [False] * 3
+        assert q.dropped == 3
+
+    def test_handler_exception_counts_as_reject(self):
+        def boom(batch):
+            raise RuntimeError("down")
+
+        q = AnnotationQueue(boom)
+        q.publish(b"x")
+        assert q.drain_once() == 0
+        assert q.depth() == 1
+
+
+class TestAnnotationMapping:
+    def test_proto_to_cloud_mapping(self):
+        req = pb.AnnotateRequest(
+            device_name="cam1",
+            type="moving",
+            start_timestamp=123,
+            confidence=0.9,
+            object_type="person",
+            object_bouding_box=pb.BoundingBox(top=1, left=2, width=3, height=4),
+            location=pb.Location(lat=1.5, lon=2.5),
+            mask=[pb.Coordinate(x=1, y=2), pb.Coordinate(x=3, y=4)],
+            object_signature=[0.1, 0.2],
+            custom_meta_1="gender:f",
+        )
+        out = annotation_to_cloud(req)
+        assert out["device_name"] == "cam1"
+        assert out["bounding_box"] == {"top": 1, "left": 2, "width": 3, "height": 4}
+        assert out["location"] == {"lat": 1.5, "lon": 2.5}
+        assert len(out["mask"]) == 2
+        assert out["object_signature"] == [0.1, 0.2]
+        assert out["custom_meta_1"] == "gender:f"
+
+    def test_optional_fields_absent(self):
+        out = annotation_to_cloud(pb.AnnotateRequest(device_name="c"))
+        assert "bounding_box" not in out and "location" not in out

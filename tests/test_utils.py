@@ -1,0 +1,94 @@
+import pytest
+
+from video_edge_ai_proxy_tpu.serve.cron import cleanup_archive, parse_duration
+from video_edge_ai_proxy_tpu.utils.config import Config, _merge, load_config
+from video_edge_ai_proxy_tpu.utils.parsing import default_device_id, parse_rtmp_key
+from video_edge_ai_proxy_tpu.utils.signing import sign_request, verify_signature
+
+
+class TestSigning:
+    def test_roundtrip(self):
+        payload, headers = sign_request({"a": 1}, "key", "secret")
+        assert verify_signature(payload, headers, "secret")
+        assert headers["X-ChrysEdge-Auth"].startswith("key:")
+
+    def test_bad_secret_rejected(self):
+        payload, headers = sign_request({"a": 1}, "key", "secret")
+        assert not verify_signature(payload, headers, "wrong")
+
+    def test_tampered_payload_rejected(self):
+        payload, headers = sign_request({"a": 1}, "key", "secret")
+        assert not verify_signature(payload + b"x", headers, "secret")
+
+    def test_deterministic_given_ts(self):
+        p1, h1 = sign_request({"a": 1}, "k", "s", now_ms=1234)
+        p2, h2 = sign_request({"a": 1}, "k", "s", now_ms=1234)
+        assert h1 == h2 and p1 == p2
+
+
+class TestParsing:
+    def test_rtmp_key_last_segment(self):
+        # Reference ParseRTMPKey: last path segment (parser_utils.go:10-25).
+        assert parse_rtmp_key("rtmp://host/live/streamkey123") == "streamkey123"
+
+    def test_rtmp_key_rejects_non_rtmp(self):
+        with pytest.raises(ValueError):
+            parse_rtmp_key("http://host/live/abc")
+
+    def test_default_device_id_is_md5(self):
+        # Reference defaults name to md5(rtsp url) (rtsp_process.go:52-55).
+        import hashlib
+
+        url = "rtsp://cam/1"
+        assert default_device_id(url) == hashlib.md5(url.encode()).hexdigest()
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = load_config(path="/nonexistent/conf.yaml")
+        assert cfg.port == 8080 and cfg.grpc_port == 50001
+        assert cfg.annotation.max_batch_size == 299  # ref main.go:59-64
+        assert cfg.annotation.poll_duration_ms == 300
+        assert cfg.annotation.unacked_limit == 1000
+        assert cfg.buffer.in_memory == 1  # ref main.go:74
+
+    def test_yaml_overlay(self, tmp_path):
+        p = tmp_path / "conf.yaml"
+        p.write_text(
+            "port: 9090\nannotation:\n  max_batch_size: 10\n"
+            "engine:\n  batch_buckets: [1, 8]\n"
+        )
+        cfg = load_config(str(p))
+        assert cfg.port == 9090
+        assert cfg.annotation.max_batch_size == 10
+        assert cfg.annotation.poll_duration_ms == 300  # untouched default
+        assert cfg.engine.batch_buckets == (1, 8)
+
+    def test_merge_ignores_unknown(self):
+        cfg = _merge(Config(), {"nope": 1, "port": 81})
+        assert cfg.port == 81
+
+
+class TestCron:
+    def test_parse_duration(self):
+        assert parse_duration("5m") == 300
+        assert parse_duration("1h30m") == 5400
+        assert parse_duration("@every 90s") == 90
+        with pytest.raises(ValueError):
+            parse_duration("whenever")
+
+    def test_cleanup_archive(self, tmp_path):
+        import os
+        import time
+
+        old = tmp_path / "cam1" / "100_200.mp4"
+        old.parent.mkdir()
+        old.write_bytes(b"x")
+        os.utime(old, (time.time() - 1000, time.time() - 1000))
+        fresh = tmp_path / "cam1" / "300_200.mp4"
+        fresh.write_bytes(b"y")
+        other = tmp_path / "cam1" / "note.txt"
+        other.write_bytes(b"z")
+        removed = cleanup_archive(str(tmp_path), older_than_s=500)
+        assert removed == 1
+        assert not old.exists() and fresh.exists() and other.exists()

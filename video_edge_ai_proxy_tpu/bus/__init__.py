@@ -1,0 +1,37 @@
+from .interface import (
+    FIELD_LAST_QUERY,
+    FIELD_PROXY_RTMP,
+    FIELD_STORE,
+    KEY_KEYFRAME_ONLY_PREFIX,
+    KEY_LAST_ACCESS_PREFIX,
+    Frame,
+    FrameBus,
+    FrameMeta,
+)
+from .memory_bus import MemoryFrameBus
+
+
+def open_bus(backend: str = "shm", shm_dir: str = "/dev/shm/vep_tpu") -> FrameBus:
+    """Factory: ``shm`` (native shared-memory, cross-process) or ``memory``
+    (in-proc, tests)."""
+    if backend == "shm":
+        from .shm_bus import ShmFrameBus
+
+        return ShmFrameBus(shm_dir)
+    if backend == "memory":
+        return MemoryFrameBus()
+    raise ValueError(f"unknown bus backend {backend!r}")
+
+
+__all__ = [
+    "Frame",
+    "FrameBus",
+    "FrameMeta",
+    "MemoryFrameBus",
+    "open_bus",
+    "KEY_LAST_ACCESS_PREFIX",
+    "KEY_KEYFRAME_ONLY_PREFIX",
+    "FIELD_LAST_QUERY",
+    "FIELD_PROXY_RTMP",
+    "FIELD_STORE",
+]

@@ -1,0 +1,275 @@
+"""Shared-memory frame bus: ctypes binding over the native vepbus library.
+
+One mmapped ring file per camera (``<shm_dir>/<device_id>.ring``) plus one
+control KV (``<shm_dir>/control.kv``). All processes on the host (ingest
+workers, gRPC server, TPU engine) map the same files; the frame hot path is a
+single memcpy with seqlock validation — no broker, no sockets, no syscalls
+(vs. the reference's Redis round-trip, ``server/grpcapi/grpc_api.go:187-229``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from .interface import FRAME_TYPE_CODES, FRAME_TYPE_NAMES, Frame, FrameBus, FrameMeta
+from .native.build import build_library
+
+log = get_logger("bus.shm")
+
+
+class _CFrameMeta(ctypes.Structure):
+    # Mirrors FrameMeta in bus/native/vepbus.cpp.
+    _fields_ = [
+        ("width", ctypes.c_int64),
+        ("height", ctypes.c_int64),
+        ("channels", ctypes.c_int64),
+        ("timestamp_ms", ctypes.c_int64),
+        ("pts", ctypes.c_int64),
+        ("dts", ctypes.c_int64),
+        ("packet", ctypes.c_int64),
+        ("keyframe_cnt", ctypes.c_int64),
+        ("is_keyframe", ctypes.c_int32),
+        ("is_corrupt", ctypes.c_int32),
+        ("frame_type", ctypes.c_int32),
+        ("dtype", ctypes.c_int32),
+        ("time_base", ctypes.c_double),
+    ]
+
+
+_lib = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(build_library())
+    u64, i64, i32, u32 = (
+        ctypes.c_uint64,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_uint32,
+    )
+    p8 = ctypes.POINTER(ctypes.c_uint8)
+    lib.vb_ring_create.restype = ctypes.c_void_p
+    lib.vb_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p, u32, u64]
+    lib.vb_ring_open.restype = ctypes.c_void_p
+    lib.vb_ring_open.argtypes = [ctypes.c_char_p]
+    lib.vb_ring_close.argtypes = [ctypes.c_void_p]
+    lib.vb_ring_slot_size.restype = u64
+    lib.vb_ring_slot_size.argtypes = [ctypes.c_void_p]
+    lib.vb_ring_head.restype = u64
+    lib.vb_ring_head.argtypes = [ctypes.c_void_p]
+    lib.vb_ring_publish.restype = u64
+    lib.vb_ring_publish.argtypes = [
+        ctypes.c_void_p, p8, u64, ctypes.POINTER(_CFrameMeta),
+    ]
+    lib.vb_ring_read_latest.restype = u64
+    lib.vb_ring_read_latest.argtypes = [
+        ctypes.c_void_p, u64, p8, u64,
+        ctypes.POINTER(u64), ctypes.POINTER(_CFrameMeta),
+    ]
+    lib.vb_kv_open.restype = ctypes.c_void_p
+    lib.vb_kv_open.argtypes = [ctypes.c_char_p, u32]
+    lib.vb_kv_close.argtypes = [ctypes.c_void_p]
+    lib.vb_kv_set.restype = i32
+    lib.vb_kv_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, p8, u32]
+    lib.vb_kv_get.restype = i64
+    lib.vb_kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, p8, u32]
+    lib.vb_kv_del.restype = i32
+    lib.vb_kv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.vb_kv_keys.restype = i64
+    lib.vb_kv_keys.argtypes = [ctypes.c_void_p, p8, u64]
+    _lib = lib
+    return lib
+
+
+def _u8ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+_RING_SUFFIX = ".ring"
+_KV_SLOTS = 4096
+_KV_VAL_CAP = 1024
+
+
+class ShmFrameBus(FrameBus):
+    def __init__(self, shm_dir: str = "/dev/shm/vep_tpu"):
+        self._lib = _load()
+        self._dir = shm_dir
+        os.makedirs(shm_dir, exist_ok=True)
+        self._rings: dict[str, int] = {}  # device_id -> handle (this process)
+        self._inodes: dict[str, int] = {}  # reader handles: inode at open time
+        self._writer: set[str] = set()
+        self._kv = self._lib.vb_kv_open(
+            os.path.join(shm_dir, "control.kv").encode(), _KV_SLOTS
+        )
+        if not self._kv:
+            raise OSError(f"failed to open control KV in {shm_dir}")
+        # Reusable read buffer, grown on demand.
+        self._buf = np.empty(4 << 20, dtype=np.uint8)
+
+    # -- paths --
+
+    def _ring_path(self, device_id: str) -> str:
+        safe = device_id.replace("/", "_")
+        return os.path.join(self._dir, safe + _RING_SUFFIX)
+
+    # -- frame plane --
+
+    def create_stream(self, device_id: str, frame_bytes: int, slots: int = 4) -> None:
+        self.drop_stream(device_id)
+        h = self._lib.vb_ring_create(
+            self._ring_path(device_id).encode(), device_id.encode(),
+            slots, frame_bytes,
+        )
+        if not h:
+            raise OSError(f"failed to create ring for {device_id}")
+        self._rings[device_id] = h
+        self._writer.add(device_id)
+
+    def _handle(self, device_id: str) -> Optional[int]:
+        path = self._ring_path(device_id)
+        h = self._rings.get(device_id)
+        if h and device_id in self._writer:
+            return h
+        # Reader side: a restarted worker re-creates the ring file, so a
+        # cached mapping can point at a dead inode — re-validate per lookup.
+        try:
+            ino = os.stat(path).st_ino
+        except FileNotFoundError:
+            if h:
+                self._lib.vb_ring_close(h)
+                self._rings.pop(device_id, None)
+                self._inodes.pop(device_id, None)
+            return None
+        if h and self._inodes.get(device_id) == ino:
+            return h
+        if h:
+            self._lib.vb_ring_close(h)
+            self._rings.pop(device_id, None)
+        h = self._lib.vb_ring_open(path.encode())
+        if not h:
+            return None
+        self._rings[device_id] = h
+        self._inodes[device_id] = ino
+        return h
+
+    def publish(self, device_id: str, data: np.ndarray, meta: FrameMeta) -> int:
+        h = self._rings.get(device_id)
+        if h is None or device_id not in self._writer:
+            raise ValueError(f"not the producer for stream {device_id!r}")
+        arr = np.ascontiguousarray(data)
+        cm = _CFrameMeta(
+            width=meta.width or (arr.shape[1] if arr.ndim >= 2 else 0),
+            height=meta.height or (arr.shape[0] if arr.ndim >= 2 else 0),
+            channels=meta.channels,
+            timestamp_ms=meta.timestamp_ms,
+            pts=meta.pts,
+            dts=meta.dts,
+            packet=meta.packet,
+            keyframe_cnt=meta.keyframe_cnt,
+            is_keyframe=int(meta.is_keyframe),
+            is_corrupt=int(meta.is_corrupt),
+            frame_type=FRAME_TYPE_CODES.get(meta.frame_type, 0),
+            dtype=0,
+            time_base=meta.time_base,
+        )
+        seq = self._lib.vb_ring_publish(
+            h, _u8ptr(arr), arr.nbytes, ctypes.byref(cm)
+        )
+        if seq == 0:
+            raise OSError(
+                f"publish failed for {device_id} ({arr.nbytes} B > slot?)"
+            )
+        return int(seq)
+
+    def read_latest(self, device_id: str, min_seq: int = 0) -> Optional[Frame]:
+        h = self._handle(device_id)
+        if h is None:
+            return None
+        out_len = ctypes.c_uint64(0)
+        cm = _CFrameMeta()
+        while True:
+            seq = self._lib.vb_ring_read_latest(
+                h, min_seq, _u8ptr(self._buf), self._buf.nbytes,
+                ctypes.byref(out_len), ctypes.byref(cm),
+            )
+            if seq == ctypes.c_uint64(-1).value:  # buffer too small
+                self._buf = np.empty(int(out_len.value) * 2, dtype=np.uint8)
+                continue
+            break
+        if seq == 0:
+            return None
+        n = int(out_len.value)
+        h_, w_, c_ = int(cm.height), int(cm.width), int(cm.channels)
+        raw = self._buf[:n].copy()
+        data = raw.reshape(h_, w_, c_) if h_ * w_ * c_ == n else raw
+        meta = FrameMeta(
+            width=w_, height=h_, channels=c_,
+            timestamp_ms=int(cm.timestamp_ms), pts=int(cm.pts), dts=int(cm.dts),
+            packet=int(cm.packet), keyframe_cnt=int(cm.keyframe_cnt),
+            is_keyframe=bool(cm.is_keyframe), is_corrupt=bool(cm.is_corrupt),
+            frame_type=FRAME_TYPE_NAMES.get(int(cm.frame_type), ""),
+            time_base=float(cm.time_base),
+        )
+        return Frame(seq=int(seq), data=data, meta=meta)
+
+    def streams(self) -> list[str]:
+        out = []
+        try:
+            names = os.listdir(self._dir)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            if name.endswith(_RING_SUFFIX):
+                out.append(name[: -len(_RING_SUFFIX)])
+        return sorted(out)
+
+    def drop_stream(self, device_id: str) -> None:
+        h = self._rings.pop(device_id, None)
+        if h:
+            self._lib.vb_ring_close(h)
+        self._writer.discard(device_id)
+        try:
+            os.unlink(self._ring_path(device_id))
+        except FileNotFoundError:
+            pass
+
+    # -- control plane --
+
+    def kv_set(self, key: str, value: str) -> None:
+        raw = value.encode()
+        if self._lib.vb_kv_set(self._kv, key.encode(), _u8ptr(
+                np.frombuffer(raw, dtype=np.uint8).copy()), len(raw)) != 0:
+            raise OSError(f"kv_set failed for {key!r} (table full / oversize)")
+
+    def kv_get(self, key: str) -> Optional[str]:
+        buf = np.empty(_KV_VAL_CAP, dtype=np.uint8)
+        n = self._lib.vb_kv_get(self._kv, key.encode(), _u8ptr(buf), buf.nbytes)
+        if n <= 0:
+            return None
+        return bytes(buf[:n]).decode()
+
+    def kv_del(self, key: str) -> None:
+        self._lib.vb_kv_del(self._kv, key.encode())
+
+    def kv_keys(self) -> list[str]:
+        buf = np.empty(1 << 20, dtype=np.uint8)
+        n = self._lib.vb_kv_keys(self._kv, _u8ptr(buf), buf.nbytes)
+        if n <= 0:
+            return []
+        return bytes(buf[:n]).decode().splitlines()
+
+    def close(self) -> None:
+        for h in self._rings.values():
+            self._lib.vb_ring_close(h)
+        self._rings.clear()
+        if self._kv:
+            self._lib.vb_kv_close(self._kv)
+            self._kv = None

@@ -1,0 +1,318 @@
+"""Per-camera ingest worker.
+
+One worker process per camera — the reference runs one Docker container per
+camera with three threads (demux -> decode -> archive,
+``python/rtsp_to_rtmp.py:207-253``). Here the demux/decode pair collapses into
+one capture loop (grab -> gated retrieve; the two-phase laziness lives in the
+source, see ``sources.py``) and the archiver remains its own thread fed by a
+queue — same pipeline shape, minus the cross-thread handshake the reference
+got wrong (its ``query_timestamp`` global never crossed modules, SURVEY.md
+§3.2; ours is an explicit read of the shared-memory control KV each packet,
+exactly as the reference *intended* with its per-packet Redis HGETALL,
+``rtsp_to_rtmp.py:117``).
+
+Decode gating (reference semantics, ``rtsp_to_rtmp.py:141-153``,
+``read_image.py:70-80``):
+- keyframes always decode;
+- non-keyframes decode only when a client queried within ``active_window``
+  seconds (default 10, reference ``rtsp_to_rtmp.py:144-145``);
+- keyframe-only mode (per-device KV flag) restricts decode to keyframes;
+- archiving enabled forces full decode (our archive stores decoded GOP
+  segments; the reference archives compressed packets,
+  ``python/archive.py:75-100`` — a deliberate re-design, we have no demux-level
+  packet access through OpenCV).
+
+Failure semantics (reference ``rtsp_to_rtmp.py:61-79,186-187``): initial
+connect failure exits nonzero so the supervisor restarts the worker
+(restart-policy-always parity); mid-stream EOF loops forever re-opening the
+source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..bus import FrameBus, FrameMeta, open_bus
+from ..utils.logging import get_logger
+from .archive import GopSegment, SegmentArchiver
+from .sources import VideoSource, open_source
+
+log = get_logger("ingest.worker")
+
+KEY_STATUS_PREFIX = "stream_status_"   # worker heartbeat (new; the reference
+                                       # derives health from Docker inspect,
+                                       # rtsp_process_manager.go:283-335)
+RECONNECT_DELAY_S = 1.0
+STATUS_INTERVAL_S = 1.0
+
+
+@dataclass
+class WorkerConfig:
+    rtsp_endpoint: str
+    device_id: str
+    rtmp_endpoint: str = ""
+    in_memory_buffer: int = 1
+    disk_buffer_path: str = ""
+    active_window_s: float = 10.0
+    shm_dir: str = "/dev/shm/vep_tpu"
+    bus_backend: str = "shm"
+    max_frames: int = 0  # 0 = endless; tests set a bound
+
+    @classmethod
+    def from_env(cls) -> "WorkerConfig":
+        """Env-var contract parity with the reference's server->worker
+        interface (``services/rtsp_process_manager.go:96-104``,
+        ``python/start.sh:8-12``)."""
+        env = os.environ
+        return cls(
+            rtsp_endpoint=env.get("rtsp_endpoint", ""),
+            device_id=env.get("device_id", ""),
+            rtmp_endpoint=env.get("rtmp_endpoint", ""),
+            in_memory_buffer=int(env.get("in_memory_buffer", "1") or 1),
+            disk_buffer_path=env.get("disk_buffer_path", ""),
+            shm_dir=env.get("vep_shm_dir", "/dev/shm/vep_tpu"),
+            max_frames=int(env.get("vep_max_frames", "0") or 0),
+        )
+
+
+class IngestWorker:
+    def __init__(
+        self,
+        cfg: WorkerConfig,
+        bus: Optional[FrameBus] = None,
+        source: Optional[VideoSource] = None,
+    ):
+        self.cfg = cfg
+        self.bus = bus or open_bus(cfg.bus_backend, cfg.shm_dir)
+        self.source = source or open_source(cfg.rtsp_endpoint)
+        self._stop = threading.Event()
+        self._packets = 0
+        self._keyframes = 0
+        self._decoded = 0
+        self._published = 0
+        self._last_status = 0.0
+        self._fps_window: list[float] = []
+        self._archiver: Optional[SegmentArchiver] = None
+        self._gop_frames: list = []
+        self._gop_start_ms = 0
+        self._rtmp_warned = False
+
+    # -- control-plane reads (per packet; shm KV, nanosecond-cheap) --
+
+    def _client_active(self, now_ms: int) -> bool:
+        last = self.bus.last_query_ms(self.cfg.device_id)
+        return last is not None and (now_ms - last) < self.cfg.active_window_s * 1000
+
+    def _should_decode(self, is_keyframe: bool, now_ms: int) -> bool:
+        if self._archiver is not None:
+            return True
+        if is_keyframe:
+            return True
+        if self.bus.keyframe_only(self.cfg.device_id):
+            return False
+        return self._client_active(now_ms)
+
+    # -- status heartbeat --
+
+    def _publish_status(self, now: float, error: str = "", force: bool = False) -> None:
+        if now - self._last_status < STATUS_INTERVAL_S and not (error or force):
+            return
+        self._last_status = now
+        window = [t for t in self._fps_window if now - t < 5.0]
+        self._fps_window = window
+        status = {
+            "pid": os.getpid(),
+            "running": not self._stop.is_set(),
+            "packets": self._packets,
+            "keyframes": self._keyframes,
+            "decoded": self._decoded,
+            "published": self._published,
+            "fps": round(len(window) / 5.0, 2),
+            "width": self.source.width,
+            "height": self.source.height,
+            "error": error,
+            "ts_ms": int(time.time() * 1000),  # epoch: readers check staleness
+        }
+        self.bus.kv_set(
+            KEY_STATUS_PREFIX + self.cfg.device_id,
+            json.dumps(status, separators=(",", ":")),
+        )
+
+    # -- archive plumbing --
+
+    def _archive_frame(self, frame, meta: FrameMeta) -> None:
+        if self._archiver is None:
+            return
+        if meta.is_keyframe and self._gop_frames:
+            # Keyframe closes the previous GOP -> hand to archiver thread
+            # (reference rtsp_to_rtmp.py:97-110).
+            self._archiver.submit(
+                GopSegment(
+                    device_id=self.cfg.device_id,
+                    start_ts_ms=self._gop_start_ms,
+                    end_ts_ms=meta.timestamp_ms,
+                    fps=self.source.fps or 30.0,
+                    frames=self._gop_frames,
+                )
+            )
+            self._gop_frames = []
+        if meta.is_keyframe or self._gop_frames:
+            if not self._gop_frames:
+                self._gop_start_ms = meta.timestamp_ms
+            self._gop_frames.append(frame)
+
+    # -- RTMP pass-through (toggle parity; transport gated on capability) --
+
+    def _maybe_passthrough(self) -> None:
+        if not self.cfg.rtmp_endpoint:
+            return
+        if self.bus.proxy_rtmp(self.cfg.device_id) and not self._rtmp_warned:
+            # The reference re-muxes compressed packets to RTMP
+            # (rtsp_to_rtmp.py:163-182); without a muxer binary in this image
+            # the toggle is accepted and surfaced, transport is a no-op.
+            log.warning(
+                "RTMP passthrough requested for %s but no muxer backend is "
+                "available in this build; toggle state is tracked only",
+                self.cfg.device_id,
+            )
+            self._rtmp_warned = True
+
+    # -- main loop --
+
+    def run(self) -> None:
+        cfg = self.cfg
+        try:
+            self.source.open()
+        except ConnectionError as exc:
+            # Exit hard: supervisor restart-policy takes over (reference
+            # rtsp_to_rtmp.py:76-78 + RestartPolicy always).
+            log.error("initial connect failed for %s: %s", cfg.device_id, exc)
+            self._publish_status(time.monotonic(), error=str(exc))
+            raise SystemExit(2)
+
+        frame_bytes = max(
+            self.source.width * self.source.height * 3, 1920 * 1080 * 3
+        )
+        self.bus.create_stream(
+            cfg.device_id, frame_bytes, slots=max(2, cfg.in_memory_buffer + 1)
+        )
+        if cfg.disk_buffer_path:
+            self._archiver = SegmentArchiver(cfg.disk_buffer_path)
+            self._archiver.start()
+        log.info(
+            "ingest worker up: device=%s source=%s %dx%d@%.1ffps",
+            cfg.device_id, cfg.rtsp_endpoint,
+            self.source.width, self.source.height, self.source.fps,
+        )
+
+        try:
+            while not self._stop.is_set():
+                pkt = self.source.grab()
+                if pkt is None:
+                    if cfg.max_frames and self._packets >= cfg.max_frames:
+                        break
+                    # Mid-stream EOF: wait for the camera to come back,
+                    # forever (reference rtsp_to_rtmp.py:186-187).
+                    log.warning(
+                        "stream %s EOF/gone; reconnecting in %.0fs",
+                        cfg.device_id, RECONNECT_DELAY_S,
+                    )
+                    self.source.close()
+                    if self._stop.wait(RECONNECT_DELAY_S):
+                        break
+                    try:
+                        self.source.open()
+                    except ConnectionError:
+                        pass
+                    continue
+
+                self._packets += 1
+                if pkt.is_keyframe:
+                    self._keyframes += 1
+                now_ms = pkt.timestamp_ms
+                self._maybe_passthrough()
+
+                if self._should_decode(pkt.is_keyframe, now_ms):
+                    frame = self.source.retrieve()
+                    if frame is None:
+                        continue
+                    self._decoded += 1
+                    meta = FrameMeta(
+                        width=frame.shape[1],
+                        height=frame.shape[0],
+                        channels=frame.shape[2] if frame.ndim == 3 else 1,
+                        timestamp_ms=now_ms,
+                        pts=pkt.pts,
+                        dts=pkt.dts,
+                        packet=pkt.packet,
+                        keyframe_cnt=self._keyframes,
+                        is_keyframe=pkt.is_keyframe,
+                        frame_type="I" if pkt.is_keyframe else "P",
+                        time_base=pkt.time_base,
+                    )
+                    self.bus.publish(cfg.device_id, frame, meta)
+                    self._published += 1
+                    self._fps_window.append(time.monotonic())
+                    self._archive_frame(frame, meta)
+
+                self._publish_status(time.monotonic())
+                if cfg.max_frames and self._packets >= cfg.max_frames:
+                    break
+        finally:
+            self._publish_status(time.monotonic(), force=True)
+            if self._archiver is not None:
+                self._archiver.stop()
+            self.source.close()
+            log.info(
+                "ingest worker down: device=%s packets=%d decoded=%d",
+                cfg.device_id, self._packets, self._decoded,
+            )
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """CLI entrypoint; flags mirror the reference's ``start.sh:27-43`` argv
+    translation, and every flag falls back to the env-var contract."""
+    env_cfg = WorkerConfig.from_env()
+    p = argparse.ArgumentParser(description="per-camera ingest worker")
+    p.add_argument("--rtsp", default=env_cfg.rtsp_endpoint)
+    p.add_argument("--device_id", default=env_cfg.device_id)
+    p.add_argument("--rtmp", default=env_cfg.rtmp_endpoint)
+    p.add_argument("--memory_buffer", type=int, default=env_cfg.in_memory_buffer)
+    p.add_argument("--disk_buffer_path", default=env_cfg.disk_buffer_path)
+    p.add_argument("--shm_dir", default=env_cfg.shm_dir)
+    p.add_argument("--max_frames", type=int, default=env_cfg.max_frames)
+    args = p.parse_args(argv)
+    if not args.rtsp or not args.device_id:
+        p.error("--rtsp and --device_id are required (or env contract)")
+    cfg = WorkerConfig(
+        rtsp_endpoint=args.rtsp,
+        device_id=args.device_id,
+        rtmp_endpoint=args.rtmp,
+        in_memory_buffer=args.memory_buffer,
+        disk_buffer_path=args.disk_buffer_path,
+        shm_dir=args.shm_dir,
+        max_frames=args.max_frames,
+    )
+    worker = IngestWorker(cfg)
+
+    import signal
+
+    def _sig(_s, _f):
+        worker.stop()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    worker.run()
+
+
+if __name__ == "__main__":
+    main()

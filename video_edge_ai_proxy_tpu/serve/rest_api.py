@@ -1,0 +1,182 @@
+"""REST control API.
+
+Route + payload parity with the reference's gin router
+(``server/router/config_routes.go:39-47``, handlers ``server/api/``):
+
+    POST   /api/v1/process         start a camera
+    DELETE /api/v1/process/{name}  stop a camera
+    GET    /api/v1/process/{name}  info (record + live state + log tail)
+    GET    /api/v1/processlist     list cameras
+    GET    /api/v1/settings        edge credentials
+    POST   /api/v1/settings        overwrite edge credentials
+
+CORS is wide open like the reference (``config_routes.go:29-35``). Errors use
+the reference's JSON envelope (``server/api/error.go``). Served by aiohttp in
+a dedicated thread with its own event loop (the gRPC server and process
+supervisor are thread-based).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import threading
+from typing import Optional
+
+from aiohttp import web
+
+from ..utils.logging import get_logger
+from .models import RTMPStreamStatus, StreamProcess
+from .process_manager import ProcessError, ProcessManager
+from .settings import SettingsManager
+
+log = get_logger("serve.rest")
+
+
+def _error(status: int, message: str) -> web.Response:
+    # JSON envelope parity with AbortWithError (server/api/error.go).
+    return web.json_response({"code": status, "message": message}, status=status)
+
+
+def _to_dict(obj) -> dict:
+    def drop_none(o):
+        if isinstance(o, dict):
+            return {k: drop_none(v) for k, v in o.items() if v is not None}
+        return o
+
+    return drop_none(dataclasses.asdict(obj))
+
+
+@web.middleware
+async def _cors(request: web.Request, handler):
+    if request.method == "OPTIONS":
+        resp = web.Response(status=204)
+    else:
+        resp = await handler(request)
+    resp.headers["Access-Control-Allow-Origin"] = "*"
+    resp.headers["Access-Control-Allow-Methods"] = "*"
+    resp.headers["Access-Control-Allow-Headers"] = "*"
+    resp.headers["Access-Control-Allow-Credentials"] = "true"
+    return resp
+
+
+def build_app(pm: ProcessManager, settings: SettingsManager) -> web.Application:
+    app = web.Application(middlewares=[_cors], client_max_size=8 << 20)
+
+    async def start_process(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        if not body.get("rtsp_endpoint"):
+            # Message parity: reference api/rtsp_process.go:50-52.
+            return _error(400, "RTP endpoint required")
+        record = StreamProcess(
+            name=body.get("name", ""),
+            image_tag=body.get("image_tag", ""),
+            rtsp_endpoint=body["rtsp_endpoint"],
+            rtmp_endpoint=body.get("rtmp_endpoint", ""),
+            rtmp_stream_status=RTMPStreamStatus(streaming=True, storing=False),
+            inference_model=body.get("inference_model", ""),
+        )
+        try:
+            await asyncio.to_thread(pm.start, record)
+        except ProcessError as exc:
+            return _error(409, str(exc))
+        return web.Response(status=200)
+
+    async def stop_process(request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        try:
+            await asyncio.to_thread(pm.stop, name)
+        except ProcessError as exc:
+            return _error(409, str(exc))
+        return web.Response(status=200)
+
+    async def process_info(request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        try:
+            record = await asyncio.to_thread(pm.info, name)
+        except ProcessError as exc:
+            return _error(400, str(exc))
+        return web.json_response(_to_dict(record))
+
+    async def process_list(_request: web.Request) -> web.Response:
+        records = await asyncio.to_thread(pm.list)
+        return web.json_response([_to_dict(r) for r in records])
+
+    async def settings_get(_request: web.Request) -> web.Response:
+        s = await asyncio.to_thread(settings.get)
+        return web.json_response(_to_dict(s))
+
+    async def settings_overwrite(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        s = await asyncio.to_thread(
+            settings.overwrite,
+            body.get("edge_key", ""),
+            body.get("edge_secret", ""),
+        )
+        return web.json_response(_to_dict(s))
+
+    app.router.add_post("/api/v1/process", start_process)
+    app.router.add_delete("/api/v1/process/{name}", stop_process)
+    app.router.add_get("/api/v1/process/{name}", process_info)
+    app.router.add_get("/api/v1/processlist", process_list)
+    app.router.add_get("/api/v1/settings", settings_get)
+    app.router.add_post("/api/v1/settings", settings_overwrite)
+    async def options(_request: web.Request) -> web.Response:
+        return web.Response(status=204)
+
+    app.router.add_route("OPTIONS", "/api/v1/{tail:.*}", options)
+    return app
+
+
+class RestServer:
+    """aiohttp app on a background thread; join/stop from the main thread."""
+
+    def __init__(self, pm: ProcessManager, settings: SettingsManager,
+                 host: str = "0.0.0.0", port: int = 8080):
+        self._app = build_app(pm, settings)
+        self._host = host
+        self._port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self.bound_port: int = port
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="rest-api", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=15):
+            raise RuntimeError("REST server failed to start")
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+
+        async def serve():
+            runner = web.AppRunner(self._app)
+            await runner.setup()
+            site = web.TCPSite(runner, self._host, self._port)
+            await site.start()
+            server = site._server  # bound socket (port 0 -> ephemeral in tests)
+            if server and server.sockets:
+                self.bound_port = server.sockets[0].getsockname()[1]
+            log.info("REST API listening on %s:%d", self._host, self.bound_port)
+            self._started.set()
+
+        loop.run_until_complete(serve())
+        try:
+            loop.run_forever()
+        finally:
+            loop.close()
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
