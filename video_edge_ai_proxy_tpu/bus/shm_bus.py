@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import time
 from typing import Optional
 
 import numpy as np
@@ -105,6 +106,7 @@ class ShmFrameBus(FrameBus):
         os.makedirs(shm_dir, exist_ok=True)
         self._rings: dict[str, int] = {}  # device_id -> handle (this process)
         self._inodes: dict[str, int] = {}  # reader handles: inode at open time
+        self._checked: dict[str, float] = {}  # last inode revalidation time
         self._writer: set[str] = set()
         self._kv = self._lib.vb_kv_open(
             os.path.join(shm_dir, "control.kv").encode(), _KV_SLOTS
@@ -133,13 +135,22 @@ class ShmFrameBus(FrameBus):
         self._rings[device_id] = h
         self._writer.add(device_id)
 
+    # A restarted worker re-creates its ring file, so a cached reader mapping
+    # can point at a dead inode. Re-validating with os.stat on *every* read
+    # would put a syscall on the per-frame hot path (belied by the module
+    # header); a dead mapping only manifests as the head going quiet, so a
+    # coarse revalidation interval gives the same correctness with the stat
+    # off the hit path.
+    _REVALIDATE_S = 0.25
+
     def _handle(self, device_id: str) -> Optional[int]:
         path = self._ring_path(device_id)
         h = self._rings.get(device_id)
         if h and device_id in self._writer:
             return h
-        # Reader side: a restarted worker re-creates the ring file, so a
-        # cached mapping can point at a dead inode — re-validate per lookup.
+        now = time.monotonic()
+        if h and now - self._checked.get(device_id, 0.0) < self._REVALIDATE_S:
+            return h
         try:
             ino = os.stat(path).st_ino
         except FileNotFoundError:
@@ -147,7 +158,9 @@ class ShmFrameBus(FrameBus):
                 self._lib.vb_ring_close(h)
                 self._rings.pop(device_id, None)
                 self._inodes.pop(device_id, None)
+                self._checked.pop(device_id, None)
             return None
+        self._checked[device_id] = now
         if h and self._inodes.get(device_id) == ino:
             return h
         if h:

@@ -256,7 +256,24 @@ class IngestWorker:
                         frame_type="I" if pkt.is_keyframe else "P",
                         time_base=pkt.time_base,
                     )
-                    self.bus.publish(cfg.device_id, frame, meta)
+                    try:
+                        self.bus.publish(cfg.device_id, frame, meta)
+                    except OSError:
+                        # Slot too small: the source under-reported its
+                        # resolution at open (OpenCV backends may say 0x0) or
+                        # the camera switched to a larger mode mid-stream.
+                        # The worker owns the ring, so grow it in place
+                        # rather than dying into a restart loop that would
+                        # re-create the same undersized ring.
+                        log.warning(
+                            "ring slot too small for %s (%d B); recreating",
+                            cfg.device_id, frame.nbytes,
+                        )
+                        self.bus.create_stream(
+                            cfg.device_id, frame.nbytes,
+                            slots=max(2, cfg.in_memory_buffer + 1),
+                        )
+                        self.bus.publish(cfg.device_id, frame, meta)
                     self._published += 1
                     self._fps_window.append(time.monotonic())
                     self._archive_frame(frame, meta)

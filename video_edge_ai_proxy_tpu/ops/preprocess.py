@@ -1,0 +1,129 @@
+"""On-device preprocessing: uint8 frames in, model-ready bf16 batches out.
+
+Design (SURVEY.md §7 hard part 2 — H2D bandwidth): frames cross PCIe as
+uint8 NHWC BGR24 exactly as they sit on the frame bus (1 byte/px; 16×1080p
+×30fps ≈ 186 MB/s instead of 745 MB/s as f32). Everything downstream —
+BGR→RGB flip, cast, resize, normalize, dtype pack — happens inside the jitted
+graph so XLA fuses it into the first conv's input pipeline.
+
+The reference leaves all of this to external clients (``README.md:202``
+documents raw BGR24 on the bus; ``examples/opencv_display.py:46-53`` rebuilds
+the numpy array client-side). Here it is a device op.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Standard ImageNet statistics (RGB order), used by every classifier in the
+# model zoo.
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def _bgr_to_rgb_float(frames_u8: jnp.ndarray) -> jnp.ndarray:
+    """NHWC uint8 BGR -> float32 RGB in [0, 1]."""
+    return frames_u8[..., ::-1].astype(jnp.float32) * (1.0 / 255.0)
+
+
+def preprocess_classify(
+    frames_u8: jnp.ndarray,
+    size: tuple[int, int] = (224, 224),
+    mean: tuple[float, ...] = IMAGENET_MEAN,
+    std: tuple[float, ...] = IMAGENET_STD,
+    out_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Classifier path: [N, H, W, 3] uint8 BGR -> [N, h, w, 3] normalized.
+
+    Resize is plain bilinear (stretch, no aspect preservation) — matching
+    what CPU clients of the reference typically do before a classifier.
+    """
+    x = _bgr_to_rgb_float(frames_u8)
+    n = x.shape[0]
+    x = jax.image.resize(x, (n, size[0], size[1], 3), method="bilinear")
+    mean_a = jnp.asarray(mean, dtype=jnp.float32)
+    std_a = jnp.asarray(std, dtype=jnp.float32)
+    x = (x - mean_a) / std_a
+    return x.astype(out_dtype)
+
+
+def preprocess_clip(
+    clips_u8: jnp.ndarray,
+    size: tuple[int, int] = (224, 224),
+    mean: tuple[float, ...] = IMAGENET_MEAN,
+    std: tuple[float, ...] = IMAGENET_STD,
+    out_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Video path (BASELINE config 5): [N, T, H, W, 3] uint8 -> normalized.
+
+    The temporal axis is just an extra leading axis folded into the batch for
+    the resize (SURVEY.md §5.7 — clip length 8 needs no sequence tricks at
+    preprocess time).
+    """
+    n, t = clips_u8.shape[:2]
+    flat = clips_u8.reshape((n * t,) + clips_u8.shape[2:])
+    out = preprocess_classify(flat, size=size, mean=mean, std=std, out_dtype=out_dtype)
+    return out.reshape((n, t) + out.shape[1:])
+
+
+class LetterboxParams(NamedTuple):
+    """Static geometry of a letterbox resize — needed to map detector boxes
+    back to source-frame pixel coordinates."""
+
+    scale: float      # source px * scale = letterboxed px
+    pad_x: float      # left padding in letterboxed px
+    pad_y: float      # top padding in letterboxed px
+    new_w: int
+    new_h: int
+
+
+def letterbox_params(src_hw: tuple[int, int], dst: int) -> LetterboxParams:
+    """Compute letterbox geometry for a (static) source shape.
+
+    Shapes are static per batch bucket, so this runs in Python at trace time
+    and bakes constants into the graph — no dynamic shapes reach XLA.
+    """
+    h, w = src_hw
+    scale = min(dst / h, dst / w)
+    new_h, new_w = int(round(h * scale)), int(round(w * scale))
+    pad_y = (dst - new_h) / 2.0
+    pad_x = (dst - new_w) / 2.0
+    return LetterboxParams(scale, pad_x, pad_y, new_w, new_h)
+
+
+def preprocess_letterbox(
+    frames_u8: jnp.ndarray,
+    dst: int = 640,
+    pad_value: float = 114.0 / 255.0,
+    out_dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[jnp.ndarray, LetterboxParams]:
+    """Detector path: [N, H, W, 3] uint8 BGR -> [N, dst, dst, 3] letterboxed
+    RGB in [0, 1] (the YOLO-family input convention), plus the geometry to
+    undo it on output boxes.
+    """
+    params = letterbox_params(frames_u8.shape[1:3], dst)
+    x = _bgr_to_rgb_float(frames_u8)
+    n = x.shape[0]
+    x = jax.image.resize(x, (n, params.new_h, params.new_w, 3), method="bilinear")
+    top = int(round(params.pad_y))
+    left = int(round(params.pad_x))
+    x = jnp.pad(
+        x,
+        ((0, 0), (top, dst - params.new_h - top), (left, dst - params.new_w - left), (0, 0)),
+        constant_values=pad_value,
+    )
+    return x.astype(out_dtype), params
+
+
+def unletterbox_boxes(
+    boxes_xyxy: jnp.ndarray, params: LetterboxParams
+) -> jnp.ndarray:
+    """Map detector-output xyxy boxes (letterboxed px) back to source px."""
+    shift = jnp.asarray(
+        [params.pad_x, params.pad_y, params.pad_x, params.pad_y],
+        dtype=boxes_xyxy.dtype,
+    )
+    return (boxes_xyxy - shift) / params.scale

@@ -33,6 +33,8 @@ import time
 from typing import Optional
 
 from ..bus import FrameBus
+from ..bus.interface import KEY_KEYFRAME_ONLY_PREFIX, KEY_LAST_ACCESS_PREFIX
+from ..ingest.worker import KEY_STATUS_PREFIX
 from ..utils.logging import get_logger
 from ..utils.parsing import default_device_id, parse_rtmp_key
 from .models import PREFIX_RTSP_PROCESS, ProcessState, RTMPStreamStatus, StreamProcess
@@ -43,6 +45,28 @@ log = get_logger("serve.process_manager")
 LOG_TAIL_LINES = 100   # reference pulls last 100 container log lines (:296)
 SUPERVISE_INTERVAL_S = 1.0
 RESTART_BACKOFF_S = 1.0
+
+# preexec_fn runs between fork and exec: nothing there may take locks, so the
+# libc handle (and through it, prctl) must be resolved once at import time in
+# the parent — a dlopen in the forked child can deadlock on an allocator or
+# import lock held by another server thread at fork time.
+if sys.platform == "linux":
+    import ctypes
+
+    _LIBC_PRCTL = ctypes.CDLL("libc.so.6", use_errno=True).prctl
+else:  # pragma: no cover
+    _LIBC_PRCTL = None
+
+_PR_SET_PDEATHSIG = 1
+_SIGTERM = 15
+
+
+def _pdeathsig() -> None:
+    """Child dies with the server (the reference gets this from dockerd
+    owning the container lifecycle; a subprocess runner needs the kernel's
+    parent-death signal)."""
+    if _LIBC_PRCTL is not None:
+        _LIBC_PRCTL(_PR_SET_PDEATHSIG, _SIGTERM)
 
 
 class ProcessError(RuntimeError):
@@ -155,18 +179,6 @@ class ProcessManager:
             vep_shm_dir=self._shm_dir,
             PYTHONUNBUFFERED="1",
         )
-        def _pdeathsig() -> None:
-            # Workers must not outlive a crashed server (the reference gets
-            # this from dockerd owning the container lifecycle; a subprocess
-            # runner needs the kernel's parent-death signal).
-            try:
-                import ctypes
-
-                PR_SET_PDEATHSIG = 1
-                ctypes.CDLL("libc.so.6").prctl(PR_SET_PDEATHSIG, 15)  # SIGTERM
-            except Exception:
-                pass
-
         proc = subprocess.Popen(
             [self._python, "-m", "video_edge_ai_proxy_tpu.ingest.worker"],
             env=env,
@@ -200,9 +212,9 @@ class ProcessManager:
                     entry.proc.wait(timeout=5)
         self._storage.delete(PREFIX_RTSP_PROCESS, device_id)
         self._bus.drop_stream(device_id)
-        self._bus.kv_del("stream_status_" + device_id)
-        self._bus.hdel_all("last_access_time_" + device_id)
-        self._bus.kv_del("is_key_frame_only_" + device_id)
+        self._bus.kv_del(KEY_STATUS_PREFIX + device_id)
+        self._bus.hdel_all(KEY_LAST_ACCESS_PREFIX + device_id)
+        self._bus.kv_del(KEY_KEYFRAME_ONLY_PREFIX + device_id)
         log.info("stopped camera process %s", device_id)
 
     def stop_all(self) -> None:
@@ -269,7 +281,13 @@ class ProcessManager:
     # -- persistence / resume --
 
     def _persist(self, record: StreamProcess) -> None:
-        self._storage.put(PREFIX_RTSP_PROCESS, record.name, record.to_json())
+        # state/logs are runtime-only views attached by info(); persisting
+        # them would rewrite the log tail into the registry on every toggle
+        # and resurrect a previous boot's state as if current.
+        clean = StreamProcess.from_json(record.to_json())
+        clean.state = None
+        clean.logs = None
+        self._storage.put(PREFIX_RTSP_PROCESS, clean.name, clean.to_json())
 
     def resume(self) -> int:
         """Re-spawn all persisted cameras (boot-time registry resume,

@@ -52,7 +52,12 @@ async def _cors(request: web.Request, handler):
     if request.method == "OPTIONS":
         resp = web.Response(status=204)
     else:
-        resp = await handler(request)
+        try:
+            resp = await handler(request)
+        except web.HTTPException as exc:
+            # 404s and other raised statuses must carry CORS headers too, or
+            # browser clients see an opaque error instead of the status.
+            resp = exc
     resp.headers["Access-Control-Allow-Origin"] = "*"
     resp.headers["Access-Control-Allow-Methods"] = "*"
     resp.headers["Access-Control-Allow-Headers"] = "*"
