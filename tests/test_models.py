@@ -1,0 +1,133 @@
+"""Model zoo tests (CPU backend, tiny configs — SURVEY.md §4(d))."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu import models
+from video_edge_ai_proxy_tpu.models import registry
+from video_edge_ai_proxy_tpu.models.videomae import (
+    VideoMAEDecoder, masked_pretrain_loss, tiny_videomae_config, tubelet_pixels,
+)
+from video_edge_ai_proxy_tpu.models.yolov8 import (
+    YOLOv8, _anchor_points, decode_level, tiny_yolov8_config,
+)
+
+
+TINY = ["tiny_mobilenet_v2", "tiny_resnet", "tiny_vit", "tiny_videomae"]
+
+
+@pytest.mark.parametrize("name", TINY)
+def test_tiny_forward_shapes(name):
+    spec = registry.get(name)
+    model, params = spec.init_params(batch=2)
+    x = jnp.ones(spec.example_shape(2), jnp.bfloat16)
+    out = jax.jit(lambda p, x: model.apply(p, x))(params, x)
+    assert out.shape[0] == 2
+    assert out.ndim == 2
+    assert out.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_resnet_features_only():
+    spec = registry.get("tiny_resnet")
+    model, params = spec.init_params()
+    x = jnp.ones(spec.example_shape(2), jnp.bfloat16)
+    emb = jax.jit(functools.partial(model.apply, features_only=True))(params, x)
+    logits = jax.jit(model.apply)(params, x)
+    assert emb.shape == (2, 16 * 2 * 4)      # width 16, 2 stages, 4x expand
+    assert logits.shape == (2, 10)
+
+
+def test_yolo_decoded_output():
+    spec = registry.get("tiny_yolov8")
+    model, params = spec.init_params()
+    x = jnp.ones(spec.example_shape(2), jnp.bfloat16)
+    boxes, scores = jax.jit(lambda p, x: model.apply(p, x))(params, x)
+    s = spec.input_size
+    anchors = sum((s // st) ** 2 for st in (8, 16, 32))
+    assert boxes.shape == (2, anchors, 4)
+    assert scores.shape == (2, anchors, 4)   # tiny config: 4 classes
+    sc = np.asarray(scores)
+    assert sc.min() >= 0.0 and sc.max() <= 1.0
+    assert np.all(np.isfinite(np.asarray(boxes)))
+
+
+def test_yolo_raw_levels():
+    spec = registry.get("tiny_yolov8")
+    model, params = spec.init_params()
+    x = jnp.ones(spec.example_shape(1), jnp.bfloat16)
+    levels = jax.jit(functools.partial(model.apply, decode=False))(params, x)
+    assert len(levels) == 3
+    cfg = tiny_yolov8_config()
+    for (box, cls), stride in zip(levels, cfg.strides):
+        side = spec.input_size // stride
+        assert box.shape == (1, side, side, 4 * cfg.reg_max)
+        assert cls.shape == (1, side, side, cfg.num_classes)
+
+
+def test_anchor_points_centers():
+    pts = np.asarray(_anchor_points(2, 2, 8))
+    assert pts.tolist() == [[4, 4], [12, 4], [4, 12], [12, 12]]
+
+
+def test_dfl_decode_known_distances():
+    # Peaked logits at bin 2 for all 4 sides -> distance 2*stride each way.
+    b, h, w, reg_max, stride = 1, 2, 2, 16, 8
+    logits = np.full((b, h, w, 4 * reg_max), -1e9, np.float32)
+    logits[..., 2::reg_max] = 0.0  # bin 2 of each of the 4 ltrb groups
+    boxes = np.asarray(decode_level(jnp.asarray(logits), stride, reg_max))
+    # first cell center at (4, 4); dist 16 -> box (-12, -12, 20, 20)
+    np.testing.assert_allclose(boxes[0, 0], [-12, -12, 20, 20], atol=1e-4)
+
+
+def test_videomae_pretrain_loss_runs():
+    cfg = tiny_videomae_config()
+    model = models.VideoMAE(cfg)
+    decoder = VideoMAEDecoder(cfg)
+    rng = jax.random.PRNGKey(0)
+    clips = jnp.ones((2, cfg.num_frames, cfg.image_size, cfg.image_size, 3), jnp.bfloat16)
+    keep = jax.random.bernoulli(rng, 0.25, (2, cfg.num_tokens))
+    enc_init = functools.partial(model.init, method=models.VideoMAE.encode_visible)
+    enc_params = jax.jit(enc_init)(rng, clips, keep)
+    enc_apply = functools.partial(model.apply, method=models.VideoMAE.encode_visible)
+    tokens = jax.jit(enc_apply)(enc_params, clips, keep)
+    dec_params = jax.jit(decoder.init)(rng, tokens)
+    loss = jax.jit(functools.partial(masked_pretrain_loss, model, decoder))(
+        {"encoder": enc_params, "decoder": dec_params}, clips, keep
+    )
+    assert np.isfinite(float(loss))
+
+
+def test_tubelet_pixels_roundtrip_shape():
+    cfg = tiny_videomae_config()
+    clips = jnp.arange(
+        2 * cfg.num_frames * cfg.image_size * cfg.image_size * 3, dtype=jnp.float32
+    ).reshape(2, cfg.num_frames, cfg.image_size, cfg.image_size, 3)
+    t = tubelet_pixels(clips, cfg)
+    assert t.shape == (2, cfg.num_tokens, cfg.pixels_per_token)
+    # first token = first tubelet (frames 0-1, patch (0,0))
+    manual = np.asarray(clips[0, 0:2, 0:8, 0:8, :]).reshape(-1)
+    np.testing.assert_allclose(np.asarray(t[0, 0]), manual)
+
+
+def test_registry_complete():
+    for required in ["mobilenet_v2", "yolov8n", "resnet50", "vit_b16", "videomae_b"]:
+        spec = registry.get(required)
+        assert spec.input_size > 0
+
+
+def test_batchnorm_train_mode_mutates_stats():
+    spec = registry.get("tiny_mobilenet_v2")
+    model, params = spec.init_params()
+    x = jax.random.normal(jax.random.PRNGKey(1), spec.example_shape(4), jnp.float32)
+    out, updates = jax.jit(
+        functools.partial(model.apply, train=True, mutable=["batch_stats"])
+    )(params, x)
+    assert out.shape == (4, 10)
+    before = jax.tree_util.tree_leaves(params["batch_stats"])
+    after = jax.tree_util.tree_leaves(updates["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))

@@ -1,0 +1,125 @@
+"""Shared transformer encoder for the ViT family (ViT-B/16, VideoMAE).
+
+TPU-first choices:
+- Weights carry flax *logical axis names* (`nn.with_logical_partitioning`)
+  so `parallel/sharding.py` can map them onto a device mesh (tp over
+  "heads"/"mlp", fsdp over "embed") without touching model code.
+- Attention is a pluggable function: the default is plain fused softmax
+  attention (XLA fuses it fine at these sizes); `parallel/ring_attention.py`
+  drops in a sequence-parallel implementation for long token counts by
+  passing `attn_fn`.
+- Optional `remat` wraps each block in `jax.checkpoint` to trade FLOPs for
+  HBM during fine-tuning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .common import Dtype
+
+# attn_fn(q, k, v) -> out, all [B, T, H, D]
+AttnFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    num_layers: int = 12
+    dim: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout: float = 0.0
+    remat: bool = False
+
+
+def default_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Plain softmax attention over [B, T, H, D]; fp32 softmax for stability."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _dense(features, logical_axes, dtype, name):
+    return nn.Dense(
+        features,
+        dtype=dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.xavier_uniform(), logical_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (logical_axes[-1],)
+        ),
+        name=name,
+    )
+
+
+class SelfAttention(nn.Module):
+    cfg: EncoderConfig
+    dtype: Dtype = jnp.bfloat16
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        c = self.cfg
+        head_dim = c.dim // c.num_heads
+        b, t, _ = x.shape
+        qkv = _dense(3 * c.dim, ("embed", "qkv"), self.dtype, "qkv")(x)
+        qkv = qkv.reshape(b, t, 3, c.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = (self.attn_fn or default_attention)(q, k, v)
+        attn = attn.reshape(b, t, c.dim)
+        return _dense(c.dim, ("qkv", "embed"), self.dtype, "out")(attn)
+
+
+class Mlp(nn.Module):
+    cfg: EncoderConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        c = self.cfg
+        h = _dense(c.mlp_dim, ("embed", "mlp"), self.dtype, "fc1")(x)
+        h = nn.gelu(h)
+        if c.dropout:
+            h = nn.Dropout(c.dropout)(h, deterministic=deterministic)
+        return _dense(c.dim, ("mlp", "embed"), self.dtype, "fc2")(h)
+
+
+class EncoderBlock(nn.Module):
+    cfg: EncoderConfig
+    dtype: Dtype = jnp.bfloat16
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        c = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x.astype(jnp.float32)).astype(self.dtype)
+        x = x + SelfAttention(c, self.dtype, self.attn_fn, name="attn")(h, deterministic)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x.astype(jnp.float32)).astype(self.dtype)
+        x = x + Mlp(c, self.dtype, name="mlp")(h, deterministic)
+        return x
+
+
+class Encoder(nn.Module):
+    cfg: EncoderConfig
+    dtype: Dtype = jnp.bfloat16
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        block = EncoderBlock
+        if self.cfg.remat:
+            block = nn.remat(EncoderBlock, static_argnums=(2,))
+        for i in range(self.cfg.num_layers):
+            x = block(self.cfg, self.dtype, self.attn_fn, name=f"block{i}")(
+                x, deterministic
+            )
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_final")(
+            x.astype(jnp.float32)
+        ).astype(self.dtype)
