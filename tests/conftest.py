@@ -8,7 +8,15 @@ without hardware. Env must be set before jax is first imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment presets JAX_PLATFORMS (e.g. the
+# axon TPU tunnel), and tests must run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# The TPU tunnel's sitecustomize imports jax at interpreter start, so the
+# env var above may already have been captured — override the live config
+# too (backends are not initialized until first use, so this still wins).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,11 +24,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 # Persistent XLA compile cache: model-sized programs cost ~1s+ each to
-# compile on this host; cache them across test runs.
+# compile on this host; cache them across test runs. jax is already
+# imported (see above), so env vars are too late — use config updates.
 _cache = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                       ".jax_cache")
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+jax.config.update("jax_compilation_cache_dir", _cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -35,6 +35,10 @@ class EncoderConfig:
     mlp_dim: int = 3072
     dropout: float = 0.0
     remat: bool = False
+    # >0 replaces the dense MLP with a mixture-of-experts MLP whose expert
+    # axis carries the "expert" logical name (sharded over the mesh's ep
+    # axis by parallel/sharding.py rules).
+    num_experts: int = 0
 
 
 def default_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
@@ -91,6 +95,51 @@ class Mlp(nn.Module):
         return _dense(c.dim, ("mlp", "embed"), self.dtype, "fc2")(h)
 
 
+class MoeMlp(nn.Module):
+    """Soft mixture-of-experts MLP (expert-parallel demonstration path).
+
+    All experts run on all tokens and are mixed by softmax gates — fully
+    static shapes, no capacity/dropping logic, exact gradients. The expert
+    dimension is sharded over the ``ep`` mesh axis via the "expert" logical
+    name; XLA turns the mixing contraction into a psum over ep. Top-k
+    routing with capacity buckets is the scale-out path once expert counts
+    grow past what dense mixing affords.
+    """
+
+    cfg: EncoderConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        c = self.cfg
+        e = c.num_experts
+        gates = jax.nn.softmax(
+            _dense(e, ("embed", "expert_gate"), jnp.float32, "gate")(
+                x.astype(jnp.float32)
+            ),
+            axis=-1,
+        )                                                      # [B, T, E]
+        w1 = self.param(
+            "w1",
+            nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), ("expert", "embed", "mlp")
+            ),
+            (e, c.dim, c.mlp_dim), jnp.float32,
+        ).astype(self.dtype)
+        w2 = self.param(
+            "w2",
+            nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), ("expert", "mlp", "embed")
+            ),
+            (e, c.mlp_dim, c.dim), jnp.float32,
+        ).astype(self.dtype)
+        h = nn.gelu(jnp.einsum("btd,edm->betm", x, w1))
+        if c.dropout:
+            h = nn.Dropout(c.dropout)(h, deterministic=deterministic)
+        y = jnp.einsum("betm,emd->betd", h, w2)
+        return jnp.einsum("bte,betd->btd", gates.astype(self.dtype), y)
+
+
 class EncoderBlock(nn.Module):
     cfg: EncoderConfig
     dtype: Dtype = jnp.bfloat16
@@ -102,7 +151,8 @@ class EncoderBlock(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x.astype(jnp.float32)).astype(self.dtype)
         x = x + SelfAttention(c, self.dtype, self.attn_fn, name="attn")(h, deterministic)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x.astype(jnp.float32)).astype(self.dtype)
-        x = x + Mlp(c, self.dtype, name="mlp")(h, deterministic)
+        mlp_cls = MoeMlp if c.num_experts else Mlp
+        x = x + mlp_cls(c, self.dtype, name="mlp")(h, deterministic)
         return x
 
 

@@ -9,11 +9,13 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from typing import Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
 
 from .common import Dtype
-from .transformer import Encoder, EncoderConfig
+from .transformer import AttnFn, Encoder, EncoderConfig
 
 
 @dataclass(frozen=True)
@@ -40,6 +42,7 @@ def tiny_vit_config(num_classes: int = 10) -> ViTConfig:
 class ViT(nn.Module):
     cfg: ViTConfig
     dtype: Dtype = jnp.bfloat16
+    attn_fn: Optional[AttnFn] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -63,5 +66,7 @@ class ViT(nn.Module):
             jnp.float32,
         )
         x = x + pos.astype(self.dtype)
-        x = Encoder(c.encoder, self.dtype, name="encoder")(x, deterministic=not train)
+        x = Encoder(c.encoder, self.dtype, self.attn_fn, name="encoder")(
+            x, deterministic=not train
+        )
         return nn.Dense(c.num_classes, dtype=jnp.float32, name="classifier")(x[:, 0])

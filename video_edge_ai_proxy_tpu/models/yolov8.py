@@ -22,6 +22,7 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops.boxes import dist_to_bbox
 from .common import ConvBN, Dtype, make_divisible, round_depth
 
 
@@ -161,10 +162,7 @@ def decode_level(box_logits, stride: int, reg_max: int):
     probs = nn.softmax(logits, axis=-1)
     bins = jnp.arange(reg_max, dtype=jnp.float32)
     dist = jnp.einsum("bafr,r->baf", probs, bins) * stride   # ltrb, px
-    anchors = _anchor_points(h, w, stride)[None]             # [1, hw, 2]
-    x1y1 = anchors - dist[..., :2]
-    x2y2 = anchors + dist[..., 2:]
-    return jnp.concatenate([x1y1, x2y2], axis=-1)
+    return dist_to_bbox(dist, _anchor_points(h, w, stride))
 
 
 class YOLOv8(nn.Module):
