@@ -177,3 +177,33 @@ class TestNMS:
         _, _, _, val = batched_nms(boxes, scores, max_candidates=32, max_det=32)
         # near-duplicates suppressed: at most one survivor per base box
         assert int(val.sum()) <= 16
+
+
+class TestMXUResize:
+    def test_matches_jax_image_resize(self):
+        """The matmul-form resize must match jax.image.resize (bilinear,
+        antialiased) — same linear map, different execution strategy."""
+        import jax
+        from video_edge_ai_proxy_tpu.ops.preprocess import resize_bilinear_mxu
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.random((2, 48, 64, 3), np.float32))
+        got = resize_bilinear_mxu(x, (16, 32))
+        want = jax.image.resize(x, (2, 16, 32, 3), method="bilinear")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_upscale_matches_too(self):
+        import jax
+        from video_edge_ai_proxy_tpu.ops.preprocess import resize_bilinear_mxu
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.random((1, 8, 8, 3), np.float32))
+        got = resize_bilinear_mxu(x, (24, 16))
+        want = jax.image.resize(x, (1, 24, 16, 3), method="bilinear")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_identity_passthrough(self):
+        from video_edge_ai_proxy_tpu.ops.preprocess import resize_bilinear_mxu
+
+        x = jnp.ones((1, 8, 8, 3))
+        assert resize_bilinear_mxu(x, (8, 8)) is x

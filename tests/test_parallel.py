@@ -1,6 +1,6 @@
 """parallel/ tests on the 8-virtual-device CPU mesh (SURVEY.md §4(d))."""
 
-import functools
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -103,14 +103,12 @@ def test_sharded_train_step_loss_decreases():
 def test_moe_expert_parallel_train():
     """MoE encoder trains with experts sharded over ep."""
     mesh = parallel.make_mesh(dp=2, ep=4, devices=jax.devices())
-    cfg = tiny_videomae_config(num_classes=3)
-    cfg = type(cfg)(**{
-        **{f.name: getattr(cfg, f.name) for f in
-           __import__("dataclasses").fields(cfg)},
-        "encoder": EncoderConfig(
+    cfg = dataclasses.replace(
+        tiny_videomae_config(num_classes=3),
+        encoder=EncoderConfig(
             num_layers=1, dim=32, num_heads=2, mlp_dim=64, num_experts=4
         ),
-    })
+    )
     model = VideoMAE(cfg)
     trainer = parallel.make_trainer(model, mesh, learning_rate=1e-3)
     rng = jax.random.PRNGKey(0)

@@ -1,0 +1,353 @@
+"""TPU inference engine: the plane the reference doesn't have.
+
+The reference ships raw BGR24 frames to external CPU clients and calls it a
+day (`/root/reference/README.md:5-27`); results only re-enter the system if
+the client pushes `Annotate` events. This engine closes that loop on-device
+(BASELINE.json north star): collector output crosses PCIe as uint8, and one
+jitted program per (bucket, source-geometry) does preprocess → forward →
+postprocess (Pallas NMS for detectors) on the TPU. Results fan out to
+
+- gRPC `Inference` subscribers (serve/grpc_api.py), and
+- the annotation uplink queue, as the same `AnnotateRequest` protos an
+  external ML client would have sent — so the reference's cloud pipeline
+  (`examples/annotation.py` shape) keeps working with zero client code.
+
+Latency pipeline: JAX dispatch is async — each tick submits the new batch
+before draining the previous one, so H2D/compute/D2H overlap across ticks
+(double buffering, SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..bus.interface import FrameBus, FrameMeta
+from ..ops.nms import batched_nms
+from ..ops.preprocess import (
+    preprocess_classify, preprocess_clip, preprocess_letterbox,
+    unletterbox_boxes,
+)
+from ..proto import pb
+from ..utils.config import EngineConfig
+from ..utils.logging import get_logger
+from .classes import class_name
+from .collector import BatchGroup, Collector
+
+log = get_logger("engine.runner")
+
+TOP_K_CLASSES = 5
+
+
+@dataclass
+class StreamStats:
+    frames: int = 0
+    last_latency_ms: float = 0.0
+    ema_latency_ms: float = 0.0
+    last_batch: int = 0
+
+
+@dataclass
+class _Inflight:
+    """A dispatched (not yet drained) device batch."""
+
+    group: BatchGroup
+    outputs: Any              # tree of jax.Arrays (async)
+    t_submit: float
+
+
+class InferenceEngine:
+    """Owns the model, the compiled step cache, and the engine thread."""
+
+    def __init__(
+        self,
+        bus: FrameBus,
+        cfg: Optional[EngineConfig] = None,
+        *,
+        annotations=None,                    # AnnotationQueue or None
+        spec=None,                           # ModelSpec override (tests)
+    ):
+        self._bus = bus
+        self._cfg = cfg or EngineConfig()
+        self._annotations = annotations
+        self._spec = spec
+        self._model = None
+        self._variables = None
+        self._step_cache: Dict[tuple, Any] = {}
+        self._collector: Optional[Collector] = None
+        self._subscribers: List[tuple] = []   # (queue, device_id filter set|None)
+        self._sub_lock = threading.Lock()
+        self._stats: Dict[str, StreamStats] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.ticks = 0
+        self.batches = 0
+
+    # -- lifecycle --
+
+    def warmup(self) -> None:
+        """Build model + params and compile nothing yet (steps compile per
+        observed shape; call `compile_for` to prewarm a given geometry)."""
+        import jax
+
+        from ..models import registry
+
+        if self._spec is None:
+            self._spec = registry.get(self._cfg.model)
+        self._model, self._variables = self._spec.init_params(
+            jax.random.PRNGKey(0)
+        )
+        self._collector = Collector(
+            self._bus,
+            buckets=self._cfg.batch_buckets,
+            clip_len=self._spec.clip_len,
+            active_window_s=self._cfg.active_window_s,
+        )
+        log.info(
+            "engine ready: model=%s kind=%s input=%d backend=%s",
+            self._spec.name, self._spec.kind, self._spec.input_size,
+            jax.default_backend(),
+        )
+
+    def start(self) -> None:
+        if self._model is None:
+            self.warmup()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        with self._sub_lock:
+            for q, _ in self._subscribers:
+                q.put(None)
+            self._subscribers.clear()
+
+    # -- results fan-out --
+
+    def subscribe(self, device_ids=None, context=None, timeout: float = 0.5):
+        """Blocking iterator of pb.InferenceResult for gRPC serving."""
+        q: queue.Queue = queue.Queue(maxsize=256)
+        ids = set(device_ids) if device_ids else None
+        with self._sub_lock:
+            self._subscribers.append((q, ids))
+        try:
+            while not self._stop.is_set():
+                if context is not None and not context.is_active():
+                    return
+                try:
+                    item = q.get(timeout=timeout)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    return
+                yield item
+        finally:
+            with self._sub_lock:
+                self._subscribers = [
+                    (sq, si) for sq, si in self._subscribers if sq is not q
+                ]
+
+    def stats(self) -> Dict[str, StreamStats]:
+        return dict(self._stats)
+
+    # -- compiled step construction --
+
+    def compile_for(self, src_hw: tuple, bucket: int) -> None:
+        """Prewarm the program for one (source geometry, bucket)."""
+        shape = (bucket,) + (
+            (self._spec.clip_len,) if self._spec.clip_len else ()
+        ) + tuple(src_hw) + (3,)
+        self._step(src_hw, bucket)(
+            self._variables, np.zeros(shape, np.uint8)
+        )
+
+    def _step(self, src_hw: tuple, bucket: int):
+        key = (src_hw, bucket)
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = self._build_step()
+            self._step_cache[key] = fn
+        return fn
+
+    def _build_step(self):
+        import jax
+
+        model, spec = self._model, self._spec
+        size = spec.input_size
+
+        if spec.kind == "detect":
+            def raw(variables, frames_u8):
+                x, lb = preprocess_letterbox(frames_u8, size)
+                boxes, scores = model.apply(variables, x)
+                cls_scores = scores.max(axis=-1)
+                cls_ids = scores.argmax(axis=-1).astype("int32")
+                b, s, c, valid = batched_nms(boxes, cls_scores, cls_ids)
+                b = unletterbox_boxes(b, lb)
+                return {"boxes": b, "scores": s, "classes": c, "valid": valid}
+        elif spec.kind == "embed":
+            def raw(variables, frames_u8):
+                x = preprocess_classify(frames_u8, (size, size))
+                emb = model.apply(variables, x, features_only=True)
+                return {"embedding": emb}
+        else:  # classify | video
+            pre = preprocess_clip if spec.clip_len else preprocess_classify
+
+            def raw(variables, frames_u8):
+                import jax.numpy as jnp
+
+                x = pre(frames_u8, (size, size))
+                logits = model.apply(variables, x)
+                probs = jax.nn.softmax(logits, axis=-1)
+                top_p, top_i = jax.lax.top_k(
+                    probs, min(TOP_K_CLASSES, probs.shape[-1])
+                )
+                return {"top_probs": top_p, "top_ids": top_i.astype(jnp.int32)}
+
+        return jax.jit(raw)
+
+    # -- engine loop --
+
+    def _run(self) -> None:
+        tick_s = self._cfg.tick_ms / 1000.0
+        inflight: Optional[_Inflight] = None
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            # The loop must outlive any single bad batch: a dead engine
+            # thread would leave subscribers blocked forever (same
+            # log-and-keep-going stance as the reference's worker loops,
+            # rtsp_to_rtmp.py:186-187).
+            try:
+                self._collector.keep_streams_hot()
+                groups = self._collector.collect()
+                submitted: List[_Inflight] = []
+                for group in groups:
+                    step = self._step(group.src_hw, group.bucket)
+                    outputs = step(self._variables, group.frames)  # async dispatch
+                    submitted.append(_Inflight(group, outputs, time.time()))
+                    self.batches += 1
+                # Drain the PREVIOUS tick's work while this tick's runs.
+                if inflight is not None:
+                    self._emit(inflight)
+                for extra in submitted[:-1]:
+                    self._emit(extra)
+                inflight = submitted[-1] if submitted else None
+            except Exception:
+                log.exception("engine tick failed; continuing")
+                inflight = None
+            self.ticks += 1
+            elapsed = time.monotonic() - t0
+            if elapsed < tick_s:
+                self._stop.wait(tick_s - elapsed)
+        if inflight is not None:
+            try:
+                self._emit(inflight)
+            except Exception:
+                log.exception("final drain failed")
+
+    # -- result emission --
+
+    def _emit(self, inflight: _Inflight) -> None:
+        group = inflight.group
+        host = {k: np.asarray(v) for k, v in inflight.outputs.items()}  # D2H
+        now_ms = int(time.time() * 1000)
+        for i, device_id in enumerate(group.device_ids):
+            meta = group.metas[i]
+            detections = self._to_detections(host, i)
+            latency = max(0.0, now_ms - meta.timestamp_ms) if meta.timestamp_ms else 0.0
+            result = pb.InferenceResult(
+                device_id=device_id,
+                timestamp=meta.timestamp_ms,
+                model=self._spec.name,
+                model_version="0",
+                detections=detections,
+                latency_ms=latency,
+                batch_size=group.bucket,
+                frame_packet=meta.packet,
+            )
+            self._publish(result)
+            self._annotate(device_id, meta, detections)
+            st = self._stats.setdefault(device_id, StreamStats())
+            st.frames += 1
+            st.last_latency_ms = latency
+            st.ema_latency_ms = (
+                latency if st.ema_latency_ms == 0.0
+                else 0.9 * st.ema_latency_ms + 0.1 * latency
+            )
+            st.last_batch = group.bucket
+
+    def _to_detections(self, host: dict, i: int) -> List[pb.Detection]:
+        spec = self._spec
+        out: List[pb.Detection] = []
+        if spec.kind == "detect":
+            valid = host["valid"][i]
+            for j in np.nonzero(valid)[0]:
+                # BoundingBox carries int32 pixel coords (proto parity with
+                # the reference's AnnotateRequest consumers).
+                x1, y1, x2, y2 = (int(round(float(v))) for v in host["boxes"][i, j])
+                cid = int(host["classes"][i, j])
+                out.append(pb.Detection(
+                    box=pb.BoundingBox(left=x1, top=y1, width=x2 - x1, height=y2 - y1),
+                    confidence=float(host["scores"][i, j]),
+                    class_id=cid,
+                    class_name=class_name(cid, self._num_classes()),
+                ))
+        elif spec.kind == "embed":
+            out.append(pb.Detection(
+                confidence=1.0, class_id=-1,
+                embedding=[float(v) for v in host["embedding"][i]],
+            ))
+        else:
+            for p, cid in zip(host["top_probs"][i], host["top_ids"][i]):
+                out.append(pb.Detection(
+                    confidence=float(p), class_id=int(cid),
+                    class_name=class_name(int(cid), self._num_classes()),
+                ))
+        return out
+
+    def _num_classes(self) -> int:
+        cfg = getattr(self._model, "cfg", None)
+        return getattr(cfg, "num_classes", 0) if cfg is not None else 0
+
+    def _publish(self, result: pb.InferenceResult) -> None:
+        with self._sub_lock:
+            subs = list(self._subscribers)
+        for q, ids in subs:
+            if ids is not None and result.device_id not in ids:
+                continue
+            try:
+                q.put_nowait(result)
+            except queue.Full:
+                pass  # slow subscriber: latest-wins spirit, drop
+
+    def _annotate(
+        self, device_id: str, meta: FrameMeta, detections: Sequence[pb.Detection]
+    ) -> None:
+        if self._annotations is None:
+            return
+        for det in detections:
+            if det.class_id < 0 or det.confidence <= 0.0:
+                continue
+            req = pb.AnnotateRequest(
+                device_name=device_id,
+                type="detection" if self._spec.kind == "detect" else self._spec.kind,
+                start_timestamp=meta.timestamp_ms or int(time.time() * 1000),
+                object_type=det.class_name,
+                confidence=det.confidence,
+                object_bouding_box=det.box if det.HasField("box") else None,
+                ml_model=self._spec.name,
+                ml_model_version="0",
+                width=meta.width,
+                height=meta.height,
+                is_keyframe=meta.is_keyframe,
+            )
+            self._annotations.publish(req.SerializeToString())

@@ -13,10 +13,12 @@ the numpy array client-side). Here it is a device op.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Standard ImageNet statistics (RGB order), used by every classifier in the
 # model zoo.
@@ -24,9 +26,48 @@ IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
 
 
-def _bgr_to_rgb_float(frames_u8: jnp.ndarray) -> jnp.ndarray:
-    """NHWC uint8 BGR -> float32 RGB in [0, 1]."""
-    return frames_u8[..., ::-1].astype(jnp.float32) * (1.0 / 255.0)
+@functools.lru_cache(maxsize=64)
+def _resize_matrix(src: int, dst: int) -> np.ndarray:
+    """[dst, src] bilinear resize matrix (antialiased triangle filter for
+    downscaling, matching jax.image.resize(method='bilinear') semantics:
+    half-pixel centers, per-row weight normalization)."""
+    scale = src / dst
+    s = max(1.0, scale)                 # antialias: widen kernel when shrinking
+    out = np.zeros((dst, src), np.float32)
+    for o in range(dst):
+        center = (o + 0.5) * scale - 0.5
+        lo = int(np.floor(center - s)) + 1
+        hi = int(np.ceil(center + s))
+        idx = np.arange(lo, hi + 1)
+        w = np.maximum(0.0, 1.0 - np.abs(idx - center) / s)
+        valid = (idx >= 0) & (idx < src)
+        idx, w = idx[valid], w[valid]
+        out[o, idx] = w / w.sum()
+    return out
+
+
+def resize_bilinear_mxu(x: jnp.ndarray, dst_hw: tuple[int, int]) -> jnp.ndarray:
+    """Separable bilinear resize as two dense matmuls.
+
+    [N, H, W, C] -> [N, h, w, C]. On TPU a gather-based image resize of
+    full-HD frames is HBM-layout-bound (~4.5 ms for 16x1080p); expressing
+    the same linear map as [h,H] and [w,W] contractions puts it on the MXU
+    (~2 ms measured, bounded by the u8->bf16 cast). Weights are trace-time
+    constants (lru-cached per geometry).
+    """
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        raise TypeError(
+            f"resize_bilinear_mxu needs a float input, got {x.dtype}; "
+            "scale uint8 frames first (frames.astype(...) / 255)"
+        )
+    h, w = x.shape[1], x.shape[2]
+    th, tw = dst_hw
+    if (h, w) == (th, tw):
+        return x
+    rh = jnp.asarray(_resize_matrix(h, th), x.dtype)
+    rw = jnp.asarray(_resize_matrix(w, tw), x.dtype)
+    y = jnp.einsum("hH,nHWc->nhWc", rh, x)
+    return jnp.einsum("wW,nhWc->nhwc", rw, y)
 
 
 def preprocess_classify(
@@ -41,12 +82,11 @@ def preprocess_classify(
     Resize is plain bilinear (stretch, no aspect preservation) — matching
     what CPU clients of the reference typically do before a classifier.
     """
-    x = _bgr_to_rgb_float(frames_u8)
-    n = x.shape[0]
-    x = jax.image.resize(x, (n, size[0], size[1], 3), method="bilinear")
+    x = frames_u8.astype(out_dtype) * (1.0 / 255.0)
+    x = resize_bilinear_mxu(x, size)[..., ::-1]          # BGR -> RGB, small
     mean_a = jnp.asarray(mean, dtype=jnp.float32)
-    std_a = jnp.asarray(std, dtype=jnp.float32)
-    x = (x - mean_a) / std_a
+    inv_std = jnp.asarray([1.0 / s for s in std], dtype=jnp.float32)
+    x = (x.astype(jnp.float32) - mean_a) * inv_std
     return x.astype(out_dtype)
 
 
@@ -105,9 +145,8 @@ def preprocess_letterbox(
     undo it on output boxes.
     """
     params = letterbox_params(frames_u8.shape[1:3], dst)
-    x = _bgr_to_rgb_float(frames_u8)
-    n = x.shape[0]
-    x = jax.image.resize(x, (n, params.new_h, params.new_w, 3), method="bilinear")
+    x = frames_u8.astype(out_dtype) * (1.0 / 255.0)
+    x = resize_bilinear_mxu(x, (params.new_h, params.new_w))[..., ::-1]
     top = int(round(params.pad_y))
     left = int(round(params.pad_x))
     x = jnp.pad(
