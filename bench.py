@@ -38,11 +38,8 @@ ITERS = 50
 
 
 def main() -> None:
+    from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
     from video_edge_ai_proxy_tpu.models import registry
-    from video_edge_ai_proxy_tpu.ops.nms import batched_nms
-    from video_edge_ai_proxy_tpu.ops.preprocess import (
-        preprocess_letterbox, unletterbox_boxes,
-    )
 
     backend = jax.default_backend()
     streams = STREAMS if backend == "tpu" else 2
@@ -51,14 +48,12 @@ def main() -> None:
 
     spec = registry.get("yolov8n")
     model, variables = spec.init_params(jax.random.PRNGKey(0))
+    # The exact program the engine serves (single source of truth).
+    serving_step = build_serving_step(model, spec)
 
     def one_batch(frames_u8):
-        x, lb = preprocess_letterbox(frames_u8, spec.input_size)
-        boxes, scores = model.apply(variables, x)
-        cls_scores = scores.max(axis=-1)
-        cls_ids = scores.argmax(axis=-1).astype(jnp.int32)
-        b, s, c, valid = batched_nms(boxes, cls_scores, cls_ids)
-        return unletterbox_boxes(b, lb), s, c, valid
+        out = serving_step(variables, frames_u8)
+        return out["boxes"], out["scores"], out["classes"], out["valid"]
 
     @jax.jit
     def megastep(base_u8):

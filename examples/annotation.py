@@ -1,0 +1,43 @@
+"""Push an ML annotation event into the async cloud uplink.
+
+Parity with `/root/reference/examples/annotation.py`: requires edge
+credentials to be set (REST `/api/v1/settings`), acks on enqueue, batches
+to the cloud in the background.
+
+    python examples/annotation.py --device cam1 --type moving
+"""
+
+import argparse
+import sys
+import time
+
+import grpc
+
+sys.path.insert(0, ".")
+from video_edge_ai_proxy_tpu.proto import pb, pb_grpc  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--device", type=str, required=True)
+    parser.add_argument("--type", type=str, default="moving")
+    parser.add_argument("--host", type=str, default="127.0.0.1:50001")
+    args = parser.parse_args()
+    stub = pb_grpc.ImageStub(grpc.insecure_channel(args.host))
+    req = pb.AnnotateRequest(
+        device_name=args.device,
+        type=args.type,
+        start_timestamp=int(time.time() * 1000),
+        confidence=0.9,
+        ml_model="example",
+        ml_model_version="1",
+    )
+    try:
+        resp = stub.Annotate(req)
+        print("queued:", resp)
+    except grpc.RpcError as err:
+        print("annotate failed:", err.code(), err.details())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""List streams / pull latest frames over gRPC.
+
+Mirrors the reference client surface (`/root/reference/examples/
+basic_usage.py`): `--list` prints every registered stream's health record;
+`--device <name>` loops over `VideoLatestImage`, reconnecting on the
+server's stream deadline exactly as reference clients must.
+
+    python examples/basic_usage.py --list
+    python examples/basic_usage.py --device cam1
+"""
+
+import argparse
+import sys
+
+import grpc
+
+sys.path.insert(0, ".")
+from video_edge_ai_proxy_tpu.proto import pb, pb_grpc  # noqa: E402
+
+
+def list_streams(stub):
+    for stream in stub.ListStreams(pb.ListStreamRequest()):
+        print(stream)
+
+
+def frame_requests(device_id: str, keyframe_only: bool):
+    while True:
+        yield pb.VideoFrameRequest(device_id=device_id, key_frame_only=keyframe_only)
+
+
+def watch(stub, device_id: str, keyframe_only: bool):
+    while True:
+        try:
+            for frame in stub.VideoLatestImage(
+                frame_requests(device_id, keyframe_only)
+            ):
+                if not frame.width:
+                    continue
+                print(
+                    f"{device_id}: {frame.width}x{frame.height} "
+                    f"keyframe={frame.is_keyframe} pts={frame.pts} "
+                    f"packet={frame.packet}"
+                )
+        except grpc.RpcError as err:
+            if err.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                continue   # 15 s stream deadline: reconnect (by design)
+            raise
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="basic usage example")
+    parser.add_argument("--list", action="store_true")
+    parser.add_argument("--device", type=str, default=None)
+    parser.add_argument("--keyframe_only", action="store_true")
+    parser.add_argument("--host", type=str, default="127.0.0.1:50001")
+    args = parser.parse_args()
+
+    stub = pb_grpc.ImageStub(grpc.insecure_channel(args.host))
+    if args.list:
+        list_streams(stub)
+    if args.device:
+        watch(stub, args.device, args.keyframe_only)

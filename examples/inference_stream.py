@@ -1,0 +1,40 @@
+"""Stream TPU inference results (no reference counterpart — the reference
+ships raw frames out and leaves inference to the client; here detection
+runs on-device and clients consume results).
+
+    python examples/inference_stream.py            # all streams
+    python examples/inference_stream.py --device cam1
+"""
+
+import argparse
+import sys
+
+import grpc
+
+sys.path.insert(0, ".")
+from video_edge_ai_proxy_tpu.proto import pb, pb_grpc  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--device", type=str, default=None, action="append")
+    parser.add_argument("--host", type=str, default="127.0.0.1:50001")
+    args = parser.parse_args()
+    stub = pb_grpc.ImageStub(grpc.insecure_channel(args.host))
+    req = pb.InferenceRequest(device_ids=[d for d in (args.device or []) if d])
+    try:
+        for result in stub.Inference(req):
+            dets = ", ".join(
+                f"{d.class_name}:{d.confidence:.2f}" for d in result.detections[:5]
+            )
+            print(
+                f"{result.device_id} model={result.model} "
+                f"batch={result.batch_size} latency={result.latency_ms:.1f}ms "
+                f"[{dets}]"
+            )
+    except grpc.RpcError as err:
+        print("inference stream ended:", err.code(), err.details())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Rebuild numpy frames from the wire format and (optionally) display them.
+
+Parity with `/root/reference/examples/opencv_display.py:46-53`: the frame
+arrives as raw BGR24 bytes plus a ShapeProto; the client reshapes. Without
+a display (or cv2), prints frame stats instead.
+
+    python examples/opencv_display.py --device cam1
+"""
+
+import argparse
+import sys
+
+import grpc
+import numpy as np
+
+sys.path.insert(0, ".")
+from video_edge_ai_proxy_tpu.proto import pb, pb_grpc  # noqa: E402
+
+try:
+    import cv2
+    HAVE_CV2 = True
+except Exception:
+    HAVE_CV2 = False
+
+
+def frame_requests(device_id):
+    while True:
+        yield pb.VideoFrameRequest(device_id=device_id)
+
+
+def to_ndarray(frame) -> np.ndarray:
+    dims = [d.size for d in frame.shape.dim]
+    return np.frombuffer(frame.data, np.uint8).reshape(dims)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--device", type=str, required=True)
+    parser.add_argument("--host", type=str, default="127.0.0.1:50001")
+    args = parser.parse_args()
+    stub = pb_grpc.ImageStub(grpc.insecure_channel(args.host))
+    while True:
+        try:
+            for frame in stub.VideoLatestImage(frame_requests(args.device)):
+                if not frame.width:
+                    continue
+                img = to_ndarray(frame)
+                if HAVE_CV2:
+                    cv2.imshow(args.device, img)
+                    if cv2.waitKey(1) & 0xFF == ord("q"):
+                        return
+                else:
+                    print(
+                        f"frame {img.shape} mean={img.mean():.1f} "
+                        f"keyframe={frame.is_keyframe}"
+                    )
+        except grpc.RpcError as err:
+            if err.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                continue
+            raise
+
+
+if __name__ == "__main__":
+    main()

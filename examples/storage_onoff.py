@@ -1,0 +1,43 @@
+"""Toggle cloud storage / RTMP proxy for a stream.
+
+Parity with `/root/reference/examples/storage_onoff.py` (Storage rpc) plus
+the Proxy rpc toggle the reference exposes separately.
+
+    python examples/storage_onoff.py --device cam1 --on true
+    python examples/storage_onoff.py --device cam1 --proxy --on false
+"""
+
+import argparse
+import sys
+
+import grpc
+
+sys.path.insert(0, ".")
+from video_edge_ai_proxy_tpu.proto import pb, pb_grpc  # noqa: E402
+
+
+def str2bool(v: str) -> bool:
+    return str(v).lower() in ("yes", "true", "t", "y", "1")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--device", type=str, required=True)
+    parser.add_argument("--on", type=str2bool, default=True)
+    parser.add_argument("--proxy", action="store_true",
+                        help="toggle RTMP pass-through instead of storage")
+    parser.add_argument("--host", type=str, default="127.0.0.1:50001")
+    args = parser.parse_args()
+    stub = pb_grpc.ImageStub(grpc.insecure_channel(args.host))
+    try:
+        if args.proxy:
+            resp = stub.Proxy(pb.ProxyRequest(device_id=args.device, passthrough=args.on))
+        else:
+            resp = stub.Storage(pb.StorageRequest(device_id=args.device, start=args.on))
+        print(resp)
+    except grpc.RpcError as err:
+        print("toggle failed:", err.code(), err.details())
+
+
+if __name__ == "__main__":
+    main()

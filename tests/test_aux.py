@@ -1,0 +1,136 @@
+"""Auxiliary subsystems: stats endpoint, portal serving, checkpointing,
+examples syntax (SURVEY.md §5 — the rebuild must not inherit the
+reference's near-zero aux test coverage)."""
+
+import os
+import pathlib
+import py_compile
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+from video_edge_ai_proxy_tpu.engine import InferenceEngine
+from video_edge_ai_proxy_tpu.utils.checkpoint import (
+    load_msgpack, load_train_state, save_msgpack, save_train_state,
+)
+from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+class TestCheckpoint:
+    def test_msgpack_roundtrip(self, tmp_path):
+        tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+                "b": {"c": np.ones((4,), np.int32)}}
+        path = str(tmp_path / "ck" / "params.msgpack")
+        save_msgpack(path, tree)
+        out = load_msgpack(path, jax.tree.map(np.zeros_like, tree))
+        np.testing.assert_array_equal(out["a"], tree["a"])
+        np.testing.assert_array_equal(out["b"]["c"], tree["b"]["c"])
+
+    def test_engine_checkpoint_roundtrip(self, tmp_path):
+        ckpt = str(tmp_path / "eng.msgpack")
+        bus = MemoryFrameBus()
+        eng = InferenceEngine(
+            bus, EngineConfig(model="tiny_mobilenet_v2", checkpoint_path=ckpt)
+        )
+        eng.warmup()
+        eng.save_checkpoint()
+        assert os.path.exists(ckpt)
+        # Second engine restores identical params
+        eng2 = InferenceEngine(
+            bus, EngineConfig(model="tiny_mobilenet_v2", checkpoint_path=ckpt)
+        )
+        eng2.warmup()
+        a = jax.tree_util.tree_leaves(eng._variables)
+        b = jax.tree_util.tree_leaves(eng2._variables)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        bus.close()
+
+    def test_orbax_train_state_roundtrip(self, tmp_path):
+        from video_edge_ai_proxy_tpu import parallel
+        from video_edge_ai_proxy_tpu.models.vit import ViT, tiny_vit_config
+        import jax.numpy as jnp
+
+        mesh = parallel.make_mesh(dp=2, tp=4, devices=jax.devices())
+        model = ViT(tiny_vit_config(num_classes=4))
+        trainer = parallel.make_trainer(model, mesh)
+        rng = jax.random.PRNGKey(0)
+        x = jnp.ones((2, 32, 32, 3), jnp.float32)
+        with mesh:
+            state = trainer.init_state(rng, x)
+            path = save_train_state(str(tmp_path / "ck"), state)
+            abstract = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
+                state,
+            )
+            restored = load_train_state(path, abstract)
+        for got, want in zip(
+            jax.tree_util.tree_leaves(restored), jax.tree_util.tree_leaves(state)
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestRestAux:
+    @pytest.fixture()
+    def server(self, tmp_path, shm_dir):
+        from video_edge_ai_proxy_tpu.serve.process_manager import ProcessManager
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+        from video_edge_ai_proxy_tpu.serve.settings import SettingsManager
+        from video_edge_ai_proxy_tpu.serve.storage import Storage
+        from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+
+        storage = Storage(str(tmp_path / "db"))
+        bus = MemoryFrameBus()
+        pm = ProcessManager(storage, bus, shm_dir=shm_dir)
+        settings = SettingsManager(storage)
+        ann = AnnotationQueue(handler=lambda b: True)
+        eng = InferenceEngine(bus, EngineConfig(model="tiny_mobilenet_v2"))
+        eng.warmup()
+        rest = RestServer(pm, settings, port=0, engine=eng, annotations=ann)
+        rest.start()
+        yield rest
+        rest.stop()
+        pm.close()
+        bus.close()
+        storage.close()
+
+    def _get(self, server, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.bound_port}{path}", timeout=10
+        ) as resp:
+            return resp.status, resp.read()
+
+    def test_stats_endpoint(self, server):
+        import json
+
+        status, body = self._get(server, "/api/v1/stats")
+        assert status == 200
+        data = json.loads(body)
+        assert data["engine"]["model"] == "tiny_mobilenet_v2"
+        assert data["annotation_queue"]["depth"] == 0
+
+    def test_rtspscan_stub(self, server):
+        status, body = self._get(server, "/api/v1/rtspscan")
+        assert status == 200
+        assert body.strip() == b"[]"
+
+    def test_portal_served_at_root(self, server):
+        status, body = self._get(server, "/")
+        assert status == 200
+        assert b"video-edge-ai-proxy-tpu" in body
+        assert b"Connect RTSP camera" in body
+
+
+def test_examples_compile():
+    """Every example must at least be valid Python (full runs need a live
+    server; the serve tests cover the RPC surface)."""
+    examples = sorted((REPO / "examples").glob("*.py"))
+    assert len(examples) >= 5
+    for path in examples:
+        py_compile.compile(str(path), doraise=True)

@@ -20,6 +20,7 @@ before draining the previous one, so H2D/compute/D2H overlap across ticks
 from __future__ import annotations
 
 import functools
+import os
 import queue
 import threading
 import time
@@ -43,6 +44,46 @@ from .collector import BatchGroup, Collector
 log = get_logger("engine.runner")
 
 TOP_K_CLASSES = 5
+
+
+def build_serving_step(model, spec):
+    """The per-tick device program for one model kind: uint8 frames in,
+    postprocessed results out. SINGLE source of truth — the engine compiles
+    it per (geometry, bucket), bench.py times it, __graft_entry__ exposes
+    it, so all three always run the identical program."""
+    import jax
+
+    size = spec.input_size
+
+    if spec.kind == "detect":
+        def raw(variables, frames_u8):
+            x, lb = preprocess_letterbox(frames_u8, size)
+            boxes, scores = model.apply(variables, x)
+            cls_scores = scores.max(axis=-1)
+            cls_ids = scores.argmax(axis=-1).astype("int32")
+            b, s, c, valid = batched_nms(boxes, cls_scores, cls_ids)
+            b = unletterbox_boxes(b, lb)
+            return {"boxes": b, "scores": s, "classes": c, "valid": valid}
+    elif spec.kind == "embed":
+        def raw(variables, frames_u8):
+            x = preprocess_classify(frames_u8, (size, size))
+            emb = model.apply(variables, x, features_only=True)
+            return {"embedding": emb}
+    else:  # classify | video
+        pre = preprocess_clip if spec.clip_len else preprocess_classify
+
+        def raw(variables, frames_u8):
+            import jax.numpy as jnp
+
+            x = pre(frames_u8, (size, size))
+            logits = model.apply(variables, x)
+            probs = jax.nn.softmax(logits, axis=-1)
+            top_p, top_i = jax.lax.top_k(
+                probs, min(TOP_K_CLASSES, probs.shape[-1])
+            )
+            return {"top_probs": top_p, "top_ids": top_i.astype(jnp.int32)}
+
+    return raw
 
 
 @dataclass
@@ -103,6 +144,17 @@ class InferenceEngine:
         self._model, self._variables = self._spec.init_params(
             jax.random.PRNGKey(0)
         )
+        ckpt = self._cfg.checkpoint_path
+        if ckpt:
+            from ..utils.checkpoint import load_msgpack
+
+            if os.path.exists(ckpt):
+                self._variables = jax.device_put(
+                    load_msgpack(ckpt, jax.tree.map(np.asarray, self._variables))
+                )
+                log.info("loaded engine params from %s", ckpt)
+            else:
+                log.warning("checkpoint %s missing; using random init", ckpt)
         self._collector = Collector(
             self._bus,
             buckets=self._cfg.batch_buckets,
@@ -114,6 +166,23 @@ class InferenceEngine:
             self._spec.name, self._spec.kind, self._spec.input_size,
             jax.default_backend(),
         )
+
+    def save_checkpoint(self, path: Optional[str] = None) -> str:
+        """Persist current params (msgpack, atomic)."""
+        import jax
+
+        from ..utils.checkpoint import save_msgpack
+
+        if self._variables is None:
+            raise RuntimeError(
+                "save_checkpoint before warmup would overwrite the "
+                "checkpoint with empty params; call warmup() first"
+            )
+        path = path or self._cfg.checkpoint_path
+        if not path:
+            raise ValueError("no checkpoint path configured")
+        save_msgpack(path, jax.tree.map(np.asarray, self._variables))
+        return path
 
     def start(self) -> None:
         if self._model is None:
@@ -182,38 +251,7 @@ class InferenceEngine:
     def _build_step(self):
         import jax
 
-        model, spec = self._model, self._spec
-        size = spec.input_size
-
-        if spec.kind == "detect":
-            def raw(variables, frames_u8):
-                x, lb = preprocess_letterbox(frames_u8, size)
-                boxes, scores = model.apply(variables, x)
-                cls_scores = scores.max(axis=-1)
-                cls_ids = scores.argmax(axis=-1).astype("int32")
-                b, s, c, valid = batched_nms(boxes, cls_scores, cls_ids)
-                b = unletterbox_boxes(b, lb)
-                return {"boxes": b, "scores": s, "classes": c, "valid": valid}
-        elif spec.kind == "embed":
-            def raw(variables, frames_u8):
-                x = preprocess_classify(frames_u8, (size, size))
-                emb = model.apply(variables, x, features_only=True)
-                return {"embedding": emb}
-        else:  # classify | video
-            pre = preprocess_clip if spec.clip_len else preprocess_classify
-
-            def raw(variables, frames_u8):
-                import jax.numpy as jnp
-
-                x = pre(frames_u8, (size, size))
-                logits = model.apply(variables, x)
-                probs = jax.nn.softmax(logits, axis=-1)
-                top_p, top_i = jax.lax.top_k(
-                    probs, min(TOP_K_CLASSES, probs.shape[-1])
-                )
-                return {"top_probs": top_p, "top_ids": top_i.astype(jnp.int32)}
-
-        return jax.jit(raw)
+        return jax.jit(build_serving_step(self._model, self._spec))
 
     # -- engine loop --
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import os
 import threading
 from typing import Optional
 
@@ -65,7 +66,13 @@ async def _cors(request: web.Request, handler):
     return resp
 
 
-def build_app(pm: ProcessManager, settings: SettingsManager) -> web.Application:
+def build_app(
+    pm: ProcessManager,
+    settings: SettingsManager,
+    engine=None,                      # Optional[InferenceEngine]
+    annotations=None,                 # Optional[AnnotationQueue]
+    portal_dir: Optional[str] = None,
+) -> web.Application:
     app = web.Application(middlewares=[_cors], client_max_size=8 << 20)
 
     async def start_process(request: web.Request) -> web.Response:
@@ -126,16 +133,61 @@ def build_app(pm: ProcessManager, settings: SettingsManager) -> web.Application:
         )
         return web.json_response(_to_dict(s))
 
+    async def stats(_request: web.Request) -> web.Response:
+        """Engine + uplink observability (new; SURVEY.md §5.5 makes
+        per-stream fps/latency counters mandatory in the rebuild)."""
+        out: dict = {"engine": None, "annotation_queue": None}
+        if engine is not None:
+            out["engine"] = {
+                "model": engine._spec.name if engine._spec else None,
+                "ticks": engine.ticks,
+                "batches": engine.batches,
+                "streams": {
+                    did: dataclasses.asdict(st)
+                    for did, st in engine.stats().items()
+                },
+            }
+        if annotations is not None:
+            out["annotation_queue"] = {
+                "depth": annotations.depth(),
+                "published": annotations.published,
+                "acked": annotations.acked,
+                "dropped": annotations.dropped,
+                "rejected_batches": annotations.rejected_batches,
+            }
+        return web.json_response(out)
+
+    async def rtspscan(_request: web.Request) -> web.Response:
+        """The reference portal calls this route but its server never
+        implemented it (SURVEY.md L7 note, web edge.service.ts rtspScan).
+        Implemented here as an explicit empty result: local RTSP discovery
+        needs an ONVIF/port scanner, which is deployment tooling."""
+        return web.json_response([])
+
     app.router.add_post("/api/v1/process", start_process)
     app.router.add_delete("/api/v1/process/{name}", stop_process)
     app.router.add_get("/api/v1/process/{name}", process_info)
     app.router.add_get("/api/v1/processlist", process_list)
     app.router.add_get("/api/v1/settings", settings_get)
     app.router.add_post("/api/v1/settings", settings_overwrite)
+    app.router.add_get("/api/v1/stats", stats)
+    app.router.add_get("/api/v1/rtspscan", rtspscan)
+
     async def options(_request: web.Request) -> web.Response:
         return web.Response(status=204)
 
     app.router.add_route("OPTIONS", "/api/v1/{tail:.*}", options)
+
+    if portal_dir is None:
+        portal_dir = os.path.join(os.path.dirname(__file__), "..", "portal")
+    portal_dir = os.path.abspath(portal_dir)
+    index_path = os.path.join(portal_dir, "index.html")
+    if os.path.isfile(index_path):
+        async def portal_index(_request: web.Request) -> web.Response:
+            return web.FileResponse(index_path)
+
+        app.router.add_get("/", portal_index)
+        app.router.add_static("/portal", portal_dir)
     return app
 
 
@@ -143,8 +195,9 @@ class RestServer:
     """aiohttp app on a background thread; join/stop from the main thread."""
 
     def __init__(self, pm: ProcessManager, settings: SettingsManager,
-                 host: str = "0.0.0.0", port: int = 8080):
-        self._app = build_app(pm, settings)
+                 host: str = "0.0.0.0", port: int = 8080,
+                 engine=None, annotations=None):
+        self._app = build_app(pm, settings, engine=engine, annotations=annotations)
         self._host = host
         self._port = port
         self._loop: Optional[asyncio.AbstractEventLoop] = None

@@ -99,7 +99,8 @@ class Server:
             self.engine.start()
 
         self._rest = RestServer(
-            self.process_manager, self.settings, port=self._rest_port
+            self.process_manager, self.settings, port=self._rest_port,
+            engine=self.engine, annotations=self.annotations,
         )
         self._rest.start()
 

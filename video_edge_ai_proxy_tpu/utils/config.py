@@ -83,6 +83,9 @@ class EngineConfig:
     dtype: str = "bfloat16"
     # Mesh shape for multi-chip serving; empty = single chip.
     mesh: dict[str, int] = field(default_factory=dict)
+    # msgpack params checkpoint; empty = random init (no pretrained weights
+    # are bundled). Loaded at warmup so restart = load + compile cache.
+    checkpoint_path: str = ""
 
 
 @dataclass
