@@ -197,3 +197,26 @@ class TestEngine:
         st = eng.stats()["cam1"]
         assert st.frames >= 1
         assert st.last_batch == 1
+
+    def test_mesh_serving_dp_sharded(self, bus):
+        """cfg.mesh shards the serving batch over dp on the virtual mesh."""
+        import jax
+
+        cfg = EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2, 4), tick_ms=5,
+            mesh={"dp": 4},
+        )
+        eng = InferenceEngine(bus, cfg)
+        eng.warmup()
+        # buckets not divisible by dp are dropped
+        assert eng._collector._buckets == (4,)
+        for i in range(3):
+            did = f"cam{i}"
+            bus.create_stream(did, 32 * 32 * 3)
+            _publish(bus, did, w=32, h=32)
+        groups = eng._collector.collect()
+        assert groups[0].bucket == 4            # 3 streams padded to 4
+        placed = eng._place(groups[0].frames)
+        assert len(placed.sharding.device_set) == 4
+        out = eng._step(groups[0].src_hw, groups[0].bucket)(eng._variables, placed)
+        assert np.asarray(out["top_probs"]).shape == (4, 5)

@@ -1,3 +1,4 @@
+import os
 import time
 
 import numpy as np
@@ -133,3 +134,47 @@ class TestArchiver:
         assert w._decoded == 20
         segs = list((tmp_path / "cam1").iterdir())
         assert len(segs) >= 3  # 4 keyframes -> 3 closed GOPs
+
+
+class TestPassthrough:
+    def test_writer_flushes_gop_on_activation(self, tmp_path):
+        from video_edge_ai_proxy_tpu.ingest.passthrough import PassthroughWriter
+
+        sink = str(tmp_path / "out" / "relay.mp4")
+        w = PassthroughWriter(sink, fps=10.0)
+        frames = [np.full((32, 32, 3), i, np.uint8) for i in range(6)]
+        w.buffer(frames[0], True)       # GOP head
+        for f in frames[1:3]:
+            w.buffer(f, False)
+        w.set_active(True)              # must flush the 3 buffered frames
+        assert w.written == 3
+        for f in frames[3:]:
+            w.relay(f)
+        w.set_active(False)
+        assert w.written == 6
+        assert os.path.getsize(sink) > 0
+
+    def test_keyframe_resets_buffer(self):
+        from video_edge_ai_proxy_tpu.ingest.passthrough import PassthroughWriter
+
+        w = PassthroughWriter("/tmp/never-opened.mp4")
+        for i in range(5):
+            w.buffer(np.zeros((8, 8, 3), np.uint8), i % 2 == 0)
+        assert len(w._gop) == 1 + (5 - 1) % 2  # last keyframe + trailing
+
+    def test_worker_relays_when_proxy_flag_set(self, tmp_path):
+        bus = MemoryFrameBus()
+        sink = str(tmp_path / "relay.mp4")
+        cfg = WorkerConfig(
+            device_id="cam1",
+            rtsp_endpoint="test://pattern?w=32&h=32&fps=30&gop=5",
+            rtmp_endpoint=sink,
+            max_frames=25,
+        )
+        bus.set_proxy_rtmp("cam1", True)   # toggle already on at start
+        worker = IngestWorker(cfg, bus=bus)
+        worker.run()
+        assert worker._passthrough is not None
+        assert worker._passthrough.written > 0
+        assert os.path.exists(sink) and os.path.getsize(sink) > 0
+        bus.close()

@@ -120,6 +120,7 @@ class InferenceEngine:
         self._spec = spec
         self._model = None
         self._variables = None
+        self._mesh = None
         self._step_cache: Dict[tuple, Any] = {}
         self._collector: Optional[Collector] = None
         self._subscribers: List[tuple] = []   # (queue, device_id filter set|None)
@@ -155,9 +156,35 @@ class InferenceEngine:
                 log.info("loaded engine params from %s", ckpt)
             else:
                 log.warning("checkpoint %s missing; using random init", ckpt)
+        buckets = tuple(self._cfg.batch_buckets)
+        if self._cfg.mesh:
+            # Multi-chip serving: batch axis sharded over dp, params
+            # replicated (inference weights are small; fsdp-style sharding
+            # belongs to training). Buckets must divide evenly across dp so
+            # every chip gets identical static shapes.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel import make_mesh
+
+            n_need = 1
+            for v in self._cfg.mesh.values():
+                n_need *= v
+            self._mesh = make_mesh(
+                **self._cfg.mesh, devices=jax.devices()[:n_need]
+            )
+            dp = self._mesh.shape["dp"]
+            buckets = tuple(b for b in buckets if b % dp == 0) or (dp,)
+            self._variables = jax.device_put(
+                self._variables, NamedSharding(self._mesh, P())
+            )
+            log.info(
+                "engine mesh: %s (buckets -> %s)",
+                dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
+                buckets,
+            )
         self._collector = Collector(
             self._bus,
-            buckets=self._cfg.batch_buckets,
+            buckets=buckets,
             clip_len=self._spec.clip_len,
             active_window_s=self._cfg.active_window_s,
         )
@@ -237,8 +264,19 @@ class InferenceEngine:
             (self._spec.clip_len,) if self._spec.clip_len else ()
         ) + tuple(src_hw) + (3,)
         self._step(src_hw, bucket)(
-            self._variables, np.zeros(shape, np.uint8)
+            self._variables, self._place(np.zeros(shape, np.uint8))
         )
+
+    def _place(self, frames: np.ndarray):
+        """Shard the batch dim over dp when serving on a mesh; pass through
+        numpy (implicit single-device transfer) otherwise."""
+        if self._mesh is None:
+            return frames
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(("dp",), *([None] * (frames.ndim - 1)))
+        return jax.device_put(frames, NamedSharding(self._mesh, spec))
 
     def _step(self, src_hw: tuple, bucket: int):
         key = (src_hw, bucket)
@@ -270,7 +308,7 @@ class InferenceEngine:
                 submitted: List[_Inflight] = []
                 for group in groups:
                     step = self._step(group.src_hw, group.bucket)
-                    outputs = step(self._variables, group.frames)  # async dispatch
+                    outputs = step(self._variables, self._place(group.frames))
                     submitted.append(_Inflight(group, outputs, time.time()))
                     self.batches += 1
                 # Drain the PREVIOUS tick's work while this tick's runs.

@@ -1,0 +1,149 @@
+"""RTMP/file pass-through with buffered-GOP flush.
+
+Reference semantics (``python/rtsp_to_rtmp.py:127-139,163-182``): the worker
+demuxes continuously and keeps the current GOP buffered; when the Proxy
+toggle flips on (Redis hash ``proxy_rtmp``, written by
+``server/grpcapi/grpc_proxy_api.go:30-37``), it first flushes the buffered
+GOP — so the remote stream starts on a decodable keyframe — then relays
+live. Toggle-off closes the remote mux.
+
+Transport difference by design: the reference re-muxes *compressed* packets
+(PyAV); this build encodes decoded frames through OpenCV's FFmpeg backend.
+That supports rtmp:// where the cv2 build allows it and any local file
+target (how the tests drive the flush semantics). When no backend can open
+the sink, the toggle stays tracked and a warning is logged once — same
+observable control-plane state, degraded transport.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Deque, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger("ingest.passthrough")
+
+
+class PassthroughWriter:
+    """Owns the sink lifecycle; fed one decoded frame at a time."""
+
+    def __init__(self, endpoint: str, fps: float = 30.0,
+                 max_buffer_bytes: int = 64 << 20):
+        self.endpoint = endpoint
+        self.fps = max(fps, 1.0)
+        self._writer = None
+        self._failed = False
+        # Rolling buffer of the current GOP (reset at each keyframe) so
+        # toggle-on can flush from the GOP head (reference :155-157).
+        # Byte-bounded: we hold decoded frames where the reference held
+        # compressed packets, so an unbounded GOP would be GBs at 1080p.
+        self._gop: Deque[Tuple[np.ndarray, bool]] = deque()
+        self._gop_bytes = 0
+        self._max_buffer_bytes = max_buffer_bytes
+        self.requested = False   # control-plane toggle state (always tracked)
+        self.active = False      # transport actually relaying
+        self.written = 0
+
+    # -- GOP buffering (references, not copies; byte-capped) --
+
+    def buffer(self, frame: np.ndarray, is_keyframe: bool) -> None:
+        if self._failed:
+            return
+        if is_keyframe:
+            self._gop.clear()
+            self._gop_bytes = 0
+        self._gop.append((frame, is_keyframe))
+        self._gop_bytes += frame.nbytes
+        while self._gop_bytes > self._max_buffer_bytes and len(self._gop) > 1:
+            old, _ = self._gop.popleft()
+            self._gop_bytes -= old.nbytes
+
+    # -- toggle + relay --
+
+    def set_active(self, active: bool) -> None:
+        if active == self.requested:
+            return
+        self.requested = active
+        if not active:
+            self.active = False
+            self._failed = False   # a fresh toggle-on retries the sink
+            self._close()
+            log.info("passthrough to %s stopped", self.endpoint)
+            return
+        if self._open():
+            self.active = True
+            # Flush the buffered GOP so the sink starts at a keyframe
+            # (reference rtsp_to_rtmp.py:136-139,163-182).
+            for frame, _ in self._gop:
+                self._write(frame)
+            log.info(
+                "passthrough to %s started (flushed %d buffered frames)",
+                self.endpoint, len(self._gop),
+            )
+
+    def relay(self, frame: np.ndarray) -> None:
+        if self.active:
+            self._write(frame)   # opens the sink lazily on the first frame
+
+    # -- sink plumbing --
+
+    def _open(self) -> bool:
+        if self._failed:
+            return False
+        try:
+            import cv2
+        except ImportError:
+            self._fail("OpenCV unavailable")
+            return False
+        if not self._gop:
+            return True  # open lazily on the first frame
+        h, w = self._gop[-1][0].shape[:2]
+        return self._open_writer(w, h)
+
+    def _open_writer(self, w: int, h: int) -> bool:
+        import cv2
+
+        is_url = "://" in self.endpoint
+        fourcc = cv2.VideoWriter_fourcc(*("FLV1" if is_url else "mp4v"))
+        if not is_url:
+            os.makedirs(os.path.dirname(self.endpoint) or ".", exist_ok=True)
+        writer = cv2.VideoWriter(self.endpoint, fourcc, self.fps, (w, h))
+        if not writer.isOpened():
+            self._fail("no encoder backend for this sink")
+            return False
+        self._writer = writer
+        return True
+
+    def _write(self, frame: np.ndarray) -> None:
+        if self._failed:
+            return
+        if self._writer is None:
+            if not self._open_writer(frame.shape[1], frame.shape[0]):
+                return
+        self._writer.write(frame)
+        self.written += 1
+
+    def _fail(self, why: str) -> None:
+        if not self._failed:
+            log.warning(
+                "RTMP passthrough to %s unavailable (%s); toggle state is "
+                "tracked only, transport off until re-toggled",
+                self.endpoint, why,
+            )
+        self._failed = True
+        # Transport is dead: do NOT hold the worker's decode gate open.
+        # `requested` keeps the control-plane toggle observable.
+        self.active = False
+
+    def _close(self) -> None:
+        if self._writer is not None:
+            self._writer.release()
+            self._writer = None
+
+    def close(self) -> None:
+        self._close()
+        self.active = False

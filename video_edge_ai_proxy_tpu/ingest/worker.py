@@ -101,7 +101,7 @@ class IngestWorker:
         self._archiver: Optional[SegmentArchiver] = None
         self._gop_frames: list = []
         self._gop_start_ms = 0
-        self._rtmp_warned = False
+        self._passthrough = None  # built in run() once source fps is known
 
     # -- control-plane reads (per packet; shm KV, nanosecond-cheap) --
 
@@ -111,6 +111,10 @@ class IngestWorker:
 
     def _should_decode(self, is_keyframe: bool, now_ms: int) -> bool:
         if self._archiver is not None:
+            return True
+        if self._passthrough is not None and self._passthrough.active:
+            # Live relay consumes pixels (we encode decoded frames where the
+            # reference re-muxed packets), so it pins decoding on.
             return True
         if is_keyframe:
             return True
@@ -167,21 +171,12 @@ class IngestWorker:
                 self._gop_start_ms = meta.timestamp_ms
             self._gop_frames.append(frame)
 
-    # -- RTMP pass-through (toggle parity; transport gated on capability) --
+    # -- RTMP pass-through (reference §3.4: toggle + buffered-GOP flush) --
 
     def _maybe_passthrough(self) -> None:
-        if not self.cfg.rtmp_endpoint:
+        if self._passthrough is None:
             return
-        if self.bus.proxy_rtmp(self.cfg.device_id) and not self._rtmp_warned:
-            # The reference re-muxes compressed packets to RTMP
-            # (rtsp_to_rtmp.py:163-182); without a muxer binary in this image
-            # the toggle is accepted and surfaced, transport is a no-op.
-            log.warning(
-                "RTMP passthrough requested for %s but no muxer backend is "
-                "available in this build; toggle state is tracked only",
-                self.cfg.device_id,
-            )
-            self._rtmp_warned = True
+        self._passthrough.set_active(self.bus.proxy_rtmp(self.cfg.device_id))
 
     # -- main loop --
 
@@ -205,6 +200,12 @@ class IngestWorker:
         if cfg.disk_buffer_path:
             self._archiver = SegmentArchiver(cfg.disk_buffer_path)
             self._archiver.start()
+        if cfg.rtmp_endpoint:
+            from .passthrough import PassthroughWriter
+
+            self._passthrough = PassthroughWriter(
+                cfg.rtmp_endpoint, fps=self.source.fps or 30.0
+            )
         log.info(
             "ingest worker up: device=%s source=%s %dx%d@%.1ffps",
             cfg.device_id, cfg.rtsp_endpoint,
@@ -277,6 +278,9 @@ class IngestWorker:
                     self._published += 1
                     self._fps_window.append(time.monotonic())
                     self._archive_frame(frame, meta)
+                    if self._passthrough is not None:
+                        self._passthrough.buffer(frame, meta.is_keyframe)
+                        self._passthrough.relay(frame)
 
                 self._publish_status(time.monotonic())
                 if cfg.max_frames and self._packets >= cfg.max_frames:
@@ -285,6 +289,8 @@ class IngestWorker:
             self._publish_status(time.monotonic(), force=True)
             if self._archiver is not None:
                 self._archiver.stop()
+            if self._passthrough is not None:
+                self._passthrough.close()
             self.source.close()
             log.info(
                 "ingest worker down: device=%s packets=%d decoded=%d",
