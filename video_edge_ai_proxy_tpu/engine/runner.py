@@ -162,9 +162,7 @@ class InferenceEngine:
             # replicated (inference weights are small; fsdp-style sharding
             # belongs to training). Buckets must divide evenly across dp so
             # every chip gets identical static shapes.
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from ..parallel import make_mesh
+            from ..parallel import make_mesh, replicated
 
             n_need = 1
             for v in self._cfg.mesh.values():
@@ -175,7 +173,7 @@ class InferenceEngine:
             dp = self._mesh.shape["dp"]
             buckets = tuple(b for b in buckets if b % dp == 0) or (dp,)
             self._variables = jax.device_put(
-                self._variables, NamedSharding(self._mesh, P())
+                self._variables, replicated(self._mesh)
             )
             log.info(
                 "engine mesh: %s (buckets -> %s)",
@@ -273,10 +271,10 @@ class InferenceEngine:
         if self._mesh is None:
             return frames
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        spec = P(("dp",), *([None] * (frames.ndim - 1)))
-        return jax.device_put(frames, NamedSharding(self._mesh, spec))
+        from ..parallel import batch_sharding
+
+        return jax.device_put(frames, batch_sharding(self._mesh, frames.ndim))
 
     def _step(self, src_hw: tuple, bucket: int):
         key = (src_hw, bucket)

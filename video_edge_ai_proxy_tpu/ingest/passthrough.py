@@ -36,6 +36,7 @@ class PassthroughWriter:
         self.endpoint = endpoint
         self.fps = max(fps, 1.0)
         self._writer = None
+        self._writer_wh: Optional[Tuple[int, int]] = None
         self._failed = False
         # Rolling buffer of the current GOP (reset at each keyframe) so
         # toggle-on can flush from the GOP head (reference :155-157).
@@ -116,13 +117,24 @@ class PassthroughWriter:
             self._fail("no encoder backend for this sink")
             return False
         self._writer = writer
+        self._writer_wh = (w, h)
         return True
 
     def _write(self, frame: np.ndarray) -> None:
         if self._failed:
             return
+        wh = (frame.shape[1], frame.shape[0])
+        if self._writer is not None and wh != self._writer_wh:
+            # Camera switched modes mid-stream (worker grows its ring for
+            # the same reason); cv2 silently drops mis-sized frames, so
+            # reopen the sink at the new geometry instead of going dead.
+            log.info(
+                "passthrough sink %s reopening for %dx%d",
+                self.endpoint, wh[0], wh[1],
+            )
+            self._close()
         if self._writer is None:
-            if not self._open_writer(frame.shape[1], frame.shape[0]):
+            if not self._open_writer(*wh):
                 return
         self._writer.write(frame)
         self.written += 1

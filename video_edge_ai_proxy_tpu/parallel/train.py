@@ -31,6 +31,9 @@ class TrainState:
     step: jnp.ndarray
     params: Any
     opt_state: Any
+    # Frozen non-param collections (e.g. BatchNorm stats for convnet
+    # fine-tuning with frozen statistics). Not updated by the step.
+    aux: Any = None
 
 
 @dataclass
@@ -43,20 +46,26 @@ class Trainer:
     train_step: Callable[[TrainState, jnp.ndarray, jnp.ndarray], tuple]
 
     def init_state(self, rng: jax.Array, example: jnp.ndarray) -> TrainState:
-        boxed = jax.jit(functools.partial(self.model.init, train=False))(
+        variables = jax.jit(functools.partial(self.model.init, train=False))(
             rng, example
-        )["params"]
-        params = shd.place_params(self.mesh, boxed)
+        )
+        params = shd.place_params(self.mesh, variables["params"])
+        aux = {k: jax.device_put(shd.unbox(v), shd.replicated(self.mesh))
+               for k, v in variables.items() if k != "params"} or None
         opt_state = jax.jit(self.tx.init)(params)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                          opt_state=opt_state)
+                          opt_state=opt_state, aux=aux)
 
     def shard_batch(self, x: jnp.ndarray) -> jnp.ndarray:
         return jax.device_put(x, shd.batch_sharding(self.mesh, x.ndim))
 
 
-def cross_entropy_loss(model: nn.Module, params, batch, labels) -> jnp.ndarray:
-    logits = model.apply({"params": params}, batch, train=True)
+def cross_entropy_loss(model: nn.Module, params, aux, batch, labels) -> jnp.ndarray:
+    # BatchNorm models fine-tune with frozen statistics (train=True would
+    # try to mutate the immutable batch_stats collection); stat-less models
+    # (ViT family) get train=True so dropout stays active.
+    train = not (aux and "batch_stats" in aux)
+    logits = model.apply({"params": params, **(aux or {})}, batch, train=train)
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
@@ -69,20 +78,22 @@ def make_trainer(
 ) -> Trainer:
     """Build a Trainer whose step is jitted over ``mesh``.
 
-    ``loss_fn(model, params, batch, labels) -> scalar`` defaults to softmax
-    cross entropy (classification fine-tune, configs 1/3/4/5).
+    ``loss_fn(model, params, aux, batch, labels) -> scalar`` defaults to
+    softmax cross entropy (classification fine-tune, configs 1/3/4/5);
+    ``aux`` carries frozen non-param collections (BatchNorm stats).
     """
     tx = optax.adamw(learning_rate, weight_decay=weight_decay)
     loss_fn = loss_fn or cross_entropy_loss
 
     def step_fn(state: TrainState, batch, labels):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, batch, labels)
+            lambda p: loss_fn(model, p, state.aux, batch, labels)
         )(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return (
-            TrainState(step=state.step + 1, params=params, opt_state=opt_state),
+            TrainState(step=state.step + 1, params=params,
+                       opt_state=opt_state, aux=state.aux),
             loss,
         )
 
