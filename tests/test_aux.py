@@ -6,6 +6,7 @@ import os
 import pathlib
 import py_compile
 import time
+import urllib.error
 import urllib.request
 
 import jax
@@ -134,3 +135,58 @@ def test_examples_compile():
     assert len(examples) >= 5
     for path in examples:
         py_compile.compile(str(path), doraise=True)
+
+
+def test_distributed_initialize_noop_single_host(monkeypatch):
+    from video_edge_ai_proxy_tpu.parallel import initialize_distributed
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert initialize_distributed() is False
+
+
+class TestProfileEndpoint:
+    def test_profile_start_stop(self, tmp_path, shm_dir):
+        from video_edge_ai_proxy_tpu.serve.process_manager import ProcessManager
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+        from video_edge_ai_proxy_tpu.serve.settings import SettingsManager
+        from video_edge_ai_proxy_tpu.serve.storage import Storage
+        import json
+        import urllib.request
+
+        storage = Storage(str(tmp_path / "db"))
+        bus = MemoryFrameBus()
+        pm = ProcessManager(storage, bus, shm_dir=shm_dir)
+        settings = SettingsManager(storage)
+        eng = InferenceEngine(bus, EngineConfig(model="tiny_mobilenet_v2"))
+        eng.warmup()
+        rest = RestServer(pm, settings, port=0, engine=eng)
+        rest.start()
+        try:
+            base = f"http://127.0.0.1:{rest.bound_port}/api/v1/profile"
+            prof_dir = str(tmp_path / "trace")
+            req = urllib.request.Request(
+                base + "/start", data=json.dumps({"log_dir": prof_dir}).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+            # double-start conflicts
+            try:
+                urllib.request.urlopen(
+                    urllib.request.Request(base + "/start", data=b"{}", method="POST"),
+                    timeout=10,
+                )
+                assert False, "expected 409"
+            except urllib.error.HTTPError as err:
+                assert err.code == 409
+            with urllib.request.urlopen(
+                urllib.request.Request(base + "/stop", method="POST"), timeout=10
+            ) as resp:
+                assert resp.status == 200
+            assert os.path.isdir(prof_dir)
+        finally:
+            rest.stop()
+            pm.close()
+            bus.close()
+            storage.close()

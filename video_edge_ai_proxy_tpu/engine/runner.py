@@ -128,6 +128,7 @@ class InferenceEngine:
         self._stats: Dict[str, StreamStats] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._profiling = False
         self.ticks = 0
         self.batches = 0
 
@@ -191,6 +192,30 @@ class InferenceEngine:
             self._spec.name, self._spec.kind, self._spec.input_size,
             jax.default_backend(),
         )
+
+    # -- profiling (SURVEY.md §5.1: the reference has no tracing at all) --
+
+    def start_profile(self, log_dir: str) -> None:
+        """Begin a jax.profiler trace (view with TensorBoard/XProf)."""
+        import jax
+
+        if self._profiling:
+            raise RuntimeError("profiler already running")
+        jax.profiler.start_trace(log_dir)
+        self._profiling = True
+        log.info("profiler tracing to %s", log_dir)
+
+    def stop_profile(self) -> None:
+        import jax
+
+        if not self._profiling:
+            raise RuntimeError("profiler not running")
+        # stop_trace flushes to disk and can raise (e.g. unwritable
+        # log_dir); jax's session is torn down either way, so always clear
+        # the flag or the profiler API wedges until restart.
+        self._profiling = False
+        jax.profiler.stop_trace()
+        log.info("profiler trace stopped")
 
     def save_checkpoint(self, path: Optional[str] = None) -> str:
         """Persist current params (msgpack, atomic)."""

@@ -157,6 +157,31 @@ def build_app(
             }
         return web.json_response(out)
 
+    async def profile_start(request: web.Request) -> web.Response:
+        if engine is None:
+            return _error(400, "engine not running")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return _error(400, "JSON object body expected")
+        log_dir = body.get("log_dir", "/tmp/vep_tpu_profile")
+        try:
+            await asyncio.to_thread(engine.start_profile, log_dir)
+        except RuntimeError as exc:
+            return _error(409, str(exc))
+        return web.json_response({"log_dir": log_dir})
+
+    async def profile_stop(_request: web.Request) -> web.Response:
+        if engine is None:
+            return _error(400, "engine not running")
+        try:
+            await asyncio.to_thread(engine.stop_profile)
+        except RuntimeError as exc:
+            return _error(409, str(exc))
+        return web.Response(status=200)
+
     async def rtspscan(_request: web.Request) -> web.Response:
         """The reference portal calls this route but its server never
         implemented it (SURVEY.md L7 note, web edge.service.ts rtspScan).
@@ -172,6 +197,8 @@ def build_app(
     app.router.add_post("/api/v1/settings", settings_overwrite)
     app.router.add_get("/api/v1/stats", stats)
     app.router.add_get("/api/v1/rtspscan", rtspscan)
+    app.router.add_post("/api/v1/profile/start", profile_start)
+    app.router.add_post("/api/v1/profile/stop", profile_stop)
 
     async def options(_request: web.Request) -> web.Response:
         return web.Response(status=204)
