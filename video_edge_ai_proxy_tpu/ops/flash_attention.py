@@ -1,0 +1,163 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The within-chip counterpart to `parallel/ring_attention.py`: ring attention
+shards the *sequence across chips* (K/V ride ICI), this kernel makes each
+chip's local attention O(T) in memory — the [Tq, Tk] logits matrix lives
+only as a VMEM block, never in HBM. Together they are the long-context
+story (SURVEY.md §5.7: clip lengths that outgrow one chip's HBM).
+
+Kernel shape: grid = (B*H, Tq/block_q); each program owns one query block
+and scans the full K/V for its (batch, head) — K/V stay VMEM-resident
+(fine through ~16k tokens at d=64 bf16; beyond that the sequence is
+sharded by the ring anyway). Online softmax carries fp32 running max /
+denominator / accumulator, so the result is exact dense attention.
+
+Drop-in `attn_fn` for `models/transformer.Encoder` ([B, T, H, D] in/out,
+non-causal, like `default_attention`). The XLA twin used off-TPU is the
+same math via `interpret=True`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+_NEG = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, true_t: int):
+    """q [1, bq, D]; k/v [1, Tp, D]; o [1, bq, D]. Tp % block_k == 0."""
+    q = q_ref[0].astype(jnp.float32)               # [bq, D]
+    bq, d = q.shape
+    tp = k_ref.shape[1]
+    scale = d ** -0.5
+
+    m0 = jnp.full((bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # [bq, bk]
+        kpos = i * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        logits = jnp.where(kpos < true_t, logits, _NEG)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[:, None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    _, l, acc = lax.fori_loop(0, tp // block_k, body, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_q", "block_k", "true_t", "interpret"),
+)
+def _flash_call(q, k, v, *, block_q, block_k, true_t, interpret):
+    bh, tp, d = q.shape
+    kernel = functools.partial(_flash_kernel, block_k=block_k, true_t=true_t)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, tp // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tp, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tp, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dense_reference(q, k, v):
+    """Dense softmax attention (local twin of the encoder default): the
+    recompute path for the backward pass."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _flash(block_q: int, block_k: int, interpret: bool, q, k, v):
+    b, t, h, d = q.shape
+    # Grid and in-kernel K loop both index the padded length, so it must be
+    # a multiple of BOTH block sizes.
+    tp = -(-t // math.lcm(block_q, block_k)) * math.lcm(block_q, block_k)
+
+    def pack(x):
+        x = x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        if tp != t:
+            x = jnp.pad(x, ((0, 0), (0, tp - t), (0, 0)))
+        return x
+
+    out = _flash_call(
+        pack(q), pack(k), pack(v),
+        block_q=block_q, block_k=block_k, true_t=t, interpret=interpret,
+    )
+    return out[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(block_q, block_k, interpret, q, k, v):
+    return _flash(block_q, block_k, interpret, q, k, v), (q, k, v)
+
+
+def _flash_bwd(block_q, block_k, interpret, residuals, g):
+    # Backward recomputes through the dense formulation — exact gradients,
+    # O(T^2) memory only inside the backward pass. A flash backward kernel
+    # is the upgrade path once long-context *training* (not just serving)
+    # becomes the bottleneck.
+    q, k, v = residuals
+    _, vjp = jax.vjp(_dense_reference, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Exact softmax attention, [B, T, H, D] -> [B, T, H, D].
+
+    Arbitrary T (right-padded to the block grid and masked in-kernel) and
+    differentiable (custom VJP; backward recomputes densely). ``interpret``
+    defaults to True off-TPU so CPU tests run the same kernel body.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    t = q.shape[1]
+    block_q = min(block_q, max(8, t))
+    block_k = min(block_k, max(8, t))
+    return _flash(block_q, block_k, interpret, q, k, v)
