@@ -1,0 +1,120 @@
+"""Data pipeline tests: archive scan, segment decode, shuffled batching."""
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.data import Loader, SegmentDataset, scan_archive
+from video_edge_ai_proxy_tpu.ingest.archive import GopSegment, SegmentArchiver
+
+
+@pytest.fixture()
+def archive(tmp_path):
+    """A real archive written by the production archiver: 2 cameras x 3
+    GOP segments of 10 frames each."""
+    arch = SegmentArchiver(str(tmp_path))
+    arch.start()
+    for cam in ("cam1", "cam2"):
+        for g in range(3):
+            frames = [
+                np.full((48, 64, 3), g * 10 + i, np.uint8) for i in range(10)
+            ]
+            arch.submit(GopSegment(
+                device_id=cam, start_ts_ms=1000 * g, end_ts_ms=1000 * g + 333,
+                fps=30.0, frames=frames,
+            ))
+    arch.stop()
+    assert arch.written == 6
+    return str(tmp_path)
+
+
+def test_scan_archive_contract(archive):
+    refs = scan_archive(archive)
+    assert len(refs) == 6
+    assert {r.device_id for r in refs} == {"cam1", "cam2"}
+    assert all(r.duration_ms == 333 for r in refs)
+    only = scan_archive(archive, device_ids=["cam2"])
+    assert len(only) == 3 and all(r.device_id == "cam2" for r in only)
+
+
+def test_frame_samples_resized(archive):
+    ds = SegmentDataset(archive, size=(32, 32))
+    samples = list(ds.samples_from(ds.refs[0]))
+    assert len(samples) == 10
+    assert samples[0].shape == (32, 32, 3)
+
+
+def test_clip_samples(archive):
+    ds = SegmentDataset(archive, size=(32, 32), clip_len=4)
+    clips = list(ds.samples_from(ds.refs[0]))
+    assert len(clips) == 2              # 10 frames -> two non-overlapping 4-clips
+    assert clips[0].shape == (4, 32, 32, 3)
+
+
+def test_loader_batches(archive):
+    ds = SegmentDataset(archive, size=(32, 32), seed=7)
+    batches = list(Loader(ds, batch_size=16))
+    # 6 segments x 10 frames = 60 samples -> 3 full batches of 16
+    assert len(batches) == 3
+    for b in batches:
+        assert b.shape == (16, 32, 32, 3)
+        assert b.dtype == np.uint8
+
+
+def test_loader_keep_last(archive):
+    ds = SegmentDataset(archive, size=(32, 32))
+    batches = list(Loader(ds, batch_size=16, drop_last=False))
+    assert [b.shape[0] for b in batches] == [16, 16, 16, 12]
+
+
+def test_loader_shuffles_between_epochs(archive):
+    ds = SegmentDataset(archive, size=(32, 32), seed=3)
+    order1 = [r.path for r in ds.shuffled_refs()]
+    order2 = [r.path for r in ds.shuffled_refs()]
+    assert sorted(order1) == sorted(order2)
+    assert order1 != order2
+
+
+def test_empty_archive(tmp_path):
+    assert scan_archive(str(tmp_path / "missing")) == []
+    ds = SegmentDataset(str(tmp_path / "missing"))
+    assert list(Loader(ds, batch_size=4)) == []
+
+
+def test_loader_early_abandonment_stops_producer(archive):
+    import threading
+
+    ds = SegmentDataset(archive, size=(32, 32))
+    before = threading.active_count()
+    it = iter(Loader(ds, batch_size=8, prefetch=1))
+    next(it)
+    it.close()          # abandon mid-epoch
+    import time
+
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+def test_loader_propagates_producer_error(tmp_path):
+    # An unreadable "segment" that parses but cannot decode
+    dev = tmp_path / "cam1"
+    dev.mkdir()
+    (dev / "1000_333.npz").write_bytes(b"not a real npz")
+    ds = SegmentDataset(str(tmp_path), size=(16, 16))
+    # samples_from logs+skips unreadable files, so this yields no batches
+    assert list(Loader(ds, batch_size=2)) == []
+
+
+def test_scan_archive_numeric_order(tmp_path):
+    dev = tmp_path / "cam1"
+    dev.mkdir()
+    for start in (9000, 10000, 800):
+        np.savez(dev / f"{start}_100.npz",
+                 frames=np.zeros((2, 8, 8, 3), np.uint8), fps=30.0)
+    refs = scan_archive(str(tmp_path))
+    assert [r.start_ms for r in refs] == [800, 9000, 10000]
+
+
+def test_scan_archive_empty_allowlist_means_none(archive):
+    assert scan_archive(archive, device_ids=[]) == []

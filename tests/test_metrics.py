@@ -1,0 +1,69 @@
+"""mAP evaluator tests against hand-computable cases."""
+
+import numpy as np
+
+from video_edge_ai_proxy_tpu.models.metrics import DetectionEvaluator, _iou_matrix
+
+
+def test_iou_matrix_empty_safe():
+    assert _iou_matrix(np.zeros((0, 4)), np.zeros((3, 4))).shape == (0, 3)
+
+
+def test_perfect_predictions_map_one():
+    ev = DetectionEvaluator()
+    gt = np.array([[0, 0, 10, 10], [20, 20, 40, 40]], np.float32)
+    cls = np.array([1, 2])
+    ev.add_image(gt, np.array([0.9, 0.8]), cls, gt, cls)
+    s = ev.summarize()
+    assert s["mAP"] == 1.0 and s["mAP50"] == 1.0 and s["mAP75"] == 1.0
+
+
+def test_wrong_class_scores_zero():
+    ev = DetectionEvaluator()
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    ev.add_image(gt, np.array([0.9]), np.array([3]), gt, np.array([1]))
+    assert ev.summarize()["mAP"] == 0.0
+
+
+def test_loose_boxes_pass_50_fail_75():
+    ev = DetectionEvaluator()
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    # IoU vs gt = (10*6)/(100+60-60) = 0.6 -> matches at 0.5, not at 0.75
+    pred = np.array([[0, 0, 10, 6]], np.float32)
+    ev.add_image(pred, np.array([0.9]), np.array([0]), gt, np.array([0]))
+    s = ev.summarize()
+    assert s["mAP50"] == 1.0
+    assert s["mAP75"] == 0.0
+    assert 0.0 < s["mAP"] < 1.0
+
+
+def test_false_positive_lowers_precision():
+    ev = DetectionEvaluator()
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    preds = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+    # FP has the HIGHER score, so precision at the recall point is 1/2.
+    ev.add_image(preds, np.array([0.5, 0.9]), np.array([0, 0]),
+                 gt, np.array([0]))
+    s = ev.summarize()
+    assert s["mAP50"] < 1.0
+
+
+def test_missed_gt_lowers_recall():
+    ev = DetectionEvaluator()
+    gt = np.array([[0, 0, 10, 10], [30, 30, 40, 40]], np.float32)
+    ev.add_image(np.array([[0, 0, 10, 10]], np.float32), np.array([0.9]),
+                 np.array([0]), gt, np.array([0, 0]))
+    s = ev.summarize()
+    assert abs(s["mAP50"] - 0.5) < 0.01   # one of two GT found
+
+
+def test_multi_image_accumulation():
+    ev = DetectionEvaluator()
+    box = np.array([[0, 0, 10, 10]], np.float32)
+    for _ in range(4):
+        ev.add_image(box, np.array([0.9]), np.array([0]), box, np.array([0]))
+    # plus one image with a miss
+    ev.add_image(np.zeros((0, 4)), np.zeros((0,)), np.zeros((0,)),
+                 box, np.array([0]))
+    s = ev.summarize()
+    assert abs(s["mAP50"] - 0.8) < 0.01   # 4/5 recall, full precision
