@@ -1,0 +1,30 @@
+# Server image (reference docker-compose builds one image per service; this
+# framework is one process + per-camera subprocesses, so one image serves
+# REST+portal, gRPC, ingest workers and the TPU engine).
+#
+# For TPU: base this on a jax[tpu]-enabled image on a TPU VM; the CPU base
+# below runs everything (engine included) on the XLA CPU backend.
+
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make libgl1 libglib2.0-0 \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY video_edge_ai_proxy_tpu ./video_edge_ai_proxy_tpu
+COPY examples ./examples
+
+RUN pip install --no-cache-dir \
+        jax flax optax orbax-checkpoint chex einops numpy \
+        grpcio protobuf aiohttp pyyaml opencv-python-headless
+
+# Pre-build the native bus core into the image
+RUN python -c "from video_edge_ai_proxy_tpu.bus.native.build import build_library; build_library()"
+
+EXPOSE 8080 50001
+VOLUME ["/data/chrysalis", "/dev/shm"]
+
+ENTRYPOINT ["python", "-m", "video_edge_ai_proxy_tpu.serve.server", \
+            "--engine", "--data_dir", "/data/chrysalis"]

@@ -1,0 +1,40 @@
+# Build / codegen targets (reference Makefile parity: proto codegen was its
+# whole build; ours adds the native bus lib and test/bench shortcuts).
+
+.PHONY: all proto native test bench graft clean
+
+all: proto native
+
+# Regenerate gRPC stubs after editing proto/video_streaming.proto
+# (reference Makefile:5-17 — one schema, generated bindings checked in).
+proto:
+	python -m grpc_tools.protoc \
+		-I video_edge_ai_proxy_tpu/proto \
+		--python_out=video_edge_ai_proxy_tpu/proto \
+		--grpc_python_out=video_edge_ai_proxy_tpu/proto \
+		video_edge_ai_proxy_tpu/proto/video_streaming.proto
+	@# generated import is absolute; rewrite to package-relative
+	sed -i 's/^import video_streaming_pb2/from . import video_streaming_pb2/' \
+		video_edge_ai_proxy_tpu/proto/video_streaming_pb2_grpc.py
+
+# Force-rebuild the C++ shm bus core (normally built+cached on first import).
+native:
+	rm -rf ~/.cache/vep_tpu
+	python -c "from video_edge_ai_proxy_tpu.bus.native.build import build_library; print(build_library())"
+
+# Tooling for the proto target (reference Makefile:20-24).
+install:
+	pip install -U grpcio grpcio-tools
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+graft:
+	python __graft_entry__.py
+
+clean:
+	rm -rf .jax_cache .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

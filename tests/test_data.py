@@ -96,14 +96,30 @@ def test_loader_early_abandonment_stops_producer(archive):
     assert threading.active_count() <= before
 
 
-def test_loader_propagates_producer_error(tmp_path):
-    # An unreadable "segment" that parses but cannot decode
+def test_unreadable_segment_skipped(tmp_path):
     dev = tmp_path / "cam1"
     dev.mkdir()
     (dev / "1000_333.npz").write_bytes(b"not a real npz")
     ds = SegmentDataset(str(tmp_path), size=(16, 16))
     # samples_from logs+skips unreadable files, so this yields no batches
     assert list(Loader(ds, batch_size=2)) == []
+
+
+def test_loader_propagates_producer_error(archive, monkeypatch):
+    ds = SegmentDataset(archive, size=(32, 32))
+
+    def boom(_ref):
+        raise RuntimeError("producer exploded")
+
+    monkeypatch.setattr(ds, "samples_from", boom)
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        list(Loader(ds, batch_size=2))
+
+
+def test_loader_rejects_zero_prefetch(archive):
+    ds = SegmentDataset(archive)
+    with pytest.raises(ValueError):
+        Loader(ds, batch_size=2, prefetch=0)
 
 
 def test_scan_archive_numeric_order(tmp_path):

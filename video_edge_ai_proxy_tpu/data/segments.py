@@ -89,9 +89,9 @@ class SegmentDataset:
     """Iterable of fixed-shape samples drawn from archived segments.
 
     ``clip_len=0`` yields single frames [H, W, 3]; ``clip_len=T`` yields
-    clips [T, H, W, 3] cut from consecutive frames. All samples are
-    center-cropped/resized to ``size`` so batches are shape-homogeneous
-    regardless of per-camera resolutions.
+    clips [T, H, W, 3] cut from consecutive frames. All samples are resized
+    (anisotropically — no crop) to ``size`` so batches are
+    shape-homogeneous regardless of per-camera resolutions.
     """
 
     def __init__(
@@ -146,6 +146,9 @@ class Loader:
 
     def __init__(self, dataset: SegmentDataset, batch_size: int,
                  prefetch: int = 4, drop_last: bool = True):
+        if prefetch < 1:
+            # queue.Queue(0) would mean UNBOUNDED readahead, not none.
+            raise ValueError("prefetch must be >= 1")
         self.dataset = dataset
         self.batch_size = batch_size
         self.prefetch = prefetch
