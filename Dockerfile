@@ -24,7 +24,7 @@ RUN pip install --no-cache-dir \
 RUN python -c "from video_edge_ai_proxy_tpu.bus.native.build import build_library; build_library()"
 
 EXPOSE 8080 50001
-VOLUME ["/data/chrysalis", "/dev/shm"]
+VOLUME ["/data/chrysalis"]
 
 ENTRYPOINT ["python", "-m", "video_edge_ai_proxy_tpu.serve.server", \
             "--engine", "--data_dir", "/data/chrysalis"]

@@ -1,7 +1,7 @@
 # Build / codegen targets (reference Makefile parity: proto codegen was its
 # whole build; ours adds the native bus lib and test/bench shortcuts).
 
-.PHONY: all proto native test bench graft clean
+.PHONY: all proto native install test bench graft clean
 
 all: proto native
 

@@ -220,3 +220,40 @@ class TestEngine:
         assert len(placed.sharding.device_set) == 4
         out = eng._step(groups[0].src_hw, groups[0].bucket)(eng._variables, placed)
         assert np.asarray(out["top_probs"]).shape == (4, 5)
+
+    def test_per_stream_model_selection(self, bus):
+        """Streams with different inference_model records run different
+        models in the same engine, batched separately."""
+        assignments = {"cam_detect": "tiny_yolov8", "cam_cls": ""}
+        cfg = EngineConfig(model="tiny_mobilenet_v2", batch_buckets=(1, 2),
+                           tick_ms=5)
+        eng = InferenceEngine(
+            bus, cfg, model_resolver=lambda d: assignments.get(d, ""),
+        )
+        eng.warmup()
+        for did in assignments:
+            bus.create_stream(did, 64 * 64 * 3)
+            _publish(bus, did, w=64, h=64)
+        groups = eng._collector.collect()
+        by_model = {g.model: g for g in groups}
+        assert set(by_model) == {"tiny_yolov8", "tiny_mobilenet_v2"}
+        assert by_model["tiny_yolov8"].device_ids == ["cam_detect"]
+        # run both programs; outputs match each model kind
+        out_det = eng._step((64, 64), 1, "tiny_yolov8")(
+            eng._models["tiny_yolov8"][2], by_model["tiny_yolov8"].frames
+        )
+        assert "valid" in out_det
+        out_cls = eng._step((64, 64), 1, "tiny_mobilenet_v2")(
+            eng._variables, by_model["tiny_mobilenet_v2"].frames
+        )
+        assert "top_probs" in out_cls
+
+    def test_unknown_model_falls_back_to_default(self, bus):
+        cfg = EngineConfig(model="tiny_mobilenet_v2", batch_buckets=(1,),
+                           tick_ms=5)
+        eng = InferenceEngine(bus, cfg, model_resolver=lambda d: "nope")
+        eng.warmup()
+        bus.create_stream("cam1", 32 * 32 * 3)
+        _publish(bus, "cam1", w=32, h=32)
+        groups = eng._collector.collect()
+        assert groups[0].model == "tiny_mobilenet_v2"

@@ -34,6 +34,7 @@ class BatchGroup:
     frames: np.ndarray       # [N, H, W, C] u8, or [N, T, H, W, C] for clips
     metas: List[FrameMeta]
     bucket: int = 0          # padded batch size chosen by pad_to_bucket
+    model: str = ""          # registry model these streams run (engine key)
 
 
 def pad_to_bucket(group: BatchGroup, buckets: Sequence[int]) -> BatchGroup:
@@ -60,14 +61,27 @@ class Collector:
         buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
         clip_len: int = 0,
         active_window_s: float = 10.0,
+        model_of: Optional[callable] = None,   # device_id -> (model, clip_len)
+        default_model: str = "",
     ):
         self._bus = bus
         self._buckets = tuple(sorted(buckets))
         self._clip_len = clip_len
         self._active_window_s = active_window_s
+        self._model_of = model_of
+        self._default_model = default_model
         self._cursors: Dict[str, int] = {}
         self._clips: Dict[str, deque] = {}
         self._only: Optional[set] = None   # restrict to these ids (None = all)
+
+    def _stream_model(self, device_id: str):
+        """(model name, clip_len) for one stream — per-stream override via
+        the resolver (StreamProcess.inference_model), else engine default."""
+        if self._model_of is not None:
+            resolved = self._model_of(device_id)
+            if resolved:
+                return resolved
+        return self._default_model, self._clip_len
 
     def restrict(self, device_ids: Optional[Sequence[str]]) -> None:
         self._only = set(device_ids) if device_ids else None
@@ -98,33 +112,34 @@ class Collector:
         return out
 
     def collect(self) -> List[BatchGroup]:
-        """One tick: newest unseen frame per stream -> shape-grouped,
-        bucket-padded batches (clips for video models)."""
+        """One tick: newest unseen frame per stream -> (model, shape)-
+        grouped, bucket-padded batches (clips for video models)."""
         fresh = self._take_new_frames()
-        by_shape: Dict[tuple, list] = {}
+        by_key: Dict[tuple, list] = {}
 
-        if self._clip_len:
-            for device_id, frame in fresh:
-                window = self._clips.setdefault(
-                    device_id, deque(maxlen=self._clip_len)
-                )
+        for device_id, frame in fresh:
+            model, clip_len = self._stream_model(device_id)
+            hw = frame.data.shape[:2]
+            if clip_len:
+                window = self._clips.get(device_id)
+                if window is None or window.maxlen != clip_len:
+                    # (Re)create on clip-length change — a re-added stream
+                    # with a different model must not inherit a stale window.
+                    window = deque(maxlen=clip_len)
+                    self._clips[device_id] = window
                 window.append(frame)
-                if len(window) == self._clip_len:
-                    hw = frame.data.shape[:2]
-                    clip = np.stack([f.data for f in window])
-                    by_shape.setdefault(hw, []).append(
-                        (device_id, clip, window[-1].meta)
-                    )
-        else:
-            for device_id, frame in fresh:
-                hw = frame.data.shape[:2]
-                by_shape.setdefault(hw, []).append(
-                    (device_id, frame.data, frame.meta)
-                )
+                if len(window) < clip_len:
+                    continue
+                sample = np.stack([f.data for f in window])
+            else:
+                sample = frame.data
+            by_key.setdefault((model, hw), []).append(
+                (device_id, sample, frame.meta)
+            )
 
         groups: List[BatchGroup] = []
         max_bucket = self._buckets[-1]
-        for hw, items in sorted(by_shape.items()):
+        for (model, hw), items in sorted(by_key.items()):
             for start in range(0, len(items), max_bucket):
                 chunk = items[start:start + max_bucket]
                 group = BatchGroup(
@@ -132,6 +147,7 @@ class Collector:
                     device_ids=[d for d, _, _ in chunk],
                     frames=np.stack([a for _, a, _ in chunk]),
                     metas=[m for _, _, m in chunk],
+                    model=model,
                 )
                 groups.append(pad_to_bucket(group, self._buckets))
         return groups

@@ -113,6 +113,7 @@ class InferenceEngine:
         *,
         annotations=None,                    # AnnotationQueue or None
         spec=None,                           # ModelSpec override (tests)
+        model_resolver=None,                 # device_id -> model name or ""
     ):
         self._bus = bus
         self._cfg = cfg or EngineConfig()
@@ -121,6 +122,12 @@ class InferenceEngine:
         self._model = None
         self._variables = None
         self._mesh = None
+        # Per-stream model selection (StreamProcess.inference_model): other
+        # registry models load lazily on first use; name -> (spec, model,
+        # variables). The default model also lives here under its name.
+        self._model_resolver = model_resolver
+        self._models: Dict[str, tuple] = {}
+        self._bad_models: set = set()
         self._step_cache: Dict[tuple, Any] = {}
         self._collector: Optional[Collector] = None
         self._subscribers: List[tuple] = []   # (queue, device_id filter set|None)
@@ -182,17 +189,61 @@ class InferenceEngine:
                 dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
                 buckets,
             )
+        self._models[self._spec.name] = (self._spec, self._model, self._variables)
         self._collector = Collector(
             self._bus,
             buckets=buckets,
             clip_len=self._spec.clip_len,
             active_window_s=self._cfg.active_window_s,
+            model_of=self._stream_model,
+            default_model=self._spec.name,
         )
         log.info(
             "engine ready: model=%s kind=%s input=%d backend=%s",
             self._spec.name, self._spec.kind, self._spec.input_size,
             jax.default_backend(),
         )
+
+    def _ensure_model(self, name: str):
+        """(spec, model, variables) for a registry model, lazily built.
+        Only the default model reads cfg.checkpoint_path; per-stream extras
+        start from init (their checkpoints belong to a later config)."""
+        entry = self._models.get(name)
+        if entry is None:
+            import jax
+
+            from ..models import registry
+
+            spec = registry.get(name)
+            model, variables = spec.init_params(jax.random.PRNGKey(0))
+            if self._mesh is not None:
+                from ..parallel import replicated
+
+                variables = jax.device_put(variables, replicated(self._mesh))
+            entry = (spec, model, variables)
+            self._models[name] = entry
+            log.info("engine loaded extra model '%s' (kind=%s)", name, spec.kind)
+        return entry
+
+    def _stream_model(self, device_id: str):
+        """Collector resolver: (model name, clip_len) or None for default."""
+        if self._model_resolver is None:
+            return None
+        name = self._model_resolver(device_id)
+        if not name or name == self._spec.name:
+            return None
+        if name in self._bad_models:
+            return None
+        try:
+            spec, _, _ = self._ensure_model(name)
+        except KeyError:
+            log.warning(
+                "stream %s requests unknown model '%s'; using default",
+                device_id, name,
+            )
+            self._bad_models.add(name)
+            return None
+        return name, spec.clip_len
 
     # -- profiling (SURVEY.md §5.1: the reference has no tracing at all) --
 
@@ -304,18 +355,17 @@ class InferenceEngine:
 
         return jax.device_put(frames, batch_sharding(self._mesh, frames.ndim))
 
-    def _step(self, src_hw: tuple, bucket: int):
-        key = (src_hw, bucket)
+    def _step(self, src_hw: tuple, bucket: int, model: Optional[str] = None):
+        model = model or self._spec.name
+        key = (model, src_hw, bucket)
         fn = self._step_cache.get(key)
         if fn is None:
-            fn = self._build_step()
+            import jax
+
+            spec, mod, _ = self._ensure_model(model)
+            fn = jax.jit(build_serving_step(mod, spec))
             self._step_cache[key] = fn
         return fn
-
-    def _build_step(self):
-        import jax
-
-        return jax.jit(build_serving_step(self._model, self._spec))
 
     # -- engine loop --
 
@@ -333,8 +383,11 @@ class InferenceEngine:
                 groups = self._collector.collect()
                 submitted: List[_Inflight] = []
                 for group in groups:
-                    step = self._step(group.src_hw, group.bucket)
-                    outputs = step(self._variables, self._place(group.frames))
+                    step = self._step(group.src_hw, group.bucket, group.model)
+                    _, _, variables = self._ensure_model(
+                        group.model or self._spec.name
+                    )
+                    outputs = step(variables, self._place(group.frames))
                     submitted.append(_Inflight(group, outputs, time.time()))
                     self.batches += 1
                 # Drain the PREVIOUS tick's work while this tick's runs.
@@ -360,16 +413,17 @@ class InferenceEngine:
 
     def _emit(self, inflight: _Inflight) -> None:
         group = inflight.group
+        spec = self._models[group.model or self._spec.name][0]
         host = {k: np.asarray(v) for k, v in inflight.outputs.items()}  # D2H
         now_ms = int(time.time() * 1000)
         for i, device_id in enumerate(group.device_ids):
             meta = group.metas[i]
-            detections = self._to_detections(host, i)
+            detections = self._to_detections(host, i, spec)
             latency = max(0.0, now_ms - meta.timestamp_ms) if meta.timestamp_ms else 0.0
             result = pb.InferenceResult(
                 device_id=device_id,
                 timestamp=meta.timestamp_ms,
-                model=self._spec.name,
+                model=spec.name,
                 model_version="0",
                 detections=detections,
                 latency_ms=latency,
@@ -377,7 +431,7 @@ class InferenceEngine:
                 frame_packet=meta.packet,
             )
             self._publish(result)
-            self._annotate(device_id, meta, detections)
+            self._annotate(device_id, meta, detections, spec)
             st = self._stats.setdefault(device_id, StreamStats())
             st.frames += 1
             st.last_latency_ms = latency
@@ -387,8 +441,8 @@ class InferenceEngine:
             )
             st.last_batch = group.bucket
 
-    def _to_detections(self, host: dict, i: int) -> List[pb.Detection]:
-        spec = self._spec
+    def _to_detections(self, host: dict, i: int, spec=None) -> List[pb.Detection]:
+        spec = spec or self._spec
         out: List[pb.Detection] = []
         if spec.kind == "detect":
             valid = host["valid"][i]
@@ -401,7 +455,7 @@ class InferenceEngine:
                     box=pb.BoundingBox(left=x1, top=y1, width=x2 - x1, height=y2 - y1),
                     confidence=float(host["scores"][i, j]),
                     class_id=cid,
-                    class_name=class_name(cid, self._num_classes()),
+                    class_name=class_name(cid, self._num_classes(spec)),
                 ))
         elif spec.kind == "embed":
             out.append(pb.Detection(
@@ -412,12 +466,14 @@ class InferenceEngine:
             for p, cid in zip(host["top_probs"][i], host["top_ids"][i]):
                 out.append(pb.Detection(
                     confidence=float(p), class_id=int(cid),
-                    class_name=class_name(int(cid), self._num_classes()),
+                    class_name=class_name(int(cid), self._num_classes(spec)),
                 ))
         return out
 
-    def _num_classes(self) -> int:
-        cfg = getattr(self._model, "cfg", None)
+    def _num_classes(self, spec=None) -> int:
+        spec = spec or self._spec
+        model = self._models[spec.name][1] if spec.name in self._models else self._model
+        cfg = getattr(model, "cfg", None)
         return getattr(cfg, "num_classes", 0) if cfg is not None else 0
 
     def _publish(self, result: pb.InferenceResult) -> None:
@@ -432,8 +488,10 @@ class InferenceEngine:
                 pass  # slow subscriber: latest-wins spirit, drop
 
     def _annotate(
-        self, device_id: str, meta: FrameMeta, detections: Sequence[pb.Detection]
+        self, device_id: str, meta: FrameMeta, detections: Sequence[pb.Detection],
+        spec=None,
     ) -> None:
+        spec = spec or self._spec
         if self._annotations is None:
             return
         for det in detections:
@@ -441,12 +499,12 @@ class InferenceEngine:
                 continue
             req = pb.AnnotateRequest(
                 device_name=device_id,
-                type="detection" if self._spec.kind == "detect" else self._spec.kind,
+                type="detection" if spec.kind == "detect" else spec.kind,
                 start_timestamp=meta.timestamp_ms or int(time.time() * 1000),
                 object_type=det.class_name,
                 confidence=det.confidence,
                 object_bouding_box=det.box if det.HasField("box") else None,
-                ml_model=self._spec.name,
+                ml_model=spec.name,
                 ml_model_version="0",
                 width=meta.width,
                 height=meta.height,

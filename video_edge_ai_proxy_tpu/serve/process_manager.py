@@ -99,6 +99,7 @@ class _Entry:
         self.desired = True  # restart-policy always while desired
         self.last_exit = 0
         self.last_spawn = time.monotonic()
+        self.inference_model = ""  # per-stream engine model override
         self.restart_due = 0.0  # backoff deadline; 0 = not pending
 
 
@@ -135,6 +136,7 @@ class ProcessManager:
             if device_id in self._entries:
                 raise ProcessError(f"process {device_id!r} already exists")
             entry = _Entry()
+            entry.inference_model = record.inference_model
             self._entries[device_id] = entry
         now = StreamProcess.now_ms()
         record.created = record.created or now
@@ -191,6 +193,13 @@ class ProcessManager:
         entry.last_spawn = time.monotonic()
         entry.tail = _Tail(proc)
         record.container_id = f"{proc.pid}@{os.uname().nodename}"
+
+    def inference_model_of(self, device_id: str) -> str:
+        """Per-stream engine model override (StreamProcess.inference_model);
+        "" means the engine default. Lock-free dict read — called by the
+        engine collector every tick."""
+        entry = self._entries.get(device_id)
+        return entry.inference_model if entry is not None else ""
 
     def stop(self, device_id: str) -> None:
         with self._lock:
@@ -300,6 +309,7 @@ class ProcessManager:
                 entry = _Entry()
                 self._entries[device_id] = entry
             record = StreamProcess.from_json(raw)
+            entry.inference_model = record.inference_model
             try:
                 self._spawn(record, entry)
                 self._persist(record)

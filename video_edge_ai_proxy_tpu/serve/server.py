@@ -79,7 +79,8 @@ class Server:
                     "is unavailable"
                 ) from exc
             self.engine = InferenceEngine(
-                self.bus, self.cfg.engine, annotations=self.annotations
+                self.bus, self.cfg.engine, annotations=self.annotations,
+                model_resolver=self.process_manager.inference_model_of,
             )
         self.cron = CronJobs(self.cfg.buffer)
         self._grpc_port = grpc_port if grpc_port is not None else self.cfg.grpc_port
