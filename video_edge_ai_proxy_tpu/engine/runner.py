@@ -236,9 +236,13 @@ class InferenceEngine:
             return None
         try:
             spec, _, _ = self._ensure_model(name)
-        except KeyError:
-            log.warning(
-                "stream %s requests unknown model '%s'; using default",
+        except Exception:
+            # Unknown name OR a model that fails to build (OOM, bug): either
+            # way confine the damage to this stream's model choice — a
+            # per-tick re-attempt of a failing multi-second init would
+            # starve every healthy stream.
+            log.exception(
+                "stream %s model '%s' unavailable; using default",
                 device_id, name,
             )
             self._bad_models.add(name)
@@ -495,7 +499,9 @@ class InferenceEngine:
         if self._annotations is None:
             return
         for det in detections:
-            if det.class_id < 0 or det.confidence <= 0.0:
+            if det.confidence <= 0.0:
+                continue
+            if det.class_id < 0 and not det.embedding:
                 continue
             req = pb.AnnotateRequest(
                 device_name=device_id,
@@ -504,6 +510,9 @@ class InferenceEngine:
                 object_type=det.class_name,
                 confidence=det.confidence,
                 object_bouding_box=det.box if det.HasField("box") else None,
+                # Re-ID feature vectors ride the proto's embedding field
+                # (AnnotateRequest.object_signature, video_streaming.proto:26)
+                object_signature=list(det.embedding),
                 ml_model=spec.name,
                 ml_model_version="0",
                 width=meta.width,

@@ -5,6 +5,7 @@ The TPU-native replacement for the distributed story in SURVEY.md §2.3/§2.4
 never had, all expressed as shardings over one `jax.sharding.Mesh`.
 """
 
+from . import pipeline
 from .distributed import initialize as initialize_distributed
 from .mesh import AXES, factor_mesh, make_mesh, single_device_mesh
 from .ring_attention import make_ring_attn_fn, ring_attention_local
@@ -16,7 +17,7 @@ from .train import TrainState, Trainer, cross_entropy_loss, make_trainer, with_r
 
 __all__ = [
     "AXES", "factor_mesh", "make_mesh", "single_device_mesh",
-    "initialize_distributed",
+    "initialize_distributed", "pipeline",
     "make_ring_attn_fn", "ring_attention_local",
     "DEFAULT_RULES", "batch_sharding", "param_shardings", "place_params",
     "replicated", "unbox",

@@ -10,6 +10,7 @@ axes name the parallelism dimensions:
 - ``sp``   sequence/context parallel (ring attention over tokens)
 - ``tp``   tensor parallel (heads / mlp width)
 - ``ep``   expert parallel (MoE experts)
+- ``pp``   pipeline parallel (layer stages — parallel/pipeline.py)
 
 Axes of size 1 are always legal, so single-chip and 256-chip builds share
 every code path: XLA inserts psum/all-gather/ppermute over ICI (intra-slice)
@@ -24,7 +25,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "fsdp", "sp", "tp", "ep")
+AXES = ("dp", "fsdp", "sp", "tp", "ep", "pp")
 
 
 def make_mesh(
@@ -33,11 +34,12 @@ def make_mesh(
     sp: int = 1,
     tp: int = 1,
     ep: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Mesh over explicit per-axis sizes; product must equal device count."""
     devices = list(devices if devices is not None else jax.devices())
-    shape = (dp, fsdp, sp, tp, ep)
+    shape = (dp, fsdp, sp, tp, ep, pp)
     need = int(np.prod(shape))
     if need != len(devices):
         raise ValueError(
