@@ -95,6 +95,30 @@ def main() -> None:
     np.asarray(single(jax.device_put(base)))
     e2e_ms = (time.perf_counter() - t0) * 1000.0
 
+    # capacity configuration: 64-stream bucket (XLA schedules bs64 ~3x
+    # better per frame than bs16 on v5e; engine buckets include 64)
+    fps64 = None
+    if backend == "tpu":
+        base64_dev = jax.device_put(
+            np.broadcast_to(base, (64 // streams,) + base.shape)
+            .reshape((64,) + base.shape[1:]).copy()
+        )
+
+        @jax.jit
+        def megastep64(b):
+            def body(carry, i):
+                _, _, _, valid = one_batch(b + i.astype(jnp.uint8))
+                return carry + valid.sum(), None
+            total, _ = jax.lax.scan(
+                body, jnp.zeros((), jnp.int32), jnp.arange(iters)
+            )
+            return total
+
+        np.asarray(megastep64(base64_dev))
+        t0 = time.perf_counter()
+        np.asarray(megastep64(base64_dev))
+        fps64 = 64 * iters / (time.perf_counter() - t0)
+
     print(json.dumps({
         "metric": f"yolov8n_640_detect_fps_{streams}x1080p_{backend}",
         "value": round(fps, 1),
@@ -104,6 +128,7 @@ def main() -> None:
         "frame_ms": round(batch_ms / streams, 3),
         "h2d_mbps": round(base.nbytes / 1e6 / h2d_s, 1),
         "e2e_tunnel_ms": round(e2e_ms, 1),
+        "fps_64stream_bucket": round(fps64, 1) if fps64 else None,
         "checksum": total,
     }))
 

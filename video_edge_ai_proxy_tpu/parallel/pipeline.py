@@ -33,7 +33,8 @@ def init_stages(rng: jax.Array, stage_module, example: jnp.ndarray, n_stages: in
     """Init one param tree per stage and stack them on a leading axis
     (shard it over ``pp`` with `place_stages`)."""
     rngs = jax.random.split(rng, n_stages)
-    trees = [jax.jit(stage_module.init)(r, example) for r in rngs]
+    jit_init = jax.jit(stage_module.init)   # one compile, n_stages calls
+    trees = [jit_init(r, example) for r in rngs]
     return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
 
 
