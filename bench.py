@@ -58,16 +58,17 @@ def main() -> None:
     @jax.jit
     def megastep(base_u8):
         """scan ITERS serving ticks; per-tick input perturbed on-device so
-        every iteration does real, distinct work."""
+        every iteration does real, distinct work. One definition serves
+        every batch size benched below."""
         def body(carry, i):
             frames = base_u8 + i.astype(jnp.uint8)      # wraps mod 256
-            boxes, scores, classes, valid = one_batch(frames)
-            return carry + valid.sum(), (scores.max(), valid.sum())
+            _, _, _, valid = one_batch(frames)
+            return carry + valid.sum(), None
 
-        total, (smax, vsum) = jax.lax.scan(
+        total, _ = jax.lax.scan(
             body, jnp.zeros((), jnp.int32), jnp.arange(iters)
         )
-        return total, smax[-1], vsum[-1]
+        return total
 
     rng = np.random.default_rng(0)
     base = rng.integers(0, 256, (streams,) + src_hw + (3,), dtype=np.uint8)
@@ -79,9 +80,9 @@ def main() -> None:
     h2d_s = time.perf_counter() - t0
 
     # warmup/compile, then timed run (single dispatch + tiny fetch)
-    np.asarray(megastep(base_dev)[0])
+    np.asarray(megastep(base_dev))
     t0 = time.perf_counter()
-    total = int(np.asarray(megastep(base_dev)[0]))
+    total = int(np.asarray(megastep(base_dev)))
     elapsed = time.perf_counter() - t0
 
     frames_done = streams * iters
@@ -96,27 +97,17 @@ def main() -> None:
     e2e_ms = (time.perf_counter() - t0) * 1000.0
 
     # capacity configuration: 64-stream bucket (XLA schedules bs64 ~3x
-    # better per frame than bs16 on v5e; engine buckets include 64)
+    # better per frame than bs16 on v5e; engine buckets include 64) —
+    # same megastep, bigger batch.
     fps64 = None
     if backend == "tpu":
+        reps = -(-64 // streams)
         base64_dev = jax.device_put(
-            np.broadcast_to(base, (64 // streams,) + base.shape)
-            .reshape((64,) + base.shape[1:]).copy()
+            np.tile(base, (reps, 1, 1, 1))[:64]
         )
-
-        @jax.jit
-        def megastep64(b):
-            def body(carry, i):
-                _, _, _, valid = one_batch(b + i.astype(jnp.uint8))
-                return carry + valid.sum(), None
-            total, _ = jax.lax.scan(
-                body, jnp.zeros((), jnp.int32), jnp.arange(iters)
-            )
-            return total
-
-        np.asarray(megastep64(base64_dev))
+        np.asarray(megastep(base64_dev))
         t0 = time.perf_counter()
-        np.asarray(megastep64(base64_dev))
+        np.asarray(megastep(base64_dev))
         fps64 = 64 * iters / (time.perf_counter() - t0)
 
     print(json.dumps({
