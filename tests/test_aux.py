@@ -190,3 +190,48 @@ class TestProfileEndpoint:
             pm.close()
             bus.close()
             storage.close()
+
+
+def test_train_then_deploy_checkpoint(tmp_path):
+    """Fine-tune -> save -> engine serves the trained params (the edge
+    retrain loop end to end)."""
+    import jax.numpy as jnp
+
+    from video_edge_ai_proxy_tpu import parallel
+    from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.utils.checkpoint import save_msgpack
+
+    spec = registry.get("tiny_mobilenet_v2")
+    mesh = parallel.make_mesh(dp=2, devices=jax.devices()[:2])
+    trainer = parallel.make_trainer(spec.build(), mesh, learning_rate=1e-2)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (4, 32, 32, 3), jnp.float32)
+    y = jnp.array([0, 1, 2, 3], jnp.int32)
+    with mesh:
+        state = trainer.init_state(rng, x[:2])
+        state, _ = trainer.train_step(
+            state, trainer.shard_batch(x), trainer.shard_batch(y)
+        )
+
+    ckpt = str(tmp_path / "trained.msgpack")
+    variables = {"params": jax.tree.map(np.asarray, state.params),
+                 **{k: jax.tree.map(np.asarray, v)
+                    for k, v in (state.aux or {}).items()}}
+    save_msgpack(ckpt, variables)
+
+    bus = MemoryFrameBus()
+    eng = InferenceEngine(
+        bus, EngineConfig(model="tiny_mobilenet_v2", checkpoint_path=ckpt)
+    )
+    eng.warmup()
+    # engine params == trained params (not the random init)
+    for got, want in zip(
+        jax.tree_util.tree_leaves(eng._variables["params"]),
+        jax.tree_util.tree_leaves(state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    out = eng._step((32, 32), 1)(
+        eng._variables, np.zeros((1, 32, 32, 3), np.uint8)
+    )
+    assert np.isfinite(np.asarray(out["top_probs"])).all()
+    bus.close()

@@ -317,3 +317,61 @@ class TestEndToEnd:
         f2 = fetch_one()
         assert f1 is not None and f2 is not None
         channel.close()
+
+
+@pytest.fixture()
+def engine_server(tmp_path, shm_dir):
+    """Full stack WITH the TPU engine: the flagship serving path."""
+    from video_edge_ai_proxy_tpu.serve.server import Server
+
+    cfg = Config()
+    cfg.bus.shm_dir = shm_dir
+    cfg.annotation.endpoint = "http://127.0.0.1:1/annotate"
+    cfg.engine.model = "tiny_mobilenet_v2"
+    cfg.engine.tick_ms = 20
+    cfg.engine.batch_buckets = (1, 2, 4)
+    srv = Server(cfg, data_dir=str(tmp_path), grpc_port=0, rest_port=0,
+                 enable_engine=True)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class TestInferenceEndToEnd:
+    """Flagship path: synthetic camera -> ingest -> bus -> engine ->
+    gRPC Inference stream (the loop the reference never closes)."""
+
+    def test_inference_stream(self, engine_server):
+        import urllib.request
+
+        rest = f"http://127.0.0.1:{engine_server._rest.bound_port}"
+        req = urllib.request.Request(
+            rest + "/api/v1/process",
+            data=json.dumps(
+                {"name": "cam1",
+                 "rtsp_endpoint": "test://pattern?w=32&h=32&fps=30&gop=10"}
+            ).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+
+        channel = grpc.insecure_channel(
+            f"127.0.0.1:{engine_server.bound_grpc_port}"
+        )
+        stub = pb_grpc.ImageStub(channel)
+        results = []
+        for r in stub.Inference(pb.InferenceRequest(), timeout=60):
+            results.append(r)
+            if len(results) >= 3:
+                break
+        assert len(results) >= 3
+        for r in results:
+            assert r.device_id == "cam1"
+            assert r.model == "tiny_mobilenet_v2"
+            assert len(r.detections) == 5          # top-5 classification
+            assert r.batch_size >= 1
+        # engine stats visible over REST
+        with urllib.request.urlopen(rest + "/api/v1/stats") as resp:
+            stats = json.loads(resp.read())
+        assert stats["engine"]["streams"]["cam1"]["frames"] >= 3

@@ -249,13 +249,7 @@ def _padded_t(t, block_q, block_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
 def _flash(block_q: int, block_k: int, interpret: bool, q, k, v):
-    t = q.shape[1]
-    tp = _padded_t(t, block_q, block_k)
-    out, _ = _flash_call(
-        _pack(q, tp), _pack(k, tp), _pack(v, tp),
-        block_q=block_q, block_k=block_k, true_t=t, interpret=interpret,
-    )
-    return _unpack(out, q.shape)
+    return _flash_fwd(block_q, block_k, interpret, q, k, v)[0]
 
 
 def _flash_fwd(block_q, block_k, interpret, q, k, v):
