@@ -193,3 +193,95 @@ class TestPipelineParallel:
         with pytest.raises(ValueError):
             pipeline.pipeline_apply(mesh, stage.apply, stacked, x,
                                     n_microbatches=3)
+
+
+class TestRoutedMoe:
+    def _cfg(self, cap=2.0):
+        return EncoderConfig(num_layers=1, dim=16, num_heads=2, mlp_dim=32,
+                             num_experts=4, moe_router="top1",
+                             capacity_factor=cap)
+
+    def test_forward_and_aux(self):
+        from video_edge_ai_proxy_tpu.models.transformer import EncoderBlock
+
+        block = EncoderBlock(self._cfg(), jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+        params = jax.jit(block.init)(jax.random.PRNGKey(1), x)
+        out, state = jax.jit(
+            lambda p, x: block.apply(p, x, mutable=["losses"])
+        )(params, x)
+        assert out.shape == x.shape
+        assert np.all(np.isfinite(np.asarray(out)))
+        aux = jax.tree_util.tree_leaves(state["losses"])[0]
+        # Switch aux is >= 1 (equals 1 at perfect balance)
+        assert float(aux) >= 0.99
+
+    def test_capacity_drops_tokens(self):
+        """With capacity_factor tiny, overflow tokens pass through as the
+        residual only (MoE contribution zero) — shapes stay static."""
+        from video_edge_ai_proxy_tpu.models.transformer import RoutedMoeMlp
+
+        moe = RoutedMoeMlp(self._cfg(cap=0.01), jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 16))
+        params = jax.jit(moe.init)(jax.random.PRNGKey(1), x)
+        out = jax.jit(lambda p, x: moe.apply(p, x))(params, x)
+        # cap = max(1, 16*0.01/4) = 1 slot/expert -> at most 4 non-zero rows
+        nonzero = np.abs(np.asarray(out)[0]).sum(axis=-1) > 1e-6
+        assert nonzero.sum() <= 4
+
+    def test_trains_with_ep_sharding(self):
+        mesh = parallel.make_mesh(dp=2, ep=4, devices=jax.devices())
+        cfg = dataclasses.replace(
+            tiny_videomae_config(num_classes=3),
+            encoder=self._cfg(),
+        )
+        model = VideoMAE(cfg)
+        trainer = parallel.make_trainer(model, mesh, learning_rate=1e-3)
+        rng = jax.random.PRNGKey(0)
+        clips = jax.random.normal(
+            rng, (4, cfg.num_frames, cfg.image_size, cfg.image_size, 3),
+            jnp.float32,
+        )
+        labels = jnp.array([0, 1, 2, 0], jnp.int32)
+        with mesh:
+            state = trainer.init_state(rng, clips[:2])
+            w1 = state.params["encoder"]["block0"]["mlp"]["w1"]
+            assert w1.sharding.spec[0] == "ep"
+            losses = []
+            for _ in range(4):
+                state, loss = trainer.train_step(
+                    state, trainer.shard_batch(clips), trainer.shard_batch(labels)
+                )
+                losses.append(float(loss))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    def test_trainer_objective_includes_aux(self):
+        """cross_entropy_loss must fold the sown switch aux into the loss."""
+        from video_edge_ai_proxy_tpu.models.transformer import EncoderConfig
+        from video_edge_ai_proxy_tpu.parallel.train import (
+            AUX_LOSS_WEIGHT, cross_entropy_loss,
+        )
+        import optax
+
+        cfg = dataclasses.replace(
+            tiny_videomae_config(num_classes=3), encoder=self._cfg(),
+        )
+        model = VideoMAE(cfg)
+        rng = jax.random.PRNGKey(0)
+        clips = jax.random.normal(
+            rng, (2, cfg.num_frames, cfg.image_size, cfg.image_size, 3),
+            jnp.float32,
+        )
+        labels = jnp.array([0, 1], jnp.int32)
+        params = jax.jit(model.init)(rng, clips)["params"]
+        total = cross_entropy_loss(model, params, None, clips, labels)
+        logits, sown = model.apply(
+            {"params": params}, clips, train=True, mutable=["losses"]
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        aux = sum(jnp.sum(a) for a in jax.tree_util.tree_leaves(sown["losses"]))
+        np.testing.assert_allclose(
+            float(total), float(ce + AUX_LOSS_WEIGHT * aux), rtol=1e-5
+        )

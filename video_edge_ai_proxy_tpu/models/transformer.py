@@ -39,6 +39,11 @@ class EncoderConfig:
     # axis carries the "expert" logical name (sharded over the mesh's ep
     # axis by parallel/sharding.py rules).
     num_experts: int = 0
+    # "soft" = dense mixture (all experts on all tokens, exact but E× FLOPs);
+    # "top1" = switch routing with static capacity (scale-out path).
+    moe_router: str = "soft"
+    # top1 only: per-expert slots = capacity_factor * tokens / num_experts.
+    capacity_factor: float = 1.25
 
 
 def default_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
@@ -111,6 +116,26 @@ class Mlp(nn.Module):
         return _dense(c.dim, ("mlp", "embed"), self.dtype, "fc2")(h)
 
 
+def _expert_weights(mod: nn.Module, cfg: EncoderConfig):
+    """The [E, d, mlp] / [E, mlp, d] expert stacks, shared by both MoE
+    variants (one definition of the 'expert' logical sharding axis)."""
+    w1 = mod.param(
+        "w1",
+        nn.with_logical_partitioning(
+            nn.initializers.xavier_uniform(), ("expert", "embed", "mlp")
+        ),
+        (cfg.num_experts, cfg.dim, cfg.mlp_dim), jnp.float32,
+    )
+    w2 = mod.param(
+        "w2",
+        nn.with_logical_partitioning(
+            nn.initializers.xavier_uniform(), ("expert", "mlp", "embed")
+        ),
+        (cfg.num_experts, cfg.mlp_dim, cfg.dim), jnp.float32,
+    )
+    return w1, w2
+
+
 class MoeMlp(nn.Module):
     """Soft mixture-of-experts MLP (expert-parallel demonstration path).
 
@@ -135,25 +160,74 @@ class MoeMlp(nn.Module):
             ),
             axis=-1,
         )                                                      # [B, T, E]
-        w1 = self.param(
-            "w1",
-            nn.with_logical_partitioning(
-                nn.initializers.xavier_uniform(), ("expert", "embed", "mlp")
-            ),
-            (e, c.dim, c.mlp_dim), jnp.float32,
-        ).astype(self.dtype)
-        w2 = self.param(
-            "w2",
-            nn.with_logical_partitioning(
-                nn.initializers.xavier_uniform(), ("expert", "mlp", "embed")
-            ),
-            (e, c.mlp_dim, c.dim), jnp.float32,
-        ).astype(self.dtype)
+        w1, w2 = _expert_weights(self, c)
+        w1, w2 = w1.astype(self.dtype), w2.astype(self.dtype)
         h = nn.gelu(jnp.einsum("btd,edm->betm", x, w1))
         if c.dropout:
             h = nn.Dropout(c.dropout)(h, deterministic=deterministic)
         y = jnp.einsum("betm,emd->betd", h, w2)
         return jnp.einsum("bte,betd->btd", gates.astype(self.dtype), y)
+
+
+class RoutedMoeMlp(nn.Module):
+    """Top-1 (switch) routed MoE MLP with static capacity.
+
+    Fully static shapes: each expert owns ``capacity`` slots; tokens beyond
+    an expert's capacity are dropped (contribute zero, standard switch
+    behavior). Dispatch is a scatter into an [E*C(+1), D] slot buffer and a
+    gather back — no [N, E, C] dispatch tensor, so memory stays O(N*D).
+    Expert weights carry the "expert" logical axis (ep sharding). The
+    load-balance auxiliary (Switch aux = E * sum(f_e * p_e)) is sown under
+    ('losses', 'moe_aux') for the trainer to add.
+    """
+
+    cfg: EncoderConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        c = self.cfg
+        e = c.num_experts
+        b, t, d = x.shape
+        n = b * t
+        cap = max(1, int(n / e * c.capacity_factor))
+
+        flat = x.reshape(n, d)
+        logits = _dense(e, ("embed", "expert_gate"), jnp.float32, "gate")(
+            flat.astype(jnp.float32)
+        )
+        gates = jax.nn.softmax(logits, axis=-1)            # [N, E]
+        gate_val = gates.max(axis=-1)                      # [N]
+        expert_idx = gates.argmax(axis=-1)                 # [N]
+
+        # position of each token within its expert's queue
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)
+        pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot    # [N, E]
+        pos_tok = pos.sum(axis=-1)                         # [N]
+        keep = pos_tok < cap
+        # dropped tokens land in a sentinel row past the real slots
+        slot = jnp.where(keep, expert_idx * cap + pos_tok, e * cap)
+
+        buf = jnp.zeros((e * cap + 1, d), self.dtype).at[slot].add(
+            jnp.where(keep[:, None], flat, 0).astype(self.dtype)
+        )
+        expert_in = buf[: e * cap].reshape(e, cap, d)
+
+        w1, w2 = _expert_weights(self, c)
+        w1, w2 = w1.astype(self.dtype), w2.astype(self.dtype)
+        h = nn.gelu(jnp.einsum("ecd,edm->ecm", expert_in, w1))
+        if c.dropout:
+            h = nn.Dropout(c.dropout)(h, deterministic=deterministic)
+        y = jnp.einsum("ecm,emd->ecd", h, w2).reshape(e * cap, d)
+        y = jnp.concatenate([y, jnp.zeros((1, d), y.dtype)], axis=0)
+
+        out = y[slot] * (gate_val * keep)[:, None].astype(self.dtype)
+
+        # Switch load-balance aux: E * sum_e(fraction_routed_e * mean_prob_e)
+        frac = onehot.astype(jnp.float32).mean(axis=0)
+        prob = gates.mean(axis=0)
+        self.sow("losses", "moe_aux", e * jnp.sum(frac * prob))
+        return out.reshape(b, t, d)
 
 
 class EncoderBlock(nn.Module):
@@ -167,7 +241,16 @@ class EncoderBlock(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x.astype(jnp.float32)).astype(self.dtype)
         x = x + SelfAttention(c, self.dtype, self.attn_fn, name="attn")(h, deterministic)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x.astype(jnp.float32)).astype(self.dtype)
-        mlp_cls = MoeMlp if c.num_experts else Mlp
+        if not c.num_experts:
+            mlp_cls = Mlp
+        elif c.moe_router == "top1":
+            mlp_cls = RoutedMoeMlp
+        elif c.moe_router == "soft":
+            mlp_cls = MoeMlp
+        else:
+            raise ValueError(
+                f"unknown moe_router {c.moe_router!r}; expected 'soft' or 'top1'"
+            )
         x = x + mlp_cls(c, self.dtype, name="mlp")(h, deterministic)
         return x
 

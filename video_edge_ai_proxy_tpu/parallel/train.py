@@ -60,13 +60,27 @@ class Trainer:
         return jax.device_put(x, shd.batch_sharding(self.mesh, x.ndim))
 
 
+# Weight on sown auxiliary objectives (e.g. the switch-MoE load-balance
+# loss) — the Switch Transformer default.
+AUX_LOSS_WEIGHT = 0.01
+
+
 def cross_entropy_loss(model: nn.Module, params, aux, batch, labels) -> jnp.ndarray:
     # BatchNorm models fine-tune with frozen statistics (train=True would
     # try to mutate the immutable batch_stats collection); stat-less models
     # (ViT family) get train=True so dropout stays active.
     train = not (aux and "batch_stats" in aux)
-    logits = model.apply({"params": params, **(aux or {})}, batch, train=train)
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    # mutable=["losses"] collects nn.sow'd auxiliaries (no-op for models
+    # that sow nothing) so e.g. routed-MoE balance pressure reaches grads.
+    logits, sown = model.apply(
+        {"params": params, **(aux or {})}, batch, train=train,
+        mutable=["losses"],
+    )
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    aux_terms = jax.tree_util.tree_leaves(sown.get("losses", {}))
+    if aux_terms:
+        loss = loss + AUX_LOSS_WEIGHT * sum(jnp.sum(a) for a in aux_terms)
+    return loss
 
 
 def make_trainer(
