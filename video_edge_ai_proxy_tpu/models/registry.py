@@ -99,6 +99,12 @@ register(ModelSpec(
     input_size=224, preprocess="clip", kind="video", clip_len=8,
     description="config 5: 8-frame clip action recognition",
 ))
+register(ModelSpec(
+    "videomae_b_long", lambda: VideoMAE(VideoMAEConfig(num_frames=64)),
+    input_size=224, preprocess="clip", kind="video", clip_len=64,
+    description="long-context clips: 64 frames -> 6272 tokens, attention "
+                "auto-dispatches to the Pallas flash kernel",
+))
 
 # --- tiny twins (tests / CI on CPU) --------------------------------------
 
