@@ -257,3 +257,29 @@ class TestEngine:
         _publish(bus, "cam1", w=32, h=32)
         groups = eng._collector.collect()
         assert groups[0].model == "tiny_mobilenet_v2"
+
+    def test_prewarm_compiles_configured_geometries(self, bus):
+        cfg = EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=1000,
+            prewarm=[[32, 32, 2], [64, 64, 1]],
+        )
+        eng = InferenceEngine(bus, cfg)
+        eng.start()
+        try:
+            assert ("tiny_mobilenet_v2", (32, 32), 2) in eng._step_cache
+            assert ("tiny_mobilenet_v2", (64, 64), 1) in eng._step_cache
+        finally:
+            eng.stop()
+
+    def test_prewarm_bad_entries_do_not_abort_boot(self, bus):
+        cfg = EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=1000,
+            prewarm=[[32, 32], [32, 32, 7], [32, 32, 1]],  # short, off-bucket, good
+        )
+        eng = InferenceEngine(bus, cfg)
+        eng.start()   # must not raise
+        try:
+            assert ("tiny_mobilenet_v2", (32, 32), 1) in eng._step_cache
+            assert not any(k[2] == 7 for k in eng._step_cache)
+        finally:
+            eng.stop()

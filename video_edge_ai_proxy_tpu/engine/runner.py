@@ -295,6 +295,22 @@ class InferenceEngine:
     def start(self) -> None:
         if self._model is None:
             self.warmup()
+        for geom in self._cfg.prewarm:
+            # Log-and-continue like every other per-item path here: a bad
+            # prewarm entry must not abort server boot, and buckets must be
+            # ones the collector can actually dispatch (post mesh filter).
+            try:
+                h, w, bucket = (int(v) for v in geom)
+                if bucket not in self._collector._buckets:
+                    log.warning(
+                        "prewarm bucket %d not in effective buckets %s; "
+                        "skipping", bucket, self._collector._buckets,
+                    )
+                    continue
+                log.info("prewarming program for %dx%d bucket=%d", h, w, bucket)
+                self.compile_for((h, w), bucket)
+            except Exception:
+                log.exception("prewarm entry %r failed; continuing", geom)
         self._thread = threading.Thread(
             target=self._run, name="tpu-engine", daemon=True
         )

@@ -88,6 +88,10 @@ class EngineConfig:
     # msgpack params checkpoint; empty = random init (no pretrained weights
     # are bundled). Loaded at warmup so restart = load + compile cache.
     checkpoint_path: str = ""
+    # Geometries to compile at boot instead of on first frame: list of
+    # [height, width, bucket]. Big programs (e.g. ViT at bucket 32) can take
+    # minutes to compile; prewarming moves that cost out of the hot path.
+    prewarm: list = field(default_factory=list)
 
 
 @dataclass
