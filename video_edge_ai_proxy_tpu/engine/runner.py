@@ -190,6 +190,7 @@ class InferenceEngine:
                 buckets,
             )
         self._models[self._spec.name] = (self._spec, self._model, self._variables)
+        self._buckets = buckets   # effective (mesh-filtered) buckets
         self._collector = Collector(
             self._bus,
             buckets=buckets,
@@ -301,10 +302,10 @@ class InferenceEngine:
             # ones the collector can actually dispatch (post mesh filter).
             try:
                 h, w, bucket = (int(v) for v in geom)
-                if bucket not in self._collector._buckets:
+                if bucket not in self._buckets:
                     log.warning(
                         "prewarm bucket %d not in effective buckets %s; "
-                        "skipping", bucket, self._collector._buckets,
+                        "skipping", bucket, self._buckets,
                     )
                     continue
                 log.info("prewarming program for %dx%d bucket=%d", h, w, bucket)

@@ -21,12 +21,16 @@ pipelined trunk (the standard megatron-style split).
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .train import TrainState
 
 
 def init_stages(rng: jax.Array, stage_module, example: jnp.ndarray, n_stages: int):
@@ -118,3 +122,57 @@ def pipeline_apply(
         return outs.reshape(x.shape)
 
     return run(stacked_params, x)
+
+
+@dataclass
+class PipelineTrainer:
+    """Trains a pipelined trunk end to end: embed/head replicated closures
+    around the staged middle, optimizer state sharded like the params
+    (stage axis on pp), gradients flowing back through the ppermute chain.
+    """
+
+    mesh: Mesh
+    apply_fn: Callable
+    tx: optax.GradientTransformation
+    n_microbatches: int
+
+    def init_state(self, stacked_params) -> TrainState:
+        placed = place_stages(self.mesh, stacked_params)
+        opt_state = jax.jit(self.tx.init)(placed)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=placed,
+                          opt_state=opt_state)
+
+    def make_step(self, loss_of_output: Callable[[jnp.ndarray, Any], jnp.ndarray]):
+        """Build the jitted train step. ``loss_of_output(trunk_out, labels)``
+        maps the pipelined trunk's output (e.g. [B, T, D] tokens) plus
+        labels to a scalar — pooling/head logic lives there, replicated."""
+
+        def step(state: TrainState, x, labels):
+            def loss_fn(params):
+                out = pipeline_apply(
+                    self.mesh, self.apply_fn, params, x, self.n_microbatches
+                )
+                return loss_of_output(out, labels)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            updates, opt_state = self.tx.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(step=state.step + 1, params=params,
+                              opt_state=opt_state), loss
+
+        return jax.jit(step, donate_argnums=(0,))
+
+
+def make_pipeline_trainer(
+    mesh: Mesh,
+    apply_fn: Callable,
+    n_microbatches: int,
+    learning_rate: float = 1e-3,
+    weight_decay: float = 0.0,
+) -> PipelineTrainer:
+    tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    return PipelineTrainer(
+        mesh=mesh, apply_fn=apply_fn, tx=tx, n_microbatches=n_microbatches
+    )
