@@ -1,0 +1,175 @@
+"""Self-training loop: the full edge circle in one script.
+
+The engine's on-device detections become pseudo-labels for fine-tuning the
+same detector on the site's own archived footage — the capability the
+reference's architecture gestures at (frames out, annotations back in) but
+never closes. No reference counterpart.
+
+    # server running with --engine and buffer.on_disk, cameras added
+    python examples/self_train.py --archive /data/chrysalis/archive \
+        --host 127.0.0.1:50001 --steps 50 --out /data/chrysalis/yolo.msgpack
+
+Then point `engine.checkpoint_path` at the output and restart: the engine
+serves the fine-tuned weights.
+"""
+
+import argparse
+import sys
+import time
+
+import grpc
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def source_dims(host: str, device_ids):
+    """Per-device (w, h) from one VideoLatestImage frame each — engine boxes
+    are in source pixels and must be rescaled into training space."""
+    from video_edge_ai_proxy_tpu.proto import pb, pb_grpc
+
+    stub = pb_grpc.ImageStub(grpc.insecure_channel(host))
+    dims = {}
+    for device_id in device_ids:
+        def reqs(d=device_id):
+            for _ in range(60):
+                yield pb.VideoFrameRequest(device_id=d)
+                time.sleep(0.05)
+        try:
+            for frame in stub.VideoLatestImage(reqs(), timeout=15):
+                if frame.width:
+                    dims[device_id] = (frame.width, frame.height)
+                    break
+        except grpc.RpcError:
+            pass
+    return dims
+
+
+def collect_pseudo_labels(host: str, min_conf: float, want: int,
+                          deadline_s: float = 120.0):
+    """Stream engine detections; returns list of (device_id, box_xyxy_px,
+    class_id) in SOURCE pixel coordinates. Bounded by a wall-clock deadline
+    so a quiet scene can't hang the script."""
+    from video_edge_ai_proxy_tpu.proto import pb, pb_grpc
+
+    stub = pb_grpc.ImageStub(grpc.insecure_channel(host))
+    labels = []
+    t0 = time.monotonic()
+    try:
+        for result in stub.Inference(pb.InferenceRequest(), timeout=deadline_s):
+            for det in result.detections:
+                if det.confidence < min_conf or not det.HasField("box"):
+                    continue
+                b = det.box
+                labels.append((result.device_id,
+                               [b.left, b.top, b.left + b.width, b.top + b.height],
+                               det.class_id))
+            if len(labels) >= want or time.monotonic() - t0 > deadline_s:
+                break
+    except grpc.RpcError as err:
+        print("  inference stream ended:", err.code())
+    return labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--archive", required=True)
+    p.add_argument("--host", default="127.0.0.1:50001")
+    p.add_argument("--model", default="yolov8n")
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--size", type=int, default=640)
+    p.add_argument("--min_conf", type=float, default=0.5)
+    p.add_argument("--max_boxes", type=int, default=32)
+    p.add_argument("--labels_wanted", type=int, default=500)
+    p.add_argument("--out", default="/tmp/self_trained.msgpack")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from video_edge_ai_proxy_tpu import parallel
+    from video_edge_ai_proxy_tpu.data import Loader, SegmentDataset
+    from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.models.detect_loss import make_detection_loss_fn
+    from video_edge_ai_proxy_tpu.utils.checkpoint import save_msgpack
+
+    print("collecting pseudo-labels from the live engine ...")
+    pseudo = collect_pseudo_labels(args.host, args.min_conf, args.labels_wanted)
+    print(f"  {len(pseudo)} boxes collected")
+    if not pseudo:
+        print("no qualifying detections; lower --min_conf or check the engine")
+        return
+
+    # Rescale boxes from source pixels into the training frame space
+    # (SegmentDataset resizes every frame to --size x --size).
+    dims = source_dims(args.host, sorted({d for d, _, _ in pseudo}))
+    pool = []
+    for device_id, box, cid in pseudo:
+        if device_id not in dims:
+            continue
+        sw, sh = dims[device_id]
+        sx, sy = args.size / sw, args.size / sh
+        pool.append(([box[0] * sx, box[1] * sy, box[2] * sx, box[3] * sy], cid))
+    if not pool:
+        print("no streams answered a frame request; cannot scale boxes")
+        return
+    # Example-scope simplification: one pooled label set stamped onto every
+    # archived frame (real deployments join on (device, frame_packet)).
+
+    spec = registry.get(args.model)
+    cfg = spec.build().cfg
+    mesh = parallel.factor_mesh()
+    trainer = parallel.make_trainer(
+        spec.build(), mesh, learning_rate=1e-4,
+        loss_fn=make_detection_loss_fn(cfg),
+    )
+    ds = SegmentDataset(args.archive, size=(args.size, args.size))
+    if not len(ds):
+        print("no archived segments found; enable buffer.on_disk first")
+        return
+
+    def targets_for(batch_n):
+        m = args.max_boxes
+        boxes = np.zeros((batch_n, m, 4), np.float32)
+        labels = np.zeros((batch_n, m), np.int32)
+        mask = np.zeros((batch_n, m), bool)
+        for i in range(batch_n):
+            for j, (bx, cid) in enumerate(pool[: m]):
+                boxes[i, j] = bx
+                labels[i, j] = cid
+                mask[i, j] = True
+        return {"boxes": jnp.asarray(boxes), "labels": jnp.asarray(labels),
+                "mask": jnp.asarray(mask)}
+
+    rng = jax.random.PRNGKey(0)
+    state = None
+    step_count = 0
+    with mesh:
+        for batch in Loader(ds, batch_size=args.batch):
+            x = jnp.asarray(batch.astype(np.float32) / 255.0)
+            if state is None:
+                state = trainer.init_state(rng, x[:1])
+            state, loss = trainer.train_step(
+                state, trainer.shard_batch(x),
+                jax.tree.map(trainer.shard_batch, targets_for(x.shape[0])),
+            )
+            step_count += 1
+            if step_count % 10 == 0:
+                print(f"  step {step_count}: loss {float(loss):.3f}")
+            if step_count >= args.steps:
+                break
+
+    if state is None:
+        print("archive produced no full batches; lower --batch or archive more")
+        return
+    variables = {"params": jax.tree.map(np.asarray, state.params),
+                 **{k: jax.tree.map(np.asarray, v)
+                    for k, v in (state.aux or {}).items()}}
+    save_msgpack(args.out, variables)
+    print(f"saved fine-tuned params to {args.out}; set engine.checkpoint_path "
+          "to serve them")
+
+
+if __name__ == "__main__":
+    main()
