@@ -19,7 +19,6 @@ before draining the previous one, so H2D/compute/D2H overlap across ticks
 
 from __future__ import annotations
 
-import functools
 import os
 import queue
 import threading

@@ -18,7 +18,7 @@ Components (standard YOLOv8 formulation):
 
 from __future__ import annotations
 
-from typing import Dict, Sequence, Tuple
+from typing import Dict, Tuple
 
 import flax.linen as nn
 import jax

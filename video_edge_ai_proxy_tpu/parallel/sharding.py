@@ -9,7 +9,7 @@ pick a mesh, annotate shardings, let XLA insert the collectives.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax

@@ -36,9 +36,9 @@ from ..bus import FrameBus
 from ..bus.interface import KEY_KEYFRAME_ONLY_PREFIX, KEY_LAST_ACCESS_PREFIX
 from ..ingest.worker import KEY_STATUS_PREFIX
 from ..utils.logging import get_logger
-from ..utils.parsing import default_device_id, parse_rtmp_key
+from ..utils.parsing import default_device_id
 from .models import PREFIX_RTSP_PROCESS, ProcessState, RTMPStreamStatus, StreamProcess
-from .storage import NotFound, Storage
+from .storage import Storage
 
 log = get_logger("serve.process_manager")
 

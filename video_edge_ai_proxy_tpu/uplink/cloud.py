@@ -7,10 +7,8 @@ toggle PUT)."""
 
 from __future__ import annotations
 
-import json
 import urllib.error
 import urllib.request
-from typing import Optional
 
 from ..proto import pb
 from ..utils.logging import get_logger
