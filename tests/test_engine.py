@@ -100,6 +100,62 @@ def _engine(bus, model, annotations=None, **cfg_kw):
     return eng
 
 
+class TestServingStep:
+    def test_serving_decode_matches_decoded_path(self):
+        """decode="serving" (logit-space reduction, the engine's detect
+        contract) must reproduce decode=True (sigmoid then reduce): sigmoid
+        is monotone, so per-anchor class choice and score agree. Compared
+        pre-NMS — NMS amplifies 1-ulp ties between sigmoid(max(x)) and
+        max(sigmoid(x)) chaotically on random weights; near-tied argmaxes
+        are masked for the same reason."""
+        import jax
+        import jax.numpy as jnp
+
+        from video_edge_ai_proxy_tpu.ops.preprocess import preprocess_letterbox
+
+        spec = registry.get("tiny_yolov8")
+        model, variables = spec.init_params(jax.random.PRNGKey(0))
+
+        rng = np.random.default_rng(11)
+        frames = rng.integers(0, 256, (2, 48, 96, 3), dtype=np.uint8)
+        x, _ = preprocess_letterbox(jnp.asarray(frames), spec.input_size)
+
+        # decoded path (sigmoid everywhere, then reduce)
+        boxes_old, probs = jax.jit(model.apply)(variables, x)
+        old_scores = np.asarray(probs.max(axis=-1), np.float32)
+        old_ids = np.asarray(probs.argmax(axis=-1))
+        top2 = np.sort(np.asarray(probs, np.float32), axis=-1)[..., -2:]
+        well_separated = (top2[..., 1] - top2[..., 0]) > 1e-5
+
+        # serving path (reduce over logits, sigmoid the winner)
+        boxes_new, max_logit, new_ids = jax.jit(
+            lambda v, x: model.apply(v, x, decode="serving"))(variables, x)
+        new_scores = np.asarray(jax.nn.sigmoid(max_logit), np.float32)
+
+        np.testing.assert_allclose(new_scores, old_scores, atol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(new_ids)[well_separated], old_ids[well_separated])
+        np.testing.assert_allclose(
+            np.asarray(boxes_new), np.asarray(boxes_old), atol=1e-3)
+
+    def test_approx_topk_path_runs_and_is_sorted(self):
+        """approx_max_k candidate selection (opt-in; exact selection is the
+        default everywhere) must produce valid, score-sorted output."""
+        import jax.numpy as jnp
+
+        from video_edge_ai_proxy_tpu.ops.nms import batched_nms
+
+        rng = np.random.default_rng(12)
+        boxes = jnp.asarray(rng.uniform(0, 640, (2, 512, 4)), jnp.float32)
+        scores = jnp.asarray(rng.uniform(0, 1, (2, 512)), jnp.float32)
+        cls = jnp.asarray(rng.integers(0, 8, (2, 512)), jnp.int32)
+        ob, osc, ocl, val = batched_nms(
+            boxes, scores, cls, max_candidates=64, approx_topk=True)
+        sc = np.asarray(osc)
+        assert (np.diff(sc, axis=-1) <= 1e-6).all()     # sorted desc
+        assert np.asarray(val).any()
+
+
 class TestEngine:
     def test_detect_end_to_end(self, bus):
         bus.create_stream("cam1", 64 * 64 * 3)

@@ -170,9 +170,21 @@ class YOLOv8(nn.Module):
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = False, decode: bool = True):
-        """[B, S, S, 3] normalized RGB -> decoded (boxes, scores), or raw
-        per-level logits with decode=False (the detection-loss path)."""
+    def __call__(self, x: jnp.ndarray, train: bool = False, decode=True):
+        """[B, S, S, 3] normalized RGB -> head output, by ``decode`` mode:
+
+        - ``True``: decoded ``(boxes [B,A,4], scores [B,A,C])``, scores are
+          per-class sigmoid probabilities (the stable public contract).
+        - ``False``: raw per-level ``(box_logits, cls_logits)`` pairs (the
+          detection-loss path).
+        - ``"serving"``: ``(boxes [B,A,4], max_logit [B,A], cls_ids [B,A])``
+          — class reduction in logit space. Sigmoid is monotone, so
+          ``sigmoid(max_logit)`` equals the decode=True best-class score and
+          ``cls_ids`` its argmax, but the sigmoid over all A×C logits never
+          happens; the serving engine applies it to the A winners only.
+          Every ``kind="detect"`` registry model supports this mode — it is
+          the contract `engine/runner.py` serves detectors through.
+        """
         c = self.cfg
         d, ch = c.depth, c.ch
         x = x.astype(self.dtype)
@@ -208,12 +220,17 @@ class YOLOv8(nn.Module):
             c, [f.shape[-1] for f in levels], self.dtype, name="detect"
         )(levels, train)
 
-        if not decode:
+        if decode is False:
             return head_out
 
-        boxes, scores = [], []
+        boxes, cls_flat = [], []
         for (box_l, cls_l), stride in zip(head_out, c.strides):
             boxes.append(decode_level(box_l, stride, c.reg_max))
             b_, h_, w_, _ = cls_l.shape
-            scores.append(nn.sigmoid(cls_l.reshape(b_, h_ * w_, c.num_classes)))
-        return jnp.concatenate(boxes, axis=1), jnp.concatenate(scores, axis=1)
+            cls_flat.append(cls_l.reshape(b_, h_ * w_, c.num_classes))
+        boxes = jnp.concatenate(boxes, axis=1)
+        cls_flat = jnp.concatenate(cls_flat, axis=1)
+        if decode == "serving":
+            return (boxes, cls_flat.max(axis=-1),
+                    cls_flat.argmax(axis=-1).astype(jnp.int32))
+        return boxes, nn.sigmoid(cls_flat)
