@@ -13,14 +13,19 @@ from .sharding import (
     DEFAULT_RULES, batch_sharding, param_shardings, place_params, replicated,
     unbox,
 )
-from .train import TrainState, Trainer, cross_entropy_loss, make_trainer, with_ring_attention
+from .train import (
+    TrainState, Trainer, cross_entropy_loss, make_trainer,
+    with_ring_attention, with_ulysses_attention,
+)
+from .ulysses import make_ulysses_attn_fn, ulysses_attention_local
 
 __all__ = [
     "AXES", "factor_mesh", "make_mesh", "single_device_mesh",
     "initialize_distributed", "pipeline",
     "make_ring_attn_fn", "ring_attention_local",
+    "make_ulysses_attn_fn", "ulysses_attention_local",
     "DEFAULT_RULES", "batch_sharding", "param_shardings", "place_params",
     "replicated", "unbox",
     "TrainState", "Trainer", "cross_entropy_loss", "make_trainer",
-    "with_ring_attention",
+    "with_ring_attention", "with_ulysses_attention",
 ]

@@ -88,33 +88,41 @@ def ring_attention_local(q, k, v, axis_name: str = "sp", true_t: Optional[int] =
     return out.astype(q.dtype)
 
 
-def make_ring_attn_fn(
+def make_seq_parallel_attn_fn(
     mesh: Mesh,
+    choose_local,
     batch_axis: Optional[str] = "dp",
     seq_axis: str = "sp",
     head_axis: Optional[str] = "tp",
 ):
-    """Build an `attn_fn` for `models/transformer.Encoder`: global
-    [B, T, H, D] in/out, ring attention over ``seq_axis`` inside, batch and
-    heads partitioned over ``batch_axis``/``head_axis``.
+    """Shared wrapper for sequence-parallel attention variants: global
+    [B, T, H, D] in/out, sequence sharded over ``seq_axis`` inside the
+    shard_map, batch and heads partitioned over ``batch_axis``/``head_axis``.
 
-    Sequences whose length is not divisible by the ring size (e.g. ViT's
-    196 patches + 1 cls token) are right-padded before the shard_map and
-    the pad keys masked out of the softmax, so the result is bit-equal to
-    dense attention on the unpadded sequence."""
+    ``choose_local(h_local)`` picks the per-shard attention body (ring,
+    all-to-all, ...) given the per-device head count after head-axis
+    sharding — the one place the variants differ. The padding/fallback
+    subtleties live here exactly once:
+
+    - Sequences whose length is not divisible by the ``seq_axis`` size
+      (e.g. ViT's 196 patches + 1 cls token) are right-padded before the
+      shard_map and the pad keys masked out of the softmax, so the result
+      is bit-equal to dense attention on the unpadded sequence.
+    - Axes that don't divide the actual (static) shape fall back to
+      replication — e.g. model.init traces with batch 1 under dp=2.
+    """
     n_sp = mesh.shape[seq_axis]
 
     def attn(q, k, v):
-        # Axes that don't divide the actual (static) shape fall back to
-        # replication — e.g. model.init traces with batch 1 under dp=2.
         ba = batch_axis if batch_axis and q.shape[0] % mesh.shape[batch_axis] == 0 else None
         ha = head_axis if head_axis and q.shape[2] % mesh.shape[head_axis] == 0 else None
+        h_local = q.shape[2] // (mesh.shape[head_axis] if ha else 1)
         spec = P(ba, seq_axis, ha, None)
         t = q.shape[1]
         t_pad = -(-t // n_sp) * n_sp
         sharded = shard_map(
             functools.partial(
-                ring_attention_local, axis_name=seq_axis,
+                choose_local(h_local), axis_name=seq_axis,
                 true_t=None if t_pad == t else t,
             ),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -127,3 +135,18 @@ def make_ring_attn_fn(
         return out[:, :t] if t_pad != t else out
 
     return attn
+
+
+def make_ring_attn_fn(
+    mesh: Mesh,
+    batch_axis: Optional[str] = "dp",
+    seq_axis: str = "sp",
+    head_axis: Optional[str] = "tp",
+):
+    """Build a ring-attention `attn_fn` for `models/transformer.Encoder`
+    (see `make_seq_parallel_attn_fn` for the shared padding/fallback
+    behavior)."""
+    return make_seq_parallel_attn_fn(
+        mesh, lambda h_local: ring_attention_local,
+        batch_axis=batch_axis, seq_axis=seq_axis, head_axis=head_axis,
+    )

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from . import sharding as shd
 from .ring_attention import make_ring_attn_fn
+from .ulysses import make_ulysses_attn_fn
 
 
 @struct.dataclass
@@ -119,3 +120,9 @@ def with_ring_attention(model_cls, cfg, mesh: Mesh, dtype=jnp.bfloat16):
     """Instantiate an encoder-family model with sequence-parallel attention
     over the mesh's ``sp`` axis (ViT / VideoMAE both take `attn_fn`)."""
     return model_cls(cfg, dtype, attn_fn=make_ring_attn_fn(mesh))
+
+
+def with_ulysses_attention(model_cls, cfg, mesh: Mesh, dtype=jnp.bfloat16):
+    """Same hook, all-to-all (Ulysses) sequence parallelism — see
+    `ulysses.py` for the ring-vs-all-to-all trade-off."""
+    return model_cls(cfg, dtype, attn_fn=make_ulysses_attn_fn(mesh))
