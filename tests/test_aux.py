@@ -96,6 +96,7 @@ class TestRestAux:
         rest = RestServer(pm, settings, port=0, engine=eng, annotations=ann)
         rest.start()
         yield rest
+        eng.stop()
         rest.stop()
         pm.close()
         bus.close()
@@ -120,6 +121,44 @@ class TestRestAux:
         status, body = self._get(server, "/api/v1/rtspscan")
         assert status == 200
         assert body.strip() == b"[]"
+
+    def test_healthz_degraded_before_engine_start(self, server):
+        """Engine constructed but its tick thread not running -> the
+        liveness probe must refuse readiness (503 'degraded')."""
+        import json
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            self._get(server, "/healthz")
+        assert exc.value.code == 503
+        data = json.loads(exc.value.read())
+        assert data["status"] == "degraded"
+        assert data["engine"]["engine_thread_alive"] is False
+
+    def test_healthz_ok_with_engine_running(self, server):
+        """Running engine: 200 with TPU-side health fields (SURVEY §5.3 —
+        device liveness + tick liveness + compile-cache visibility)."""
+        import json
+
+        server.engine.start()
+        deadline = time.time() + 10
+        status = body = None
+        while time.time() < deadline:
+            try:
+                status, body = self._get(server, "/healthz")
+                break
+            except Exception:
+                time.sleep(0.2)
+        if status is None:
+            pytest.fail("healthz never returned 200 within 10s")
+        assert status == 200
+        data = json.loads(body)
+        assert data["status"] == "ok"
+        eng = data["engine"]
+        assert eng["engine_thread_alive"] is True
+        assert eng["device_ok"] is True
+        assert eng["tick_age_s"] is not None
+        assert data["workers"] == {"running": 0, "total": 0}
 
     def test_portal_served_at_root(self, server):
         status, body = self._get(server, "/")

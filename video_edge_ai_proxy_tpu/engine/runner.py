@@ -145,6 +145,10 @@ class InferenceEngine:
         self._profile_lock = threading.Lock()
         self.ticks = 0
         self.batches = 0
+        self.last_tick_monotonic = 0.0
+        self._probe_cache: tuple = (0.0, None)   # (monotonic, ok | None)
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_fn = None                    # jitted once, reused
 
     # -- lifecycle --
 
@@ -360,6 +364,76 @@ class InferenceEngine:
     def stats(self) -> Dict[str, StreamStats]:
         return dict(self._stats)
 
+    def _run_probe(self) -> None:
+        """Device round-trip on a dedicated thread; writes the cache when
+        (if) the runtime answers."""
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            if self._probe_fn is None:
+                self._probe_fn = jax.jit(jnp.add)
+            ok = int(self._probe_fn(jnp.int32(1), jnp.int32(1))) == 2
+        except Exception:
+            log.exception("device health probe failed")
+            ok = False
+        self._probe_cache = (time.monotonic(), ok)
+
+    def health(self, probe_ttl_s: float = 5.0,
+               probe_wait_s: float = 2.0) -> dict:
+        """TPU-side health (SURVEY.md §5.3 — the rebuild adds device
+        liveness and compile-cache warmth on top of the reference's
+        container-level health): engine-thread liveness, last-tick age, a
+        round-trip device probe, and how many programs are compiled.
+
+        The probe (a tiny jitted add) runs on ONE dedicated thread and its
+        result is cached ``probe_ttl_s`` — a wedged runtime must neither
+        leak a new blocked thread per poll nor hang the caller, so polls
+        wait at most ``probe_wait_s`` and a probe that cannot answer by
+        then reports ``device_ok=False`` until it does.
+
+        ``stale`` compares the last completed tick against
+        cfg.health_stale_after_s, which must stay larger than any
+        legitimate in-tick XLA compile (first frame of a new geometry
+        compiles inside the tick; see cfg.prewarm to move that to boot) —
+        it flags a wedged loop, not a busy one.
+        """
+        import jax
+
+        alive = self._thread is not None and self._thread.is_alive()
+        now = time.monotonic()
+        age = (now - self.last_tick_monotonic) if self.last_tick_monotonic else None
+        ts, ok = self._probe_cache
+        if (ok is None or now - ts > probe_ttl_s) and (
+            self._probe_thread is None or not self._probe_thread.is_alive()
+        ):
+            self._probe_thread = threading.Thread(
+                target=self._run_probe, name="tpu-health-probe", daemon=True
+            )
+            self._probe_thread.start()
+        if self._probe_thread is not None and self._probe_thread.is_alive():
+            self._probe_thread.join(timeout=probe_wait_s)
+        _, ok = self._probe_cache
+        if self._probe_thread is not None and self._probe_thread.is_alive():
+            # Probe outstanding past its wait budget: the runtime is not
+            # answering. A stale cached success must not mask that — report
+            # unhealthy until the probe actually returns.
+            ok = False
+        stale_after = self._cfg.health_stale_after_s
+        stale = age is not None and age > stale_after
+        return {
+            "healthy": bool(alive and ok and not stale),
+            "engine_thread_alive": alive,
+            "tick_age_s": round(age, 3) if age is not None else None,
+            "tick_stale": stale,
+            "device_ok": bool(ok),
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "programs_compiled": len(self._step_cache),
+            "model": self._spec.name if self._spec else None,
+            "ticks": self.ticks,
+        }
+
     # -- compiled step construction --
 
     def compile_for(self, src_hw: tuple, bucket: int) -> None:
@@ -427,6 +501,7 @@ class InferenceEngine:
                 log.exception("engine tick failed; continuing")
                 inflight = None
             self.ticks += 1
+            self.last_tick_monotonic = time.monotonic()
             elapsed = time.monotonic() - t0
             if elapsed < tick_s:
                 self._stop.wait(tick_s - elapsed)

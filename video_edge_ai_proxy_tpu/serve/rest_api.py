@@ -182,6 +182,28 @@ def build_app(
             return _error(409, str(exc))
         return web.Response(status=200)
 
+    async def healthz(_request: web.Request) -> web.Response:
+        """Liveness/readiness: 200 when every enabled plane is healthy,
+        503 otherwise (k8s-style). Covers the server itself, the worker
+        fleet (running/total), and — when the inference plane is on — the
+        engine's TPU-side health (SURVEY.md §5.3: device liveness, tick
+        liveness, compile-cache warmth)."""
+        procs = await asyncio.to_thread(pm.list)
+        running = sum(1 for p in procs if p.state and p.state.running)
+        body: dict = {
+            "status": "ok",
+            "workers": {"running": running, "total": len(procs)},
+            "engine": None,
+        }
+        healthy = True
+        if engine is not None:
+            h = await asyncio.to_thread(engine.health)
+            body["engine"] = h
+            healthy = h["healthy"]
+        if not healthy:
+            body["status"] = "degraded"
+        return web.json_response(body, status=200 if healthy else 503)
+
     async def rtspscan(_request: web.Request) -> web.Response:
         """The reference portal calls this route but its server never
         implemented it (SURVEY.md L7 note, web edge.service.ts rtspScan).
@@ -196,6 +218,7 @@ def build_app(
     app.router.add_get("/api/v1/settings", settings_get)
     app.router.add_post("/api/v1/settings", settings_overwrite)
     app.router.add_get("/api/v1/stats", stats)
+    app.router.add_get("/healthz", healthz)
     app.router.add_get("/api/v1/rtspscan", rtspscan)
     app.router.add_post("/api/v1/profile/start", profile_start)
     app.router.add_post("/api/v1/profile/stop", profile_stop)
@@ -225,6 +248,7 @@ class RestServer:
                  host: str = "0.0.0.0", port: int = 8080,
                  engine=None, annotations=None):
         self._app = build_app(pm, settings, engine=engine, annotations=annotations)
+        self.engine = engine
         self._host = host
         self._port = port
         self._loop: Optional[asyncio.AbstractEventLoop] = None

@@ -92,6 +92,11 @@ class EngineConfig:
     # [height, width, bucket]. Big programs (e.g. ViT at bucket 32) can take
     # minutes to compile; prewarming moves that cost out of the hot path.
     prewarm: list = field(default_factory=list)
+    # /healthz flags the engine loop wedged when no tick completed for this
+    # long. Must exceed the longest legitimate in-tick XLA compile (first
+    # frame of a new geometry compiles inside the tick) or a k8s liveness
+    # probe would restart the pod mid-warmup in a loop.
+    health_stale_after_s: float = 300.0
 
 
 @dataclass
