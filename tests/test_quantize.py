@@ -1,0 +1,139 @@
+"""Weight-only int8 quantization (models/quantize.py) + engine integration."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+from video_edge_ai_proxy_tpu.engine import InferenceEngine
+from video_edge_ai_proxy_tpu.models import registry
+from video_edge_ai_proxy_tpu.models.quantize import (
+    dequantize_tree, quantize_tree, quantized_nbytes, tree_nbytes,
+)
+from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+
+class TestQuantizeTree:
+    def test_roundtrip_error_bound(self):
+        """Symmetric int8: per-element error <= scale/2 = absmax/254 of the
+        output channel."""
+        rng = np.random.default_rng(0)
+        w = rng.normal(0, 0.5, (64, 48)).astype(np.float32)
+        qt = quantize_tree({"kernel": jnp.asarray(w)})
+        back = np.asarray(dequantize_tree(qt)["kernel"])
+        bound = np.abs(w).max(axis=0) / 254.0 + 1e-7
+        assert (np.abs(back - w) <= bound[None, :]).all()
+
+    def test_small_and_1d_leaves_kept_exact(self):
+        tree = {
+            "bias": jnp.arange(32, dtype=jnp.float32),
+            "tiny_kernel": jnp.ones((4, 4), jnp.float32) * 0.3,
+        }
+        qt = quantize_tree(tree)
+        back = dequantize_tree(qt)
+        np.testing.assert_array_equal(np.asarray(back["bias"]),
+                                      np.asarray(tree["bias"]))
+        np.testing.assert_array_equal(np.asarray(back["tiny_kernel"]),
+                                      np.asarray(tree["tiny_kernel"]))
+        assert qt.q["bias"].dtype == jnp.float32      # not quantized
+        assert qt.q["tiny_kernel"].dtype == jnp.float32
+
+    def test_footprint_shrinks_4x_on_real_model(self):
+        spec = registry.get("tiny_vit")
+        _, variables = spec.init_params(jax.random.PRNGKey(0))
+        qt = quantize_tree(variables)
+        before = tree_nbytes(variables)              # f32 params
+        after = quantized_nbytes(qt)
+        assert after < 0.35 * before                 # ~4x minus exact leaves
+
+    def test_forward_parity_cosine(self):
+        """Weight-only int8 must not change what the model computes: logits
+        from dequantized params stay aligned with full-precision logits."""
+        spec = registry.get("tiny_mobilenet_v2")
+        model, variables = spec.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(
+            np.random.default_rng(1).random((2, 32, 32, 3)), jnp.float32
+        )
+        ref = np.asarray(jax.jit(model.apply)(variables, x), np.float32)
+        deq = dequantize_tree(quantize_tree(variables))
+        got = np.asarray(jax.jit(model.apply)(deq, x), np.float32)
+        cos = (ref * got).sum() / (
+            np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9)
+        assert cos > 0.99
+
+
+class TestQuantizedEngine:
+    def test_engine_serves_int8(self):
+        """cfg.quantize='int8': warmup quantizes, the jitted step
+        dequantizes in-graph, results still flow end to end."""
+        bus = MemoryFrameBus()
+        try:
+            bus.create_stream("cam1", 64 * 64 * 3)
+            cfg = EngineConfig(model="tiny_yolov8", batch_buckets=(1, 2),
+                               tick_ms=5, quantize="int8")
+            eng = InferenceEngine(bus, cfg)
+            eng.warmup()
+            from video_edge_ai_proxy_tpu.models.quantize import QuantizedTree
+
+            assert isinstance(eng._variables, QuantizedTree)
+            eng.start()
+            try:
+                from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+
+                sub = eng.subscribe(timeout=0.1)
+                results = []
+                deadline = time.time() + 30
+                while not results and time.time() < deadline:
+                    bus.publish(
+                        "cam1", np.full((64, 64, 3), 128, np.uint8),
+                        FrameMeta(width=64, height=64, channels=3,
+                                  timestamp_ms=int(time.time() * 1000),
+                                  is_keyframe=True),
+                    )
+                    try:
+                        results.append(next(sub))
+                    except StopIteration:
+                        break
+            finally:
+                eng.stop()
+            assert results, "no inference results from quantized engine"
+            assert results[0].model == "tiny_yolov8"
+        finally:
+            bus.close()
+
+    def test_checkpoint_stays_full_precision(self, tmp_path):
+        """save_checkpoint from a quantized engine must write the canonical
+        full-precision msgpack (loadable into an unquantized template)."""
+        import jax
+
+        from video_edge_ai_proxy_tpu.utils.checkpoint import load_msgpack
+
+        bus = MemoryFrameBus()
+        try:
+            path = str(tmp_path / "params.msgpack")
+            cfg = EngineConfig(model="tiny_yolov8", quantize="int8",
+                               checkpoint_path=path)
+            eng = InferenceEngine(bus, cfg)
+            eng.warmup()
+            eng.save_checkpoint()
+            spec = registry.get("tiny_yolov8")
+            _, template = spec.init_params(jax.random.PRNGKey(1))
+            restored = load_msgpack(path, jax.tree.map(np.asarray, template))
+            kinds = {np.asarray(x).dtype.kind
+                     for x in jax.tree_util.tree_leaves(restored)}
+            assert "i" not in kinds            # no int8 leaves on disk
+        finally:
+            bus.close()
+
+    def test_rejects_unknown_mode(self):
+        bus = MemoryFrameBus()
+        try:
+            eng = InferenceEngine(
+                bus, EngineConfig(model="tiny_yolov8", quantize="int4"))
+            with pytest.raises(ValueError, match="int8"):
+                eng.warmup()
+        finally:
+            bus.close()

@@ -175,6 +175,7 @@ class InferenceEngine:
                 log.info("loaded engine params from %s", ckpt)
             else:
                 log.warning("checkpoint %s missing; using random init", ckpt)
+        self._variables = self._maybe_quantize(self._variables)
         buckets = tuple(self._cfg.batch_buckets)
         if self._cfg.mesh:
             # Multi-chip serving: batch axis sharded over dp, params
@@ -215,6 +216,27 @@ class InferenceEngine:
             jax.default_backend(),
         )
 
+    def _maybe_quantize(self, variables):
+        """cfg.quantize="int8": weight-only PTQ (models/quantize.py) — int8
+        device/checkpoint residency, dequantize fused into the jitted step.
+        No calibration data needed, so it is safe at engine boot."""
+        if not self._cfg.quantize:
+            return variables
+        if self._cfg.quantize != "int8":
+            raise ValueError(
+                f"engine.quantize={self._cfg.quantize!r} unsupported "
+                "(only 'int8' weight-only quantization exists)"
+            )
+        from ..models.quantize import quantize_tree, quantized_nbytes, tree_nbytes
+
+        before = tree_nbytes(variables)
+        qt = quantize_tree(variables)
+        log.info(
+            "engine params quantized int8 (weight-only): %.1f MB -> %.1f MB",
+            before / 1e6, quantized_nbytes(qt) / 1e6,
+        )
+        return qt
+
     def _ensure_model(self, name: str):
         """(spec, model, variables) for a registry model, lazily built.
         Only the default model reads cfg.checkpoint_path; per-stream extras
@@ -227,6 +249,7 @@ class InferenceEngine:
 
             spec = registry.get(name)
             model, variables = spec.init_params(jax.random.PRNGKey(0))
+            variables = self._maybe_quantize(variables)
             if self._mesh is not None:
                 from ..parallel import replicated
 
@@ -300,7 +323,23 @@ class InferenceEngine:
         path = path or self._cfg.checkpoint_path
         if not path:
             raise ValueError("no checkpoint path configured")
-        save_msgpack(path, jax.tree.map(np.asarray, self._variables))
+        variables = self._variables
+        if self._cfg.quantize:
+            # Checkpoints stay full-precision (the canonical format every
+            # load path expects); quantization re-applies at next warmup.
+            # The exact pre-quantization weights are gone, so this write is
+            # LOSSY relative to whatever the engine originally loaded —
+            # overwriting a trained f32 checkpoint bakes in up to
+            # absmax/254 per-element error. Warn, don't silently clobber.
+            from ..models.quantize import dequantize_tree
+
+            log.warning(
+                "save_checkpoint from a quantized engine writes int8-"
+                "roundtripped weights (lossy vs the originally loaded "
+                "params); keep a copy of the source checkpoint"
+            )
+            variables = dequantize_tree(variables)
+        save_msgpack(path, jax.tree.map(np.asarray, variables))
         return path
 
     def start(self) -> None:
@@ -464,7 +503,17 @@ class InferenceEngine:
             import jax
 
             spec, mod, _ = self._ensure_model(model)
-            fn = jax.jit(build_serving_step(mod, spec))
+            raw = build_serving_step(mod, spec)
+            if self._cfg.quantize:
+                from ..models.quantize import dequantize_tree
+
+                base = raw
+
+                def raw(qv, frames_u8, _base=base):
+                    # Dequantize inside the program: XLA fuses int8*scale
+                    # into each weight's first consumer, HBM stays int8.
+                    return _base(dequantize_tree(qv), frames_u8)
+            fn = jax.jit(raw)
             self._step_cache[key] = fn
         return fn
 

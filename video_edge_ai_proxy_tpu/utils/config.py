@@ -97,6 +97,10 @@ class EngineConfig:
     # frame of a new geometry compiles inside the tick) or a k8s liveness
     # probe would restart the pod mid-warmup in a loop.
     health_stale_after_s: float = 300.0
+    # "int8" = weight-only post-training quantization of serving params
+    # (models/quantize.py): int8 HBM/checkpoint residency, bf16 compute,
+    # dequantize fused in-graph. "" = full precision.
+    quantize: str = ""
 
 
 @dataclass
