@@ -7,15 +7,28 @@ all: proto native
 
 # Regenerate gRPC stubs after editing proto/video_streaming.proto
 # (reference Makefile:5-17 — one schema, generated bindings checked in).
+# Prefer grpc_tools (generator and Python runtime ship from the same wheel,
+# so no gencode/runtime version skew); fall back to the system `protoc` for
+# message-only edits where grpcio-tools isn't installed — then verify the
+# regenerated stub actually imports against the local runtime.
 proto:
-	python -m grpc_tools.protoc \
-		-I video_edge_ai_proxy_tpu/proto \
-		--python_out=video_edge_ai_proxy_tpu/proto \
-		--grpc_python_out=video_edge_ai_proxy_tpu/proto \
-		video_edge_ai_proxy_tpu/proto/video_streaming.proto
-	@# generated import is absolute; rewrite to package-relative
-	sed -i 's/^import video_streaming_pb2/from . import video_streaming_pb2/' \
-		video_edge_ai_proxy_tpu/proto/video_streaming_pb2_grpc.py
+	@if python -c "import grpc_tools" 2>/dev/null; then \
+		python -m grpc_tools.protoc \
+			-I video_edge_ai_proxy_tpu/proto \
+			--python_out=video_edge_ai_proxy_tpu/proto \
+			--grpc_python_out=video_edge_ai_proxy_tpu/proto \
+			video_edge_ai_proxy_tpu/proto/video_streaming.proto \
+		&& sed -i 's/^import video_streaming_pb2/from . import video_streaming_pb2/' \
+			video_edge_ai_proxy_tpu/proto/video_streaming_pb2_grpc.py; \
+	else \
+		echo "grpcio-tools not installed; regenerating MESSAGES ONLY with" \
+			"system protoc — a service-definition change still needs" \
+			"'make install' + rerun"; \
+		protoc -I video_edge_ai_proxy_tpu/proto \
+			--python_out=video_edge_ai_proxy_tpu/proto \
+			video_edge_ai_proxy_tpu/proto/video_streaming.proto; \
+	fi
+	python -c "from video_edge_ai_proxy_tpu.proto import pb, pb_grpc; pb.VideoFrame(); pb_grpc.ImageStub"
 
 # Force-rebuild the C++ shm bus core (normally built+cached on first import).
 native:

@@ -86,21 +86,26 @@ def main() -> None:
     # between contention windows (BASELINE.md perf notes) — so when an
     # attempt looks contended (well under the fleet-recorded rate), wait
     # out the window and retry instead of recording the co-tenant.
+    def timed_best(arg, good_ms, deadline):
+        """Best-of-3, retried past contended windows until good_ms or the
+        deadline. Returns (best seconds, last checksum, still_contended)."""
+        best = float("inf")
+        tot = 0
+        while True:
+            for _ in range(3):
+                t0 = time.perf_counter()
+                tot = int(np.asarray(megastep(arg)))
+                best = min(best, time.perf_counter() - t0)
+            if backend != "tpu" or best / iters * 1e3 <= good_ms:
+                return best, tot, False
+            if time.monotonic() > deadline:
+                return best, tot, True
+            time.sleep(25.0)
+
     np.asarray(megastep(base_dev))
-    elapsed = float("inf")
-    total = 0
     good_batch_ms = 16.0     # anything slower is a contended window
     deadline = time.monotonic() + 240.0
-    while True:
-        for _ in range(3):
-            t0 = time.perf_counter()
-            total = int(np.asarray(megastep(base_dev)))
-            elapsed = min(elapsed, time.perf_counter() - t0)
-        if backend != "tpu" or elapsed / iters * 1e3 <= good_batch_ms:
-            break
-        if time.monotonic() > deadline:
-            break
-        time.sleep(25.0)
+    elapsed, total, contended = timed_best(base_dev, good_batch_ms, deadline)
 
     frames_done = streams * iters
     fps = frames_done / elapsed
@@ -123,11 +128,13 @@ def main() -> None:
             np.tile(base, (reps, 1, 1, 1))[:64]
         )
         np.asarray(megastep(base64_dev))
-        t0 = time.perf_counter()
-        np.asarray(megastep(base64_dev))
-        fps64 = 64 * iters / (time.perf_counter() - t0)
+        # same retry discipline as the main metric (threshold scaled to the
+        # known-good ~27 ms bs64 schedule), bounded by a fresh short window.
+        el64, _, c64 = timed_best(base64_dev, 40.0, time.monotonic() + 120.0)
+        fps64 = 64 * iters / el64
+        contended = contended or c64
 
-    print(json.dumps({
+    out = {
         "metric": f"yolov8n_640_detect_fps_{streams}x1080p_{backend}",
         "value": round(fps, 1),
         "unit": "frames/sec",
@@ -138,7 +145,12 @@ def main() -> None:
         "e2e_tunnel_ms": round(e2e_ms, 1),
         "fps_64stream_bucket": round(fps64, 1) if fps64 else None,
         "checksum": total,
-    }))
+    }
+    if contended:
+        # Retries never found an uncontended window: the number below is a
+        # co-tenant artifact, not this program's speed (BASELINE.md notes).
+        out["contended_device"] = True
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

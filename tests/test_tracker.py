@@ -1,0 +1,190 @@
+"""Per-stream SORT-style tracker (engine/tracker.py) + engine integration."""
+
+import time
+
+import numpy as np
+
+from video_edge_ai_proxy_tpu.engine.tracker import IoUTracker, _iou_matrix
+
+
+def _box(x, y, w=20.0, h=20.0):
+    return (x, y, x + w, y + h)
+
+
+class TestIoUTracker:
+    def test_stable_id_across_moving_frames(self):
+        """An object drifting a few px/frame keeps one id for the whole
+        clip (the constant-velocity prediction keeps IoU above threshold)."""
+        tr = IoUTracker()
+        ids = set()
+        for f in range(20):
+            out = tr.update([_box(10 + 3 * f, 40 + 2 * f)], [0])
+            ids.add(out[0])
+        assert len(ids) == 1
+        assert tr.live_tracks == 1
+
+    def test_two_objects_two_ids(self):
+        tr = IoUTracker()
+        a, b = tr.update([_box(0, 0), _box(200, 200)], [0, 0])
+        assert a != b
+        a2, b2 = tr.update([_box(2, 1), _box(203, 202)], [0, 0])
+        assert (a2, b2) == (a, b)
+
+    def test_class_gating_blocks_match(self):
+        """Same position, different class -> a brand-new id, never a
+        cross-class continuation."""
+        tr = IoUTracker()
+        (a,) = tr.update([_box(50, 50)], [3])
+        (b,) = tr.update([_box(50, 50)], [7])
+        assert a != b
+
+    def test_track_drops_after_max_misses(self):
+        tr = IoUTracker(max_misses=3)
+        (a,) = tr.update([_box(50, 50)], [0])
+        for _ in range(4):
+            assert tr.update([], []) == []
+        assert tr.live_tracks == 0
+        (b,) = tr.update([_box(50, 50)], [0])
+        assert b != a                      # stale id is not resurrected
+
+    def test_track_survives_short_occlusion(self):
+        """A miss shorter than max_misses re-attaches to the same id,
+        coasting on the velocity estimate through the gap."""
+        tr = IoUTracker(max_misses=5)
+        ids = [tr.update([_box(10 + 4 * f, 10)], [0])[0] for f in range(5)]
+        for _ in range(2):                 # occluded: no detections
+            tr.update([], [])
+        # reappears roughly where the velocity carried it (4 px/frame)
+        (back,) = tr.update([_box(10 + 4 * 7, 10)], [0])
+        assert back == ids[0]
+
+    def test_wall_clock_gap_resets_tracks(self):
+        """A stream outage (no update() calls at all) must not freeze
+        tracks: a gap beyond max_gap_s clears them, so the object seen
+        after reconnect gets a fresh id instead of the hour-old one."""
+        tr = IoUTracker(max_gap_s=5.0)
+        (a,) = tr.update([_box(50, 50)], [0], now=100.0)
+        (b,) = tr.update([_box(50, 50)], [0], now=102.0)
+        assert b == a                     # within the gap budget
+        (c,) = tr.update([_box(50, 50)], [0], now=200.0)
+        assert c != a                     # 98 s outage: stale track cleared
+
+    def test_iou_matrix_known_values(self):
+        a = np.array([[0, 0, 10, 10]], np.float32)
+        b = np.array([[0, 0, 10, 10], [5, 0, 15, 10], [20, 20, 30, 30]],
+                     np.float32)
+        m = _iou_matrix(a, b)
+        np.testing.assert_allclose(m[0], [1.0, 50 / 150, 0.0], atol=1e-6)
+
+    def test_greedy_prefers_higher_iou(self):
+        """When two detections could claim one track, the closer one wins
+        and the other opens a new track."""
+        tr = IoUTracker()
+        (a,) = tr.update([_box(0, 0)], [0])
+        near, far = tr.update([_box(1, 1), _box(12, 12)], [0, 0])
+        assert near == a and far != a
+
+
+class TestEngineTracking:
+    def test_tracker_resets_on_model_switch_and_expires_on_empty(self):
+        """Engine-level guarantees: (a) a stream's tracker resets when its
+        model changes (class vocabularies differ), (b) empty frames reach
+        the tracker so stale tracks expire instead of freezing."""
+        from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.proto import pb
+        from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+        bus = MemoryFrameBus()
+        try:
+            eng = InferenceEngine(bus, EngineConfig(model="tiny_yolov8"))
+
+            def det():
+                return pb.Detection(
+                    box=pb.BoundingBox(left=10, top=10, width=20, height=20),
+                    class_id=0, confidence=0.9,
+                )
+
+            d1 = det()
+            eng._assign_tracks("cam", "m1", [d1])
+            d2 = det()
+            eng._assign_tracks("cam", "m1", [d2])
+            assert d2.track_id == d1.track_id          # same model: continues
+
+            d3 = det()
+            eng._assign_tracks("cam", "m2", [d3])
+            assert d3.track_id != d1.track_id          # model switch: reset
+
+            # empty frames accumulate misses until the track drops
+            for _ in range(31):                        # default max_misses=30
+                eng._assign_tracks("cam", "m2", [])
+            d4 = det()
+            eng._assign_tracks("cam", "m2", [d4])
+            assert d4.track_id != d3.track_id          # expired, new id
+        finally:
+            bus.close()
+
+    def test_track_ids_flow_to_results_and_annotations(self):
+        from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+        from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.proto import pb
+        from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+        from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+        bus = MemoryFrameBus()
+        try:
+            bus.create_stream("cam1", 64 * 64 * 3)
+            captured = []
+
+            def handler(batch):
+                captured.extend(batch)
+                return True
+
+            ann = AnnotationQueue(handler=handler)
+            ann.start()
+            eng = InferenceEngine(
+                bus,
+                EngineConfig(model="tiny_yolov8", batch_buckets=(1, 2),
+                             tick_ms=5, track=True),
+                annotations=ann,
+            )
+            eng.warmup()
+            eng.start()
+            try:
+                sub = eng.subscribe(timeout=0.1)
+                results = []
+                deadline = time.time() + 30
+                frame = np.full((64, 64, 3), 128, np.uint8)
+                while len(results) < 3 and time.time() < deadline:
+                    bus.publish(
+                        "cam1", frame,
+                        FrameMeta(width=64, height=64, channels=3,
+                                  timestamp_ms=int(time.time() * 1000),
+                                  is_keyframe=True),
+                    )
+                    try:
+                        results.append(next(sub))
+                    except StopIteration:
+                        break
+            finally:
+                eng.stop()
+            tracked = [r for r in results if r.detections]
+            if not tracked:       # random weights may detect nothing at 64px
+                return
+            for r in tracked:
+                assert all(d.track_id != "" for d in r.detections)
+            # identical frames -> identical detections -> stable ids
+            if len(tracked) >= 2:
+                ids0 = [d.track_id for d in tracked[0].detections]
+                ids1 = [d.track_id for d in tracked[1].detections]
+                assert ids0 == ids1
+            # the uplink AnnotateRequests carry the id too
+            deadline = time.time() + 5
+            while not captured and time.time() < deadline:
+                time.sleep(0.05)
+            ann.stop()
+            reqs = [pb.AnnotateRequest.FromString(b) for b in captured]
+            assert any(r.object_tracking_id for r in reqs)
+        finally:
+            bus.close()

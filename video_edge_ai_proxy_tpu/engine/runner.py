@@ -146,6 +146,7 @@ class InferenceEngine:
         self.ticks = 0
         self.batches = 0
         self.last_tick_monotonic = 0.0
+        self._trackers: Dict[str, Any] = {}      # device_id -> IoUTracker
         self._probe_cache: tuple = (0.0, None)   # (monotonic, ok | None)
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_fn = None                    # jitted once, reused
@@ -570,6 +571,12 @@ class InferenceEngine:
         for i, device_id in enumerate(group.device_ids):
             meta = group.metas[i]
             detections = self._to_detections(host, i, spec)
+            if self._cfg.track and spec.kind == "detect":
+                # Unconditionally — empty frames MUST reach the tracker so
+                # misses accumulate and stale tracks expire; skipping them
+                # would freeze old tracks and hand their ids to the next
+                # object that appears nearby.
+                self._assign_tracks(device_id, spec.name, detections)
             latency = max(0.0, now_ms - meta.timestamp_ms) if meta.timestamp_ms else 0.0
             result = pb.InferenceResult(
                 device_id=device_id,
@@ -591,6 +598,32 @@ class InferenceEngine:
                 else 0.9 * st.ema_latency_ms + 0.1 * latency
             )
             st.last_batch = group.bucket
+
+    def _assign_tracks(self, device_id: str, model: str, detections) -> None:
+        """Per-stream SORT-style association (engine/tracker.py): fills
+        Detection.track_id, which `_annotate` forwards as the reference's
+        AnnotateRequest.object_tracking_id — the field the reference leaves
+        to external ML clients. The tracker resets when the stream's model
+        changes: class_ids from different models are different label
+        vocabularies, so tracks must never continue across a switch."""
+        from .tracker import IoUTracker
+
+        entry = self._trackers.get(device_id)
+        if entry is None or entry[0] != model:
+            # Ids stay unique within the stream across resets: the fresh
+            # tracker continues numbering where the old one stopped.
+            first = entry[1].next_id if entry else 1
+            entry = (model, IoUTracker(next_id=first))
+            self._trackers[device_id] = entry
+        tracker = entry[1]
+        boxes = [
+            (d.box.left, d.box.top, d.box.left + d.box.width,
+             d.box.top + d.box.height)
+            for d in detections
+        ]
+        ids = tracker.update(boxes, [d.class_id for d in detections])
+        for det, tid in zip(detections, ids):
+            det.track_id = tid
 
     def _to_detections(self, host: dict, i: int, spec=None) -> List[pb.Detection]:
         spec = spec or self._spec
@@ -655,6 +688,7 @@ class InferenceEngine:
                 type="detection" if spec.kind == "detect" else spec.kind,
                 start_timestamp=meta.timestamp_ms or int(time.time() * 1000),
                 object_type=det.class_name,
+                object_tracking_id=det.track_id,
                 confidence=det.confidence,
                 object_bouding_box=det.box if det.HasField("box") else None,
                 # Re-ID feature vectors ride the proto's embedding field

@@ -98,9 +98,14 @@ class EngineConfig:
     # probe would restart the pod mid-warmup in a loop.
     health_stale_after_s: float = 300.0
     # "int8" = weight-only post-training quantization of serving params
-    # (models/quantize.py): int8 HBM/checkpoint residency, bf16 compute,
+    # (models/quantize.py): int8 device/HBM residency (checkpoints stay
+    # full precision on disk), bf16 compute,
     # dequantize fused in-graph. "" = full precision.
     quantize: str = ""
+    # Fill Detection.track_id / AnnotateRequest.object_tracking_id with a
+    # per-stream SORT-style tracker (engine/tracker.py). Host-side numpy on
+    # NMS output — negligible next to a device batch.
+    track: bool = True
 
 
 @dataclass
