@@ -37,6 +37,28 @@ SRC_H, SRC_W = 1080, 1920
 ITERS = 150
 
 
+def timed_best(run, iters, backend, good_ms, deadline, sleep_s=25.0):
+    """Best-of-3 timing of ``run()`` (a dispatch returning one fetchable
+    scalar), retried past contended device windows until the per-iteration
+    time reaches ``good_ms`` or ``deadline`` passes. Returns (best seconds,
+    last checksum, still_contended). Shared with tools/bench_configs.py —
+    the contention discipline must be identical everywhere numbers are
+    recorded (BASELINE.md perf notes).
+    """
+    best = float("inf")
+    tot = 0
+    while True:
+        for _ in range(3):
+            t0 = time.perf_counter()
+            tot = int(np.asarray(run()))
+            best = min(best, time.perf_counter() - t0)
+        if backend != "tpu" or best / iters * 1e3 <= good_ms:
+            return best, tot, False
+        if time.monotonic() > deadline:
+            return best, tot, True
+        time.sleep(sleep_s)
+
+
 def main() -> None:
     from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
     from video_edge_ai_proxy_tpu.models import registry
@@ -86,26 +108,11 @@ def main() -> None:
     # between contention windows (BASELINE.md perf notes) — so when an
     # attempt looks contended (well under the fleet-recorded rate), wait
     # out the window and retry instead of recording the co-tenant.
-    def timed_best(arg, good_ms, deadline):
-        """Best-of-3, retried past contended windows until good_ms or the
-        deadline. Returns (best seconds, last checksum, still_contended)."""
-        best = float("inf")
-        tot = 0
-        while True:
-            for _ in range(3):
-                t0 = time.perf_counter()
-                tot = int(np.asarray(megastep(arg)))
-                best = min(best, time.perf_counter() - t0)
-            if backend != "tpu" or best / iters * 1e3 <= good_ms:
-                return best, tot, False
-            if time.monotonic() > deadline:
-                return best, tot, True
-            time.sleep(25.0)
-
     np.asarray(megastep(base_dev))
     good_batch_ms = 16.0     # anything slower is a contended window
     deadline = time.monotonic() + 240.0
-    elapsed, total, contended = timed_best(base_dev, good_batch_ms, deadline)
+    elapsed, total, contended = timed_best(
+        lambda: megastep(base_dev), iters, backend, good_batch_ms, deadline)
 
     frames_done = streams * iters
     fps = frames_done / elapsed
@@ -130,7 +137,9 @@ def main() -> None:
         np.asarray(megastep(base64_dev))
         # same retry discipline as the main metric (threshold scaled to the
         # known-good ~27 ms bs64 schedule), bounded by a fresh short window.
-        el64, _, c64 = timed_best(base64_dev, 40.0, time.monotonic() + 120.0)
+        el64, _, c64 = timed_best(
+            lambda: megastep(base64_dev), iters, backend, 40.0,
+            time.monotonic() + 120.0)
         fps64 = 64 * iters / el64
         contended = contended or c64
 

@@ -25,7 +25,9 @@ def main():
     try:
         for result in stub.Inference(req):
             dets = ", ".join(
-                f"{d.class_name}:{d.confidence:.2f}" for d in result.detections[:5]
+                f"#{d.track_id} {d.class_name}:{d.confidence:.2f}"
+                if d.track_id else f"{d.class_name}:{d.confidence:.2f}"
+                for d in result.detections[:5]
             )
             print(
                 f"{result.device_id} model={result.model} "

@@ -157,6 +157,39 @@ class TestServingStep:
 
 
 class TestEngine:
+    def test_engine_survives_tick_exceptions(self, bus):
+        """Fault injection (SURVEY.md §5.3 — the reference has none): a
+        tick that throws must not kill the engine thread; subsequent ticks
+        keep serving (same log-and-continue stance as the reference's
+        worker loops, rtsp_to_rtmp.py:186-187)."""
+        bus.create_stream("cam1", 64 * 64 * 3)
+        eng = _engine(bus, "tiny_yolov8")
+        orig_collect = eng._collector.collect
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 3:
+                raise RuntimeError("injected tick failure")
+            return orig_collect()
+
+        eng._collector.collect = flaky
+        eng.start()
+        try:
+            sub = eng.subscribe(timeout=0.1)
+            results = []
+            deadline = time.time() + 30
+            while not results and time.time() < deadline:
+                _publish(bus, "cam1")
+                try:
+                    results.append(next(sub))
+                except StopIteration:
+                    break
+        finally:
+            eng.stop()
+        assert calls["n"] > 3, "injected failures never triggered"
+        assert results, "engine did not recover from injected tick failures"
+
     def test_detect_end_to_end(self, bus):
         bus.create_stream("cam1", 64 * 64 * 3)
         ann = AnnotationQueue(handler=lambda batch: True)

@@ -138,6 +138,26 @@ class TestProcessManager:
             lambda: manager.info("cam1").state.failing_streak >= 1, timeout=30
         )
 
+    def test_sigkill_exit_surfaces_oom_flag(self, pm):
+        """SIGKILL exit (the kernel OOM killer's signature for a subprocess
+        runner) must surface as oom_killed in the process state — the
+        reference reads Docker's OOMKilled for this (grpc_api.go:102-117)."""
+        import os
+        import signal as _signal
+
+        manager, bus, _ = pm
+        manager.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+        assert wait_for(
+            lambda: manager.info("cam1").state.running, timeout=30
+        )
+        pid = manager.info("cam1").state.pid
+        os.kill(pid, _signal.SIGKILL)
+        # Sticky across the restart: the flag must be visible even after
+        # the supervisor has already respawned the worker.
+        assert wait_for(
+            lambda: manager.info("cam1").state.oom_killed, timeout=30
+        )
+
     def test_eof_reconnect_forever(self, pm):
         """A source that runs dry does NOT kill the worker — it loops waiting
         for the camera to return (reference rtsp_to_rtmp.py:186-187)."""

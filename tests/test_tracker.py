@@ -167,11 +167,17 @@ class TestEngineTracking:
                         results.append(next(sub))
                     except StopIteration:
                         break
+                # drain while the queue consumer is still running
+                deadline = time.time() + 5
+                while not captured and time.time() < deadline:
+                    time.sleep(0.05)
             finally:
                 eng.stop()
+                ann.stop()
             tracked = [r for r in results if r.detections]
             if not tracked:       # random weights may detect nothing at 64px
-                return
+                import pytest
+                pytest.skip("no detections from random weights")
             for r in tracked:
                 assert all(d.track_id != "" for d in r.detections)
             # identical frames -> identical detections -> stable ids
@@ -180,10 +186,6 @@ class TestEngineTracking:
                 ids1 = [d.track_id for d in tracked[1].detections]
                 assert ids0 == ids1
             # the uplink AnnotateRequests carry the id too
-            deadline = time.time() + 5
-            while not captured and time.time() < deadline:
-                time.sleep(0.05)
-            ann.stop()
             reqs = [pb.AnnotateRequest.FromString(b) for b in captured]
             assert any(r.object_tracking_id for r in reqs)
         finally:

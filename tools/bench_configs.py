@@ -1,0 +1,95 @@
+"""Measure all five BASELINE.json configs on the current serving code.
+
+One JSON line per config (same scan-fold + best-of-3 methodology as
+bench.py; see tools/profile_ns.py for why inputs are perturbed per
+iteration and why cross-run comparisons on this co-tenanted dev chip are
+unreliable). bench.py stays the driver-facing north-star metric; this is
+the full matrix for BASELINE.md's table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SRC_H, SRC_W = 1080, 1920
+
+# (name, model, streams, iters, good_ms) — clip length comes from the model
+# spec; good_ms is ~1.5x the known-good fast-window batch time (BASELINE.md
+# table) and gates bench.timed_best's contention retry, same as bench.py.
+CONFIGS = [
+    ("config1_mobilenet_1stream", "mobilenet_v2", 1, 100, 2.0),
+    ("config2_yolov8n_4stream", "yolov8n", 4, 100, 5.5),
+    ("config3_resnet50_16stream", "resnet50", 16, 50, 4.5),
+    ("config4_vit_b16_32stream", "vit_b16", 32, 30, 18.0),
+    ("config5_videomae_8x8clip", "videomae_b", 8, 20, 45.0),
+]
+
+
+def main() -> None:
+    from bench import timed_best
+
+    from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
+    from video_edge_ai_proxy_tpu.models import registry
+
+    backend = jax.default_backend()
+    rng = np.random.default_rng(0)
+    for name, model_name, streams, iters, good_ms in CONFIGS:
+        if backend != "tpu":
+            streams, iters = min(streams, 2), 2
+        spec = registry.get(model_name)
+        model, variables = spec.init_params(jax.random.PRNGKey(0))
+        step = build_serving_step(model, spec)
+        shape = (streams,) + ((spec.clip_len,) if spec.clip_len else ()) + \
+            (SRC_H if backend == "tpu" else 270,
+             SRC_W if backend == "tpu" else 480, 3)
+        base = rng.integers(0, 256, shape, dtype=np.uint8)
+
+        @jax.jit
+        def mega(params, u8):
+            # params is an ARGUMENT, not a closure capture: captured trees
+            # are baked into the HLO as constants, and an 86M-param ViT
+            # makes the tunnel's remote-compile request exceed its size
+            # limit (HTTP 413).
+            def body(carry, i):
+                out = step(params, u8 + i.astype(jnp.uint8))
+                s = sum(jnp.sum(l).astype(jnp.float32)
+                        for l in jax.tree.leaves(out))
+                return carry + s, None
+
+            tot, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                  jnp.arange(iters))
+            return tot
+
+        dev = jax.device_put(base)
+        var_dev = jax.device_put(variables)
+        t0 = time.perf_counter()
+        np.asarray(mega(var_dev, dev))
+        compile_s = time.perf_counter() - t0
+        best, _, contended = timed_best(
+            lambda: mega(var_dev, dev), iters, backend, good_ms,
+            time.monotonic() + 120.0)
+        frames_per_iter = streams * (spec.clip_len or 1)
+        rec = {
+            "config": name,
+            "model": model_name,
+            "backend": backend,
+            "fps": round(frames_per_iter * iters / best, 1),
+            "batch_ms": round(best / iters * 1e3, 2),
+            "compile_s": round(compile_s, 1),
+        }
+        if contended:
+            rec["contended_device"] = True
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import collections
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -277,6 +278,11 @@ class ProcessManager:
                 pid=entry.proc.pid,
                 restarting=entry.restarting,
                 failing_streak=entry.failing_streak,
+                # Sticky across the restart (the reference surfaces Docker's
+                # OOMKilled the same way): the PREVIOUS run's SIGKILL exit
+                # stays visible so ListStreams health shows why the streak
+                # is climbing, not just that it is.
+                oom_killed=(entry.last_exit == -signal.SIGKILL),
             )
         return ProcessState(
             status="restarting" if entry.desired else "exited",
@@ -285,6 +291,12 @@ class ProcessManager:
             exit_code=code,
             restarting=entry.desired,
             failing_streak=entry.failing_streak,
+            # SIGKILL exit is the kernel OOM killer's signature for a
+            # subprocess runner (the reference reads Docker's OOMKilled flag,
+            # ``grpc_api.go:102-117``; without a cgroup supervisor, -9 is
+            # the best-available heuristic and can also mean a manual
+            # kill -9 — surfaced identically in ListStreams either way).
+            oom_killed=(code == -signal.SIGKILL),
         )
 
     # -- persistence / resume --
@@ -344,6 +356,10 @@ class ProcessManager:
                         and now - entry.last_spawn > self.STABLE_AFTER_S
                     ):
                         entry.failing_streak = 0
+                        # Stable again: clear the last-exit cause so
+                        # oom_killed stops reporting a long-gone event
+                        # (Docker clears OOMKilled on a healthy restart too).
+                        entry.last_exit = 0
                     continue
                 if not entry.restarting:
                     entry.failing_streak += 1
