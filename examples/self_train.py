@@ -142,17 +142,27 @@ def main():
         return {"boxes": jnp.asarray(boxes), "labels": jnp.asarray(labels),
                 "mask": jnp.asarray(mask)}
 
-    rng = jax.random.PRNGKey(0)
+    from video_edge_ai_proxy_tpu.ops.augment import augment_detection_batch
+
+    init_rng, rng = jax.random.split(jax.random.PRNGKey(0))
     state = None
     step_count = 0
+    augment = jax.jit(augment_detection_batch)
     with mesh:
         for batch in Loader(ds, batch_size=args.batch):
             x = jnp.asarray(batch.astype(np.float32) / 255.0)
             if state is None:
-                state = trainer.init_state(rng, x[:1])
+                state = trainer.init_state(init_rng, x[:1])
+            t = targets_for(x.shape[0])
+            # On-device augmentation (ops/augment.py): mosaic + flip +
+            # color + cutout, keyed per step for reproducibility.
+            rng, akey = jax.random.split(rng)
+            x, aug_boxes, aug_mask, aug_labels = augment(
+                akey, x, t["boxes"], t["mask"], t["labels"])
+            t = {"boxes": aug_boxes, "mask": aug_mask, "labels": aug_labels}
             state, loss = trainer.train_step(
                 state, trainer.shard_batch(x),
-                jax.tree.map(trainer.shard_batch, targets_for(x.shape[0])),
+                jax.tree.map(trainer.shard_batch, t),
             )
             step_count += 1
             if step_count % 10 == 0:

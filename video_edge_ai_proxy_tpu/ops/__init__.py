@@ -11,8 +11,13 @@ XLA-compiled device ops:
   decode and NMS).
 - ``nms``       — fixed-iteration greedy NMS: a Pallas TPU kernel with an
   exact XLA (``lax.fori_loop``) twin for CPU/interpret execution.
+- ``augment``   — training-time augmentations (mosaic, flip, color jitter,
+  cutout) that run inside the jitted train step: static shapes, PRNG-keyed.
 """
 
+from .augment import (
+    augment_detection_batch, color_jitter, cutout, mosaic4, random_hflip,
+)
 from .boxes import box_iou_matrix, cxcywh_to_xyxy, xyxy_to_cxcywh
 from .nms import batched_nms, nms_keep_mask, nms_keep_mask_pallas, nms_keep_mask_xla
 from .preprocess import (
@@ -27,15 +32,20 @@ from .preprocess import (
 __all__ = [
     "IMAGENET_MEAN",
     "IMAGENET_STD",
+    "augment_detection_batch",
     "batched_nms",
     "box_iou_matrix",
+    "color_jitter",
+    "cutout",
     "cxcywh_to_xyxy",
     "letterbox_params",
+    "mosaic4",
     "nms_keep_mask",
     "nms_keep_mask_pallas",
     "nms_keep_mask_xla",
     "preprocess_classify",
     "preprocess_clip",
     "preprocess_letterbox",
+    "random_hflip",
     "xyxy_to_cxcywh",
 ]
