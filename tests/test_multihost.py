@@ -1,0 +1,100 @@
+"""REAL multi-process collective test for the DCN fabric (SURVEY.md §2.4).
+
+The virtual-device tests elsewhere validate sharding logic in one process;
+this one actually spawns TWO OS processes that join a jax.distributed
+cluster over localhost (the moral equivalent of two TPU hosts on DCN) and
+run cross-process collectives through `parallel.initialize_distributed` +
+`parallel.make_mesh` — the exact code path a multi-host deployment boots
+through. Each worker gets 2 virtual CPU devices, so the mesh spans 4
+devices across 2 processes.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from video_edge_ai_proxy_tpu import parallel
+
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    assert parallel.initialize_distributed(f"127.0.0.1:{{port}}", 2, pid)
+    assert jax.process_count() == 2
+    n = jax.device_count()
+    assert n == 4, n                      # 2 local x 2 processes
+
+    mesh = parallel.make_mesh(dp=n, devices=jax.devices())
+
+    # cross-process psum: every shard contributes, every process agrees
+    def allsum(x):
+        return jax.lax.psum(x, "dp")
+    g = jax.jit(shard_map(
+        allsum, mesh=mesh, in_specs=P(("dp",)), out_specs=P()))
+    x = jnp.arange(float(n))
+    out = np.asarray(g(x))[0]
+    assert out == x.sum(), (out, x.sum())
+
+    # cross-process all_gather: every process ends up holding every shard
+    # (output replicated so both processes can fetch it)
+    def gather(x):
+        return jax.lax.all_gather(x, "dp")
+    h = jax.jit(shard_map(
+        gather, mesh=mesh, in_specs=P(("dp",)), out_specs=P(),
+        check_vma=False))    # all_gather output IS replicated; checker
+                             # can't infer it through the collective
+    got = np.asarray(h(x)).reshape(-1)
+    assert np.allclose(got, x), got
+
+    print(f"WORKER_OK {{pid}} devices={{n}} psum={{out}}", flush=True)
+""").format(repo=REPO)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_cluster_psum_and_gather(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        # A worker stuck in the distributed-init barrier (partner died,
+        # port stolen) must not outlive the test as an orphan.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER_OK {pid} devices=4 psum=6.0" in out, out
