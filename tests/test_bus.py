@@ -136,3 +136,66 @@ class TestShmSpecific:
         prod.publish("cam", img, FrameMeta())
         f = cons.read_latest("cam")
         np.testing.assert_array_equal(f.data, img)
+
+
+class TestRaceStress:
+    def test_concurrent_writer_reader_never_tears(self, buses):
+        """SURVEY.md §5.2 — the reference has no race detection; the rebuild
+        proves its ring under contention. One thread publishes frames whose
+        every byte equals a sequence number while another reads the latest
+        as fast as it can: any read that returns a mix of byte values is a
+        torn frame (writer overwrote a slot mid-read), which the ring's
+        slot protocol must prevent."""
+        import threading
+        import time as _time
+
+        producer, consumer = buses
+        h = w = 64
+        producer.create_stream("race", h * w * 3)
+        stop = threading.Event()
+        torn = []
+        reader_errors = []
+        published = {"n": 0}
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                frame = np.full((h, w, 3), i % 251, np.uint8)
+                producer.publish("race", frame, FrameMeta(
+                    width=w, height=h, channels=3,
+                    timestamp_ms=i, is_keyframe=True))
+                published["n"] = i = i + 1
+
+        def reader():
+            cursor = 0
+            try:
+                while not stop.is_set():
+                    got = consumer.read_latest("race", min_seq=cursor)
+                    if got is None:
+                        continue
+                    cursor = got.seq
+                    u = np.unique(got.data)
+                    if len(u) != 1:
+                        torn.append(sorted(int(v) for v in u))
+                        return
+                    # seq/payload pairing: writer encodes i % 251, seq is
+                    # i+1, so a uniform-but-mismatched slot is caught too.
+                    if int(got.data.flat[0]) != (got.seq - 1) % 251:
+                        torn.append(
+                            [int(got.data.flat[0]), "vs_seq", got.seq])
+                        return
+            except Exception as exc:   # a crashed reader must fail the test
+                reader_errors.append(repr(exc))
+
+        threads = [threading.Thread(target=writer, daemon=True),
+                   threading.Thread(target=reader, daemon=True),
+                   threading.Thread(target=reader, daemon=True)]
+        for t in threads:
+            t.start()
+        _time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not reader_errors, f"reader crashed: {reader_errors[0]}"
+        assert not torn, f"torn frame observed: {torn[0]}"
+        assert published["n"] > 100, "writer barely ran; test proves nothing"

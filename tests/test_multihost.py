@@ -84,14 +84,20 @@ def test_two_process_cluster_psum_and_gather(tmp_path):
         )
         for pid in (0, 1)
     ]
+    outs = []
     try:
-        outs = []
         for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out)
+            outs.append(p.communicate(timeout=180)[0])
+    except subprocess.TimeoutExpired:
+        # A partner that died pre-barrier leaves the other stuck in
+        # distributed init; surface whatever output WAS collected instead
+        # of an opaque timeout.
+        raise AssertionError(
+            "worker timed out in the cluster barrier; collected output:\n"
+            + "\n---\n".join(outs)
+        )
     finally:
-        # A worker stuck in the distributed-init barrier (partner died,
-        # port stolen) must not outlive the test as an orphan.
+        # Stuck/failed workers must not outlive the test as orphans.
         for p in procs:
             if p.poll() is None:
                 p.kill()

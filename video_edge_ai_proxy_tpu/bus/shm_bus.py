@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 import time
 from typing import Optional
 
@@ -113,8 +114,14 @@ class ShmFrameBus(FrameBus):
         )
         if not self._kv:
             raise OSError(f"failed to open control KV in {shm_dir}")
-        # Reusable read buffer, grown on demand.
+        # Reusable read buffer, grown on demand. One bus instance is shared
+        # by every gRPC worker thread (serve/server.py wires a single bus
+        # into the handler pool), so the buffer needs a lock: the C ring
+        # read is seqlock-consistent per call, but two Python threads
+        # memcpy-ing into the SAME staging buffer would tear each other's
+        # copies even though the ring itself never tears.
         self._buf = np.empty(4 << 20, dtype=np.uint8)
+        self._buf_lock = threading.Lock()
 
     # -- paths --
 
@@ -208,20 +215,21 @@ class ShmFrameBus(FrameBus):
             return None
         out_len = ctypes.c_uint64(0)
         cm = _CFrameMeta()
-        while True:
-            seq = self._lib.vb_ring_read_latest(
-                h, min_seq, _u8ptr(self._buf), self._buf.nbytes,
-                ctypes.byref(out_len), ctypes.byref(cm),
-            )
-            if seq == ctypes.c_uint64(-1).value:  # buffer too small
-                self._buf = np.empty(int(out_len.value) * 2, dtype=np.uint8)
-                continue
-            break
-        if seq == 0:
-            return None
-        n = int(out_len.value)
-        h_, w_, c_ = int(cm.height), int(cm.width), int(cm.channels)
-        raw = self._buf[:n].copy()
+        with self._buf_lock:
+            while True:
+                seq = self._lib.vb_ring_read_latest(
+                    h, min_seq, _u8ptr(self._buf), self._buf.nbytes,
+                    ctypes.byref(out_len), ctypes.byref(cm),
+                )
+                if seq == ctypes.c_uint64(-1).value:  # buffer too small
+                    self._buf = np.empty(int(out_len.value) * 2, dtype=np.uint8)
+                    continue
+                break
+            if seq == 0:
+                return None
+            n = int(out_len.value)
+            h_, w_, c_ = int(cm.height), int(cm.width), int(cm.channels)
+            raw = self._buf[:n].copy()
         data = raw.reshape(h_, w_, c_) if h_ * w_ * c_ == n else raw
         meta = FrameMeta(
             width=w_, height=h_, channels=c_,
