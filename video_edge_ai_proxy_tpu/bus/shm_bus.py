@@ -116,12 +116,18 @@ class ShmFrameBus(FrameBus):
             raise OSError(f"failed to open control KV in {shm_dir}")
         # Reusable read buffer, grown on demand. One bus instance is shared
         # by every gRPC worker thread (serve/server.py wires a single bus
-        # into the handler pool), so the buffer needs a lock: the C ring
-        # read is seqlock-consistent per call, but two Python threads
-        # memcpy-ing into the SAME staging buffer would tear each other's
-        # copies even though the ring itself never tears.
+        # into the handler pool), so the consumer-side hot path needs a
+        # lock, for two reasons: (a) two threads memcpy-ing into the SAME
+        # staging buffer would tear each other's copies even though the C
+        # ring's seqlock never tears; (b) `_handle` revalidation and
+        # `drop_stream` close native handles — without mutual exclusion two
+        # readers can double-close a handle, or a drop can close one while
+        # a reader is inside the C call (use-after-free). The lock covers
+        # handle resolution THROUGH the copy-out, and every mutation of the
+        # handle table. Reads serialize on a ~ms memcpy; the reference
+        # serialized the same path on a single-threaded Redis server.
         self._buf = np.empty(4 << 20, dtype=np.uint8)
-        self._buf_lock = threading.Lock()
+        self._lock = threading.RLock()
 
     # -- paths --
 
@@ -132,15 +138,16 @@ class ShmFrameBus(FrameBus):
     # -- frame plane --
 
     def create_stream(self, device_id: str, frame_bytes: int, slots: int = 4) -> None:
-        self.drop_stream(device_id)
-        h = self._lib.vb_ring_create(
-            self._ring_path(device_id).encode(), device_id.encode(),
-            slots, frame_bytes,
-        )
-        if not h:
-            raise OSError(f"failed to create ring for {device_id}")
-        self._rings[device_id] = h
-        self._writer.add(device_id)
+        with self._lock:
+            self.drop_stream(device_id)
+            h = self._lib.vb_ring_create(
+                self._ring_path(device_id).encode(), device_id.encode(),
+                slots, frame_bytes,
+            )
+            if not h:
+                raise OSError(f"failed to create ring for {device_id}")
+            self._rings[device_id] = h
+            self._writer.add(device_id)
 
     # A restarted worker re-creates its ring file, so a cached reader mapping
     # can point at a dead inode. Re-validating with os.stat on *every* read
@@ -181,9 +188,6 @@ class ShmFrameBus(FrameBus):
         return h
 
     def publish(self, device_id: str, data: np.ndarray, meta: FrameMeta) -> int:
-        h = self._rings.get(device_id)
-        if h is None or device_id not in self._writer:
-            raise ValueError(f"not the producer for stream {device_id!r}")
         arr = np.ascontiguousarray(data)
         cm = _CFrameMeta(
             width=meta.width or (arr.shape[1] if arr.ndim >= 2 else 0),
@@ -200,9 +204,13 @@ class ShmFrameBus(FrameBus):
             dtype=0,
             time_base=meta.time_base,
         )
-        seq = self._lib.vb_ring_publish(
-            h, _u8ptr(arr), arr.nbytes, ctypes.byref(cm)
-        )
+        with self._lock:
+            h = self._rings.get(device_id)
+            if h is None or device_id not in self._writer:
+                raise ValueError(f"not the producer for stream {device_id!r}")
+            seq = self._lib.vb_ring_publish(
+                h, _u8ptr(arr), arr.nbytes, ctypes.byref(cm)
+            )
         if seq == 0:
             raise OSError(
                 f"publish failed for {device_id} ({arr.nbytes} B > slot?)"
@@ -210,12 +218,12 @@ class ShmFrameBus(FrameBus):
         return int(seq)
 
     def read_latest(self, device_id: str, min_seq: int = 0) -> Optional[Frame]:
-        h = self._handle(device_id)
-        if h is None:
-            return None
         out_len = ctypes.c_uint64(0)
         cm = _CFrameMeta()
-        with self._buf_lock:
+        with self._lock:
+            h = self._handle(device_id)
+            if h is None:
+                return None
             while True:
                 seq = self._lib.vb_ring_read_latest(
                     h, min_seq, _u8ptr(self._buf), self._buf.nbytes,
@@ -253,44 +261,63 @@ class ShmFrameBus(FrameBus):
         return sorted(out)
 
     def drop_stream(self, device_id: str) -> None:
-        h = self._rings.pop(device_id, None)
-        if h:
-            self._lib.vb_ring_close(h)
-        self._writer.discard(device_id)
-        try:
-            os.unlink(self._ring_path(device_id))
-        except FileNotFoundError:
-            pass
+        with self._lock:
+            h = self._rings.pop(device_id, None)
+            if h:
+                self._lib.vb_ring_close(h)
+            self._writer.discard(device_id)
+            try:
+                os.unlink(self._ring_path(device_id))
+            except FileNotFoundError:
+                pass
 
     # -- control plane --
 
     def kv_set(self, key: str, value: str) -> None:
         raw = value.encode()
-        if self._lib.vb_kv_set(self._kv, key.encode(), _u8ptr(
-                np.frombuffer(raw, dtype=np.uint8).copy()), len(raw)) != 0:
-            raise OSError(f"kv_set failed for {key!r} (table full / oversize)")
+        with self._lock:
+            if not self._kv:
+                raise OSError("bus is closed")
+            if self._lib.vb_kv_set(self._kv, key.encode(), _u8ptr(
+                    np.frombuffer(raw, dtype=np.uint8).copy()), len(raw)) != 0:
+                raise OSError(
+                    f"kv_set failed for {key!r} (table full / oversize)")
 
     def kv_get(self, key: str) -> Optional[str]:
         buf = np.empty(_KV_VAL_CAP, dtype=np.uint8)
-        n = self._lib.vb_kv_get(self._kv, key.encode(), _u8ptr(buf), buf.nbytes)
+        with self._lock:
+            if not self._kv:
+                return None
+            n = self._lib.vb_kv_get(
+                self._kv, key.encode(), _u8ptr(buf), buf.nbytes)
         if n <= 0:
             return None
         return bytes(buf[:n]).decode()
 
     def kv_del(self, key: str) -> None:
-        self._lib.vb_kv_del(self._kv, key.encode())
+        with self._lock:
+            if self._kv:
+                self._lib.vb_kv_del(self._kv, key.encode())
 
     def kv_keys(self) -> list[str]:
         buf = np.empty(1 << 20, dtype=np.uint8)
-        n = self._lib.vb_kv_keys(self._kv, _u8ptr(buf), buf.nbytes)
+        with self._lock:
+            if not self._kv:
+                return []
+            n = self._lib.vb_kv_keys(self._kv, _u8ptr(buf), buf.nbytes)
         if n <= 0:
             return []
         return bytes(buf[:n]).decode().splitlines()
 
     def close(self) -> None:
-        for h in self._rings.values():
-            self._lib.vb_ring_close(h)
-        self._rings.clear()
-        if self._kv:
-            self._lib.vb_kv_close(self._kv)
-            self._kv = None
+        # Same lock as the read/drop paths: gRPC's stop(grace) aborts RPCs
+        # but aborted handler threads may still be inside a C ring read —
+        # closing their handle out from under them is the use-after-free
+        # the lock exists to prevent.
+        with self._lock:
+            for h in self._rings.values():
+                self._lib.vb_ring_close(h)
+            self._rings.clear()
+            if self._kv:
+                self._lib.vb_kv_close(self._kv)
+                self._kv = None
