@@ -158,7 +158,51 @@ class TestRestAux:
         assert eng["engine_thread_alive"] is True
         assert eng["device_ok"] is True
         assert eng["tick_age_s"] is not None
-        assert data["workers"] == {"running": 0, "total": 0}
+        assert data["workers"] == {
+            "running": 0, "total": 0, "crash_looping": 0,
+        }
+
+    def test_healthz_degraded_on_crash_looping_worker(self, server):
+        """A registered worker that is down and crash-looping (streak > 1)
+        or dead with nothing supervising it degrades readiness —
+        registered means desired-running (restart-always parity). A single
+        exit (streak 1, routine restart backoff) must NOT flip readiness."""
+        import json
+        import urllib.error
+
+        from video_edge_ai_proxy_tpu.serve.models import (
+            ProcessState, StreamProcess,
+        )
+
+        routine = StreamProcess(
+            name="camrestart",
+            state=ProcessState(
+                status="restarting", running=False, failing_streak=1,
+                restarting=True,
+            ),
+        )
+        broken = StreamProcess(
+            name="camloop",
+            state=ProcessState(
+                status="exited", running=False, failing_streak=3
+            ),
+        )
+        dead = StreamProcess(
+            name="camdead",
+            state=ProcessState(status="exited", running=False, dead=True),
+        )
+        orig = server.pm.list
+        server.pm.list = lambda: orig() + [routine, broken, dead]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                self._get(server, "/healthz")
+            assert exc.value.code == 503
+            data = json.loads(exc.value.read())
+            assert data["status"] == "degraded"
+            # broken + dead degrade; the routine restart (streak 1) doesn't.
+            assert data["workers"]["crash_looping"] == 2
+        finally:
+            server.pm.list = orig
 
     def test_portal_served_at_root(self, server):
         status, body = self._get(server, "/")

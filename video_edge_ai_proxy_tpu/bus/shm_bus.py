@@ -128,6 +128,7 @@ class ShmFrameBus(FrameBus):
         # serialized the same path on a single-threaded Redis server.
         self._buf = np.empty(4 << 20, dtype=np.uint8)
         self._lock = threading.RLock()
+        self._closed = False
 
     # -- paths --
 
@@ -139,6 +140,10 @@ class ShmFrameBus(FrameBus):
 
     def create_stream(self, device_id: str, frame_bytes: int, slots: int = 4) -> None:
         with self._lock:
+            if self._closed:
+                # A creator racing close() must not cache a fresh handle the
+                # close pass will never release (same rule as `_handle`).
+                raise OSError("bus is closed")
             self.drop_stream(device_id)
             h = self._lib.vb_ring_create(
                 self._ring_path(device_id).encode(), device_id.encode(),
@@ -158,6 +163,10 @@ class ShmFrameBus(FrameBus):
     _REVALIDATE_S = 0.25
 
     def _handle(self, device_id: str) -> Optional[int]:
+        if self._closed:
+            # A reader racing close() must not re-open a ring handle the
+            # close pass would never see (leaked mapping).
+            return None
         path = self._ring_path(device_id)
         h = self._rings.get(device_id)
         if h and device_id in self._writer:
@@ -205,6 +214,8 @@ class ShmFrameBus(FrameBus):
             time_base=meta.time_base,
         )
         with self._lock:
+            if self._closed:
+                raise OSError("bus is closed")
             h = self._rings.get(device_id)
             if h is None or device_id not in self._writer:
                 raise ValueError(f"not the producer for stream {device_id!r}")
@@ -315,6 +326,7 @@ class ShmFrameBus(FrameBus):
         # closing their handle out from under them is the use-after-free
         # the lock exists to prevent.
         with self._lock:
+            self._closed = True
             for h in self._rings.values():
                 self._lib.vb_ring_close(h)
             self._rings.clear()

@@ -92,12 +92,16 @@ class Collector:
             ids = [d for d in ids if d in self._only]
         return sorted(ids)
 
-    def keep_streams_hot(self, now_ms: Optional[int] = None) -> None:
+    def keep_streams_hot(self, now_ms: Optional[int] = None) -> List[str]:
         """The engine is a frame consumer like any gRPC client: touching
         ``last_query`` keeps the ingest workers' lazy-decode gate open
-        (reference semantics, ``python/rtsp_to_rtmp.py:144-145``)."""
-        for device_id in self.active_streams():
+        (reference semantics, ``python/rtsp_to_rtmp.py:144-145``).
+        Returns the ids it touched so the caller's tick can reuse the
+        enumeration instead of re-listing the bus."""
+        ids = self.active_streams()
+        for device_id in ids:
             self._bus.touch_query(device_id, now_ms)
+        return ids
 
     def _take_new_frames(self):
         out = []

@@ -112,6 +112,10 @@ class _Inflight:
 class InferenceEngine:
     """Owns the model, the compiled step cache, and the engine thread."""
 
+    # Tracker GC debounce: longer than any worker-restart ring re-create
+    # gap, far shorter than "stream is really gone" timescales.
+    _TRACKER_GC_GRACE_S = 10.0
+
     def __init__(
         self,
         bus: FrameBus,
@@ -147,8 +151,10 @@ class InferenceEngine:
         self.batches = 0
         self.last_tick_monotonic = 0.0
         self._trackers: Dict[str, Any] = {}      # device_id -> IoUTracker
+        self._tracker_absent: Dict[str, float] = {}  # id -> absent-since
         self._probe_cache: tuple = (0.0, None)   # (monotonic, ok | None)
         self._probe_thread: Optional[threading.Thread] = None
+        self._probe_spawn_lock = threading.Lock()
         self._probe_fn = None                    # jitted once, reused
 
     # -- lifecycle --
@@ -443,14 +449,20 @@ class InferenceEngine:
         alive = self._thread is not None and self._thread.is_alive()
         now = time.monotonic()
         age = (now - self.last_tick_monotonic) if self.last_tick_monotonic else None
-        ts, ok = self._probe_cache
-        if (ok is None or now - ts > probe_ttl_s) and (
-            self._probe_thread is None or not self._probe_thread.is_alive()
-        ):
-            self._probe_thread = threading.Thread(
-                target=self._run_probe, name="tpu-health-probe", daemon=True
-            )
-            self._probe_thread.start()
+        with self._probe_spawn_lock:
+            # Check-then-spawn under a lock, inputs re-read inside it:
+            # concurrent /healthz polls must not each start a probe thread
+            # (one would become untracked), and a poll that waited on the
+            # lock must see the probe the winner's thread just completed.
+            now = time.monotonic()
+            ts, ok = self._probe_cache
+            if (ok is None or now - ts > probe_ttl_s) and (
+                self._probe_thread is None or not self._probe_thread.is_alive()
+            ):
+                self._probe_thread = threading.Thread(
+                    target=self._run_probe, name="tpu-health-probe", daemon=True
+                )
+                self._probe_thread.start()
         if self._probe_thread is not None and self._probe_thread.is_alive():
             self._probe_thread.join(timeout=probe_wait_s)
         _, ok = self._probe_cache
@@ -530,7 +542,7 @@ class InferenceEngine:
             # log-and-keep-going stance as the reference's worker loops,
             # rtsp_to_rtmp.py:186-187).
             try:
-                self._collector.keep_streams_hot()
+                active_ids = self._collector.keep_streams_hot()
                 groups = self._collector.collect()
                 submitted: List[_Inflight] = []
                 for group in groups:
@@ -547,6 +559,24 @@ class InferenceEngine:
                 for extra in submitted[:-1]:
                     self._emit(extra)
                 inflight = submitted[-1] if submitted else None
+                # Scope per-stream tracker state to streams that still
+                # exist: a long-lived engine with churning device_ids must
+                # not accumulate IoUTracker entries forever. Absence is
+                # debounced (grace period) because a restarting worker
+                # re-creates its ring unlink-then-create — one sample in
+                # that window must not reset the stream's track-id
+                # numbering (invariant in _assign_tracks).
+                if self._trackers:
+                    now = time.monotonic()
+                    active = set(active_ids)
+                    for d in list(self._trackers):
+                        if d in active:
+                            self._tracker_absent.pop(d, None)
+                            continue
+                        since = self._tracker_absent.setdefault(d, now)
+                        if now - since > self._TRACKER_GC_GRACE_S:
+                            del self._trackers[d]
+                            del self._tracker_absent[d]
             except Exception:
                 log.exception("engine tick failed; continuing")
                 inflight = None

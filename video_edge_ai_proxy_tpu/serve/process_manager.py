@@ -119,6 +119,7 @@ class ProcessManager:
         self._disk_buffer_path = disk_buffer_path
         self._python = python
         self._entries: dict[str, _Entry] = {}
+        self._stopping: set[str] = set()  # mid-stop ids (see stop())
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._supervisor = threading.Thread(
@@ -205,26 +206,35 @@ class ProcessManager:
     def stop(self, device_id: str) -> None:
         with self._lock:
             entry = self._entries.pop(device_id, None)
-        if entry is None:
-            # Still clean the registry if a stale record exists
-            # (reference Stop deletes datastore entry even when the container
-            # is already gone, rtsp_process_manager.go:153-188).
-            if self._storage.get_or_none(PREFIX_RTSP_PROCESS, device_id) is None:
-                raise ProcessError(f"process {device_id!r} not found")
-        else:
-            entry.desired = False
-            if entry.proc and entry.proc.poll() is None:
-                entry.proc.terminate()
-                try:
-                    entry.proc.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    entry.proc.kill()
-                    entry.proc.wait(timeout=5)
-        self._storage.delete(PREFIX_RTSP_PROCESS, device_id)
-        self._bus.drop_stream(device_id)
-        self._bus.kv_del(KEY_STATUS_PREFIX + device_id)
-        self._bus.hdel_all(KEY_LAST_ACCESS_PREFIX + device_id)
-        self._bus.kv_del(KEY_KEYFRAME_ONLY_PREFIX + device_id)
+            # Marked before the (up to ~15 s) terminate/wait below: list()
+            # still sees the storage record during that window, and a
+            # deliberate stop must read as "exited", not as a dead worker
+            # nobody supervises — /healthz gates readiness on the latter.
+            self._stopping.add(device_id)
+        try:
+            if entry is None:
+                # Still clean the registry if a stale record exists
+                # (reference Stop deletes datastore entry even when the container
+                # is already gone, rtsp_process_manager.go:153-188).
+                if self._storage.get_or_none(PREFIX_RTSP_PROCESS, device_id) is None:
+                    raise ProcessError(f"process {device_id!r} not found")
+            else:
+                entry.desired = False
+                if entry.proc and entry.proc.poll() is None:
+                    entry.proc.terminate()
+                    try:
+                        entry.proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        entry.proc.kill()
+                        entry.proc.wait(timeout=5)
+            self._storage.delete(PREFIX_RTSP_PROCESS, device_id)
+            self._bus.drop_stream(device_id)
+            self._bus.kv_del(KEY_STATUS_PREFIX + device_id)
+            self._bus.hdel_all(KEY_LAST_ACCESS_PREFIX + device_id)
+            self._bus.kv_del(KEY_KEYFRAME_ONLY_PREFIX + device_id)
+        finally:
+            with self._lock:
+                self._stopping.discard(device_id)
         log.info("stopped camera process %s", device_id)
 
     def stop_all(self) -> None:
@@ -247,7 +257,13 @@ class ProcessManager:
         record = StreamProcess.from_json(raw)
         with self._lock:
             entry = self._entries.get(device_id)
+            stopping = device_id in self._stopping
         record.state = self._live_state(entry)
+        if entry is None and stopping:
+            # Mid-stop: supervision was detached on purpose; not the
+            # nobody-will-ever-restart-this outage `dead` means.
+            record.state.dead = False
+            record.state.status = "exited"
         record.status = record.state.status
         if entry and entry.tail:
             record.logs = {"stdout": list(entry.tail.lines)[-LOG_TAIL_LINES:]}

@@ -187,19 +187,36 @@ def build_app(
         503 otherwise (k8s-style). Covers the server itself, the worker
         fleet (running/total), and — when the inference plane is on — the
         engine's TPU-side health (SURVEY.md §5.3: device liveness, tick
-        liveness, compile-cache warmth)."""
+        liveness, compile-cache warmth).
+
+        Worker gating: registered workers are *desired running*
+        (restart-always parity), so the fleet degrades the status when a
+        registered worker is down AND either crash-looping (streak > 1 —
+        a single exit puts every routine restart's backoff window at
+        streak 1, which is supervision, not degradation) or dead with no
+        supervised process at all (resume failed: nothing will ever
+        restart it — the worst outage class)."""
         procs = await asyncio.to_thread(pm.list)
         running = sum(1 for p in procs if p.state and p.state.running)
+        crash_looping = sum(
+            1 for p in procs
+            if p.state and not p.state.running
+            and (p.state.failing_streak > 1 or p.state.dead)
+        )
         body: dict = {
             "status": "ok",
-            "workers": {"running": running, "total": len(procs)},
+            "workers": {
+                "running": running,
+                "total": len(procs),
+                "crash_looping": crash_looping,
+            },
             "engine": None,
         }
-        healthy = True
+        healthy = crash_looping == 0
         if engine is not None:
             h = await asyncio.to_thread(engine.health)
             body["engine"] = h
-            healthy = h["healthy"]
+            healthy = healthy and h["healthy"]
         if not healthy:
             body["status"] = "degraded"
         return web.json_response(body, status=200 if healthy else 503)
@@ -249,6 +266,7 @@ class RestServer:
                  engine=None, annotations=None):
         self._app = build_app(pm, settings, engine=engine, annotations=annotations)
         self.engine = engine
+        self.pm = pm
         self._host = host
         self._port = port
         self._loop: Optional[asyncio.AbstractEventLoop] = None
