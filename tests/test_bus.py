@@ -137,6 +137,28 @@ class TestShmSpecific:
         f = cons.read_latest("cam")
         np.testing.assert_array_equal(f.data, img)
 
+    def test_writer_self_heals_replaced_ring_file(self, shm_dir):
+        """The ring file vanishes/gets replaced under its producer (wiped
+        shm dir, tmpfiles cleaner, or a second supervisor racing for the
+        device_id): the writer must NOT keep publishing into the orphaned
+        mapping — it re-creates the file and readers see frames again."""
+        import os
+        import time
+
+        prod = open_bus("shm", shm_dir)
+        cons = open_bus("shm", shm_dir)
+        prod.create_stream("cam", 32 * 32 * 3)
+        img = np.full((32, 32, 3), 1, dtype=np.uint8)
+        prod.publish("cam", img, FrameMeta(timestamp_ms=1))
+        assert cons.read_latest("cam").meta.timestamp_ms == 1
+
+        os.unlink(os.path.join(shm_dir, "cam.ring"))
+        time.sleep(prod._REVALIDATE_S + 0.05)  # cross the stat interval
+        prod.publish("cam", img, FrameMeta(timestamp_ms=2))
+        time.sleep(cons._REVALIDATE_S + 0.05)  # reader re-opens new inode
+        f = cons.read_latest("cam")
+        assert f is not None and f.meta.timestamp_ms == 2
+
 
 class TestRaceStress:
     def test_concurrent_writer_reader_never_tears(self, buses):

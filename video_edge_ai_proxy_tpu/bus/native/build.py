@@ -1,48 +1,15 @@
-"""Compile-on-demand build of the native bus library.
-
-The image has no pybind11 and we need no Python C API — vepbus exposes a plain
-C ABI consumed via ctypes — so the build is a single g++ invocation, cached by
-source hash under the user cache dir.
-"""
+"""Compile-on-demand build of the native bus library (see utils/cbuild.py)."""
 
 from __future__ import annotations
 
-import hashlib
 import os
-import subprocess
-import threading
+
+from ...utils.cbuild import build_library as _build
 
 _SRC = os.path.join(os.path.dirname(__file__), "vepbus.cpp")
-_LOCK = threading.Lock()
-
-
-def _cache_dir() -> str:
-    base = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
-    return os.path.join(base, "vep_tpu")
 
 
 def build_library() -> str:
-    """Return the path to the compiled libvepbus shared object, building it if
-    needed. Raises RuntimeError (with compiler output) on build failure."""
-    with open(_SRC, "rb") as fh:
-        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
-    out_dir = _cache_dir()
-    out = os.path.join(out_dir, f"libvepbus-{digest}.so")
-    if os.path.exists(out):
-        return out
-    with _LOCK:
-        if os.path.exists(out):
-            return out
-        os.makedirs(out_dir, exist_ok=True)
-        tmp = out + f".tmp.{os.getpid()}"
-        cmd = [
-            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-            "-Wall", "-Wextra", _SRC, "-o", tmp,
-        ]
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"vepbus native build failed:\n{proc.stdout}\n{proc.stderr}"
-            )
-        os.replace(tmp, out)  # atomic: concurrent builders race benignly
-    return out
+    """Return the path to the compiled libvepbus shared object, building it
+    if needed. Raises RuntimeError (with compiler output) on build failure."""
+    return _build(_SRC, "vepbus")

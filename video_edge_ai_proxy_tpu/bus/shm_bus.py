@@ -106,9 +106,10 @@ class ShmFrameBus(FrameBus):
         self._dir = shm_dir
         os.makedirs(shm_dir, exist_ok=True)
         self._rings: dict[str, int] = {}  # device_id -> handle (this process)
-        self._inodes: dict[str, int] = {}  # reader handles: inode at open time
+        self._inodes: dict[str, int] = {}  # ring inode at open/create time
         self._checked: dict[str, float] = {}  # last inode revalidation time
         self._writer: set[str] = set()
+        self._writer_params: dict[str, tuple[int, int]] = {}  # (bytes, slots)
         self._kv = self._lib.vb_kv_open(
             os.path.join(shm_dir, "control.kv").encode(), _KV_SLOTS
         )
@@ -153,6 +154,12 @@ class ShmFrameBus(FrameBus):
                 raise OSError(f"failed to create ring for {device_id}")
             self._rings[device_id] = h
             self._writer.add(device_id)
+            self._writer_params[device_id] = (frame_bytes, slots)
+            try:
+                self._inodes[device_id] = os.stat(
+                    self._ring_path(device_id)).st_ino
+            except FileNotFoundError:
+                pass  # raced an unlink; revalidation in publish() recreates
 
     # A restarted worker re-creates its ring file, so a cached reader mapping
     # can point at a dead inode. Re-validating with os.stat on *every* read
@@ -219,6 +226,7 @@ class ShmFrameBus(FrameBus):
             h = self._rings.get(device_id)
             if h is None or device_id not in self._writer:
                 raise ValueError(f"not the producer for stream {device_id!r}")
+            h = self._writer_revalidate(device_id, h)
             seq = self._lib.vb_ring_publish(
                 h, _u8ptr(arr), arr.nbytes, ctypes.byref(cm)
             )
@@ -227,6 +235,35 @@ class ShmFrameBus(FrameBus):
                 f"publish failed for {device_id} ({arr.nbytes} B > slot?)"
             )
         return int(seq)
+
+    def _writer_revalidate(self, device_id: str, h: int) -> int:
+        """Producer-side self-heal (interval-limited stat, same cadence as
+        reader revalidation): if the ring file was unlinked/replaced under
+        this writer — a wiped shm dir, a tmpfiles cleaner, or a second
+        supervisor racing for the device_id — publishing would otherwise
+        continue into the orphaned mapping forever while readers watch the
+        new file stay silent. Detect the inode mismatch, log loudly, and
+        re-create to reclaim the path. Called with the bus lock held."""
+        now = time.monotonic()
+        if now - self._checked.get(device_id, 0.0) < self._REVALIDATE_S:
+            return h
+        self._checked[device_id] = now
+        path = self._ring_path(device_id)
+        try:
+            ino = os.stat(path).st_ino
+        except FileNotFoundError:
+            ino = None
+        if ino is not None and ino == self._inodes.get(device_id):
+            return h
+        log.warning(
+            "ring file for %s was %s under its producer; re-creating "
+            "(another supervisor racing for this device_id, or the shm "
+            "dir was cleaned)", device_id,
+            "removed" if ino is None else "replaced",
+        )
+        frame_bytes, slots = self._writer_params[device_id]
+        self.create_stream(device_id, frame_bytes, slots)
+        return self._rings[device_id]
 
     def read_latest(self, device_id: str, min_seq: int = 0) -> Optional[Frame]:
         out_len = ctypes.c_uint64(0)
@@ -277,6 +314,8 @@ class ShmFrameBus(FrameBus):
             if h:
                 self._lib.vb_ring_close(h)
             self._writer.discard(device_id)
+            self._writer_params.pop(device_id, None)
+            self._inodes.pop(device_id, None)
             try:
                 os.unlink(self._ring_path(device_id))
             except FileNotFoundError:

@@ -3,9 +3,16 @@
 Reference behavior (``python/archive.py:33-100``): a dedicated thread consumes
 per-GOP packet groups and muxes one MP4 per GOP named
 ``<start_ts_ms>_<duration_ms>.mp4``. We keep the thread + queue + naming
-contract; the payload differs by design — the reference muxes compressed
-packets, we encode decoded frames through OpenCV's VideoWriter (mp4v), with an
-``.npz`` raw fallback when no encoder backend is available.
+contract. Two payload paths:
+
+- ``PacketGopSegment`` (primary, packet sources): the compressed GOP is
+  stream-copied into the MP4 with pts/dts rebased to 0 — bit-exact, ~zero
+  CPU, exactly the reference's mux (``python/archive.py:75-100``; rebase at
+  ``:81-84``; duration from packet durations with a dts-span fallback at
+  ``:45-72``).
+- ``GopSegment`` (fallback, decoded-frame sources): frames re-encoded through
+  OpenCV's VideoWriter (mp4v), with an ``.npz`` raw fallback when no encoder
+  backend is available.
 """
 
 from __future__ import annotations
@@ -44,6 +51,33 @@ class GopSegment:
         return int(len(self.frames) * 1000 / max(self.fps, 1.0))
 
 
+@dataclass
+class PacketGopSegment:
+    """One compressed GOP: av.Packet list (payloads included) + the
+    demuxer's StreamInfo for stream-copy muxing."""
+
+    device_id: str
+    start_ts_ms: int
+    info: object                       # av.StreamInfo
+    packets: List[object] = field(default_factory=list)  # av.Packet
+
+    @property
+    def duration_ms(self) -> int:
+        """Packet-duration sum; dts-span fallback for cameras that ship no
+        durations (reference ``python/archive.py:45-72``)."""
+        num, den = self.info.time_base
+        scale = 1000.0 * num / den
+        total = sum(max(p.duration, 0) for p in self.packets)
+        if total > 0:
+            return int(total * scale)
+        if len(self.packets) >= 2:
+            span = self.packets[-1].dts - self.packets[0].dts
+            # Span misses the last frame's display time; pro-rate it.
+            span += span // max(len(self.packets) - 1, 1)
+            return int(span * scale)
+        return 0
+
+
 class SegmentArchiver:
     """Background thread writing GOP segments to ``<dir>/<device_id>/``."""
 
@@ -79,13 +113,15 @@ class SegmentArchiver:
             except Exception as exc:  # archiver must never kill ingest
                 log.error("failed to archive segment: %s", exc)
 
-    def _write(self, seg: GopSegment) -> None:
+    def _write(self, seg) -> None:
+        empty = not (seg.packets if isinstance(seg, PacketGopSegment)
+                     else seg.frames)
+        if empty:
+            return
         dev_dir = os.path.join(self.out_dir, seg.device_id)
         os.makedirs(dev_dir, exist_ok=True)
         stem = f"{seg.start_ts_ms}_{seg.duration_ms}"  # naming contract:
         # reference python/archive.py:75 ("<start_ts_ms>_<duration_ms>.mp4")
-        if not seg.frames:
-            return
         # De-collide segments that start within the same millisecond.
         n = 1
         while os.path.exists(os.path.join(dev_dir, stem + ".mp4")) or os.path.exists(
@@ -94,6 +130,9 @@ class SegmentArchiver:
             stem = f"{seg.start_ts_ms}_{seg.duration_ms}-{n}"
             n += 1
         path = os.path.join(dev_dir, stem + ".mp4")
+        if isinstance(seg, PacketGopSegment):
+            self._write_stream_copy(path, seg)
+            return
         if not self._write_mp4(path, seg):
             np.savez_compressed(
                 os.path.join(dev_dir, stem + ".npz"),
@@ -101,6 +140,18 @@ class SegmentArchiver:
                 fps=seg.fps,
                 start_ts_ms=seg.start_ts_ms,
             )
+
+    @staticmethod
+    def _write_stream_copy(path: str, seg: PacketGopSegment) -> None:
+        """Mux the compressed GOP, pts/dts rebased so the segment starts at
+        0 (reference ``python/archive.py:81-84``). No transcode."""
+        from .av import StreamCopyMuxer
+
+        base = seg.packets[0].dts
+        mux = StreamCopyMuxer(path, seg.info)
+        with mux:
+            for pkt in seg.packets:
+                mux.write(pkt, ts_offset=base)
 
     @staticmethod
     def _write_mp4(path: str, seg: GopSegment) -> bool:

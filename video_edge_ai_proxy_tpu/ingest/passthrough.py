@@ -7,12 +7,16 @@ toggle flips on (Redis hash ``proxy_rtmp``, written by
 GOP — so the remote stream starts on a decodable keyframe — then relays
 live. Toggle-off closes the remote mux.
 
-Transport difference by design: the reference re-muxes *compressed* packets
-(PyAV); this build encodes decoded frames through OpenCV's FFmpeg backend.
-That supports rtmp:// where the cv2 build allows it and any local file
-target (how the tests drive the flush semantics). When no backend can open
-the sink, the toggle stays tracked and a warning is logged once — same
-observable control-plane state, degraded transport.
+Two transports:
+
+- ``PacketPassthroughWriter`` (primary, packet sources): remuxes the
+  *compressed* packets into FLV/RTMP via the native libav shim — no
+  transcode, no decode-gate pinning, real H.264 on the wire, exactly the
+  reference's relay (``rtsp_to_rtmp.py:163-182``).
+- ``PassthroughWriter`` (fallback, decoded-frame sources): encodes decoded
+  frames through OpenCV's FFmpeg backend. When no backend can open the
+  sink, the toggle stays tracked and a warning is logged once — same
+  observable control-plane state, degraded transport.
 """
 
 from __future__ import annotations
@@ -26,6 +30,151 @@ import numpy as np
 from ..utils.logging import get_logger
 
 log = get_logger("ingest.passthrough")
+
+
+class PacketPassthroughWriter:
+    """Stream-copy relay: compressed packets in, FLV/RTMP (or any
+    libav-muxable sink) out. Fed every demuxed packet via ``feed`` whether
+    or not the toggle is on — the current GOP stays buffered so toggle-on
+    starts the remote stream at a keyframe (reference
+    ``rtsp_to_rtmp.py:136-139,155-157``)."""
+
+    def __init__(self, endpoint: str, info, max_buffer_bytes: int = 16 << 20):
+        self.endpoint = endpoint
+        self.info = info                     # av.StreamInfo of the source
+        self._gop: Deque = deque()           # av.Packet of the current GOP
+        self._gop_bytes = 0
+        self._max_buffer_bytes = max_buffer_bytes
+        self._mux = None
+        self._base_ts: Optional[int] = None  # first relayed dts -> 0
+        self._failed = False
+        self.requested = False
+        self.active = False
+        self.written = 0
+
+    @staticmethod
+    def _format_for(endpoint: str) -> str:
+        if endpoint.startswith(("rtmp://", "rtmps://")):
+            return "flv"     # the container RTMP carries
+        return ""            # local file sinks: guess from extension
+
+    def feed(self, pkt) -> None:
+        """One demuxed packet (with payload). Buffers the GOP; relays live
+        when active."""
+        if pkt.is_keyframe:
+            self._gop.clear()
+            self._gop_bytes = 0
+        self._gop.append(pkt)
+        self._gop_bytes += len(pkt.data)
+        if self._gop_bytes > self._max_buffer_bytes:
+            # Oversized GOP: drop the WHOLE buffer, never just its head —
+            # a buffer without its keyframe would flush an undecodable
+            # prefix on toggle-on. An empty buffer makes _write wait for
+            # the next keyframe instead.
+            self._gop.clear()
+            self._gop_bytes = 0
+        if self.active:
+            self._write(pkt)
+
+    def reset(self, info) -> None:
+        """Source reconnected: new demuxer, new timestamps, possibly new
+        codec parameters. Buffered packets from the dead stream must not be
+        flushed into a sink built from the new info, and a live relay must
+        restart its mux so rebasing starts from the new stream's clock
+        (otherwise the first post-reconnect write produces wildly
+        non-monotonic timestamps and kills the sink)."""
+        self.info = info
+        self._gop.clear()
+        self._gop_bytes = 0
+        if self.requested:
+            # Resume a relay the operator still wants: a stream drop is not
+            # a toggle-off. Reopen cleanly; failure follows the usual
+            # tracked-but-off path.
+            self._close()
+            self._failed = False
+            self.active = self._open()
+        else:
+            self._close()
+            self.active = False
+
+    def set_active(self, active: bool) -> None:
+        if active == self.requested:
+            return
+        self.requested = active
+        if not active:
+            self.active = False
+            self._failed = False   # a fresh toggle-on retries the sink
+            self._close()
+            log.info("packet passthrough to %s stopped", self.endpoint)
+            return
+        if self._open():
+            self.active = True
+            # Everything currently buffered (from the GOP-head keyframe on)
+            # goes first so the sink starts decodable; the caller feeds the
+            # in-flight packet only after this returns, so nothing is
+            # relayed twice (reference rtsp_to_rtmp.py:136-139,163-182).
+            for pkt in self._gop:
+                self._write(pkt)
+            log.info(
+                "packet passthrough to %s started (flushed %d buffered "
+                "packets)", self.endpoint, len(self._gop),
+            )
+
+    def _open(self) -> bool:
+        if self._failed:
+            return False
+        from .av import StreamCopyMuxer
+
+        if "://" not in self.endpoint:
+            os.makedirs(os.path.dirname(self.endpoint) or ".", exist_ok=True)
+        try:
+            self._mux = StreamCopyMuxer(
+                self.endpoint, self.info, format=self._format_for(self.endpoint)
+            )
+        except IOError as exc:
+            self._fail(str(exc))
+            return False
+        self._base_ts = None
+        return True
+
+    def _write(self, pkt) -> None:
+        if self._mux is None:
+            return
+        if self._base_ts is None:
+            if not pkt.is_keyframe:
+                # Fresh sink with nothing flushed yet (oversized-GOP drop,
+                # or a reconnect resume): the remote stream must begin at a
+                # keyframe to be decodable — hold until the next GOP head.
+                return
+            self._base_ts = pkt.dts
+        try:
+            self._mux.write(pkt, ts_offset=self._base_ts)
+            self.written += 1
+        except IOError as exc:
+            self._fail(str(exc))
+            self._close()
+
+    def _fail(self, why: str) -> None:
+        if not self._failed:
+            log.warning(
+                "RTMP packet passthrough to %s unavailable (%s); toggle "
+                "state is tracked only, transport off until re-toggled",
+                self.endpoint, why,
+            )
+        self._failed = True
+        self.active = False
+
+    def _close(self) -> None:
+        if self._mux is not None:
+            try:
+                self._mux.close()
+            except IOError as exc:
+                log.warning("closing passthrough sink failed: %s", exc)
+            self._mux = None
+
+    def close(self) -> None:
+        self._close()
+        self.active = False
 
 
 class PassthroughWriter:

@@ -17,10 +17,11 @@ Decode gating (reference semantics, ``rtsp_to_rtmp.py:141-153``,
 - non-keyframes decode only when a client queried within ``active_window``
   seconds (default 10, reference ``rtsp_to_rtmp.py:144-145``);
 - keyframe-only mode (per-device KV flag) restricts decode to keyframes;
-- archiving enabled forces full decode (our archive stores decoded GOP
-  segments; the reference archives compressed packets,
-  ``python/archive.py:75-100`` — a deliberate re-design, we have no demux-level
-  packet access through OpenCV).
+- with a packet source (the default), archive and RTMP pass-through consume
+  *compressed* packets (stream copy, ``python/archive.py:75-100``,
+  ``rtsp_to_rtmp.py:163-182``) and never touch the decode gate; on the
+  OpenCV fallback they consume decoded frames and therefore force decode
+  while enabled.
 
 Failure semantics (reference ``rtsp_to_rtmp.py:61-79,186-187``): initial
 connect failure exits nonzero so the supervisor restarts the worker
@@ -40,7 +41,7 @@ from typing import Optional
 
 from ..bus import FrameBus, FrameMeta, open_bus
 from ..utils.logging import get_logger
-from .archive import GopSegment, SegmentArchiver
+from .archive import GopSegment, PacketGopSegment, SegmentArchiver
 from .sources import VideoSource, open_source
 
 log = get_logger("ingest.worker")
@@ -102,6 +103,11 @@ class IngestWorker:
         self._gop_frames: list = []
         self._gop_start_ms = 0
         self._passthrough = None  # built in run() once source fps is known
+        # Packet mode: source exposes compressed payloads, so archive and
+        # pass-through are stream copies that never touch the decode gate.
+        self._packet_mode = bool(getattr(self.source, "supports_packets", False))
+        self._gop_packets: list = []
+        self._gop_info = None  # StreamInfo captured at GOP open
 
     # -- control-plane reads (per packet; shm KV, nanosecond-cheap) --
 
@@ -110,12 +116,13 @@ class IngestWorker:
         return last is not None and (now_ms - last) < self.cfg.active_window_s * 1000
 
     def _should_decode(self, is_keyframe: bool, now_ms: int) -> bool:
-        if self._archiver is not None:
-            return True
-        if self._passthrough is not None and self._passthrough.active:
-            # Live relay consumes pixels (we encode decoded frames where the
-            # reference re-muxed packets), so it pins decoding on.
-            return True
+        if not self._packet_mode:
+            # OpenCV fallback: archive/relay consume decoded frames, so
+            # they pin decoding on. Packet mode stream-copies instead.
+            if self._archiver is not None:
+                return True
+            if self._passthrough is not None and self._passthrough.active:
+                return True
         if is_keyframe:
             return True
         if self.bus.keyframe_only(self.cfg.device_id):
@@ -151,7 +158,7 @@ class IngestWorker:
     # -- archive plumbing --
 
     def _archive_frame(self, frame, meta: FrameMeta) -> None:
-        if self._archiver is None:
+        if self._archiver is None or self._packet_mode:
             return
         if meta.is_keyframe and self._gop_frames:
             # Keyframe closes the previous GOP -> hand to archiver thread
@@ -170,6 +177,30 @@ class IngestWorker:
             if not self._gop_frames:
                 self._gop_start_ms = meta.timestamp_ms
             self._gop_frames.append(frame)
+
+    def _archive_packet(self, pkt, is_keyframe: bool, now_ms: int) -> None:
+        """Compressed-GOP archiving (packet mode): keyframe closes the
+        previous GOP and opens a new one — same grouping as the reference's
+        demux loop (rtsp_to_rtmp.py:97-110), but with real packets."""
+        if self._archiver is None:
+            return
+        if is_keyframe and self._gop_packets:
+            self._archiver.submit(
+                PacketGopSegment(
+                    device_id=self.cfg.device_id,
+                    start_ts_ms=self._gop_start_ms,
+                    info=self._gop_info,
+                    packets=self._gop_packets,
+                )
+            )
+            self._gop_packets = []
+        if is_keyframe or self._gop_packets:
+            if not self._gop_packets:
+                self._gop_start_ms = now_ms
+                # Captured at GOP open: the source may be closed (EOF) or
+                # re-opened with new params by the time the GOP is flushed.
+                self._gop_info = self.source.stream_info
+            self._gop_packets.append(pkt)
 
     # -- RTMP pass-through (reference §3.4: toggle + buffered-GOP flush) --
 
@@ -201,11 +232,18 @@ class IngestWorker:
             self._archiver = SegmentArchiver(cfg.disk_buffer_path)
             self._archiver.start()
         if cfg.rtmp_endpoint:
-            from .passthrough import PassthroughWriter
+            if self._packet_mode:
+                from .passthrough import PacketPassthroughWriter
 
-            self._passthrough = PassthroughWriter(
-                cfg.rtmp_endpoint, fps=self.source.fps or 30.0
-            )
+                self._passthrough = PacketPassthroughWriter(
+                    cfg.rtmp_endpoint, self.source.stream_info
+                )
+            else:
+                from .passthrough import PassthroughWriter
+
+                self._passthrough = PassthroughWriter(
+                    cfg.rtmp_endpoint, fps=self.source.fps or 30.0
+                )
         log.info(
             "ingest worker up: device=%s source=%s %dx%d@%.1ffps",
             cfg.device_id, cfg.rtsp_endpoint,
@@ -229,6 +267,12 @@ class IngestWorker:
                         break
                     try:
                         self.source.open()
+                        if self._packet_mode and self._passthrough is not None:
+                            # Fresh demuxer: new clock, possibly new codec
+                            # params. Stale GOP buffer and mux must go; an
+                            # operator-requested relay resumes on the new
+                            # stream's next keyframe.
+                            self._passthrough.reset(self.source.stream_info)
                     except ConnectionError:
                         pass
                     continue
@@ -239,11 +283,25 @@ class IngestWorker:
                 now_ms = pkt.timestamp_ms
                 self._maybe_passthrough()
 
+                if self._packet_mode and (
+                    self._archiver is not None or self._passthrough is not None
+                ):
+                    # Compressed consumers ride the demux path: one payload
+                    # memcpy, zero codec work, decode gate untouched.
+                    full = self.source.packet_with_data()
+                    if self._passthrough is not None:
+                        self._passthrough.feed(full)
+                    self._archive_packet(full, pkt.is_keyframe, now_ms)
+
                 if self._should_decode(pkt.is_keyframe, now_ms):
                     frame = self.source.retrieve()
                     if frame is None:
                         continue
                     self._decoded += 1
+                    frame_type = (
+                        getattr(self.source, "last_frame_type", "")
+                        or ("I" if pkt.is_keyframe else "P")
+                    )
                     meta = FrameMeta(
                         width=frame.shape[1],
                         height=frame.shape[0],
@@ -254,7 +312,7 @@ class IngestWorker:
                         packet=pkt.packet,
                         keyframe_cnt=self._keyframes,
                         is_keyframe=pkt.is_keyframe,
-                        frame_type="I" if pkt.is_keyframe else "P",
+                        frame_type=frame_type,
                         time_base=pkt.time_base,
                     )
                     try:
@@ -278,7 +336,7 @@ class IngestWorker:
                     self._published += 1
                     self._fps_window.append(time.monotonic())
                     self._archive_frame(frame, meta)
-                    if self._passthrough is not None:
+                    if self._passthrough is not None and not self._packet_mode:
                         self._passthrough.buffer(frame, meta.is_keyframe)
                         self._passthrough.relay(frame)
 
@@ -288,6 +346,19 @@ class IngestWorker:
         finally:
             self._publish_status(time.monotonic(), force=True)
             if self._archiver is not None:
+                if self._gop_packets:
+                    # Flush the trailing (keyframe-unclosed) GOP — file
+                    # sources end mid-GOP; dropping it would lose the tail
+                    # (the reference loses it; deliberate divergence).
+                    self._archiver.submit(
+                        PacketGopSegment(
+                            device_id=self.cfg.device_id,
+                            start_ts_ms=self._gop_start_ms,
+                            info=self._gop_info,
+                            packets=self._gop_packets,
+                        )
+                    )
+                    self._gop_packets = []
                 self._archiver.stop()
             if self._passthrough is not None:
                 self._passthrough.close()
