@@ -1,0 +1,164 @@
+"""Host-side decode capacity bench: can this host feed the TPU?
+
+SURVEY.md §7 hard part 6: the north-star workload is 16x1080p RTSP at
+30 fps (480 aggregate fps of H.264 decode) on the TPU-VM host CPU —
+round 1 never measured whether the host side (demux, decode, bus publish)
+can source it. This bench answers that with the real worker pipeline:
+``IngestWorker`` processes over ``PacketSource`` (native libav demux +
+decode) publishing to the shared-memory bus, i.e. exactly the per-camera
+path, minus only the RTSP network layer.
+
+Modes measured per scenario (workers x resolution):
+- ``active``: a client query keeps the decode gate open (the engine's
+  ``keep_streams_hot`` does this in production) -> full decode+publish rate.
+- ``idle``: no client -> keyframe-only decode; shows what the lazy gate
+  saves (reference semantics, ``rtsp_to_rtmp.py:141-153``).
+
+The file source is unpaced (demux/decode run flat out), so rates are
+CAPACITY (max sustainable), not the 30 fps a real camera would deliver.
+Results are read from each worker's status heartbeat counters. The fixture
+is long (default 120 s of video) so the measurement window mostly fits in
+one file pass; any EOF->reopen (1 s reconnect sleep) inside the window
+biases rates LOW — numbers are capacity floors, never inflated.
+
+Usage: python tools/bench_host.py [--streams 16] [--seconds 10] [--res 1080]
+Prints one JSON line per scenario + a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from video_edge_ai_proxy_tpu.bus import open_bus
+from video_edge_ai_proxy_tpu.ingest import av
+from video_edge_ai_proxy_tpu.ingest.worker import KEY_STATUS_PREFIX
+
+RES = {
+    1080: (1920, 1080),
+    720: (1280, 720),
+    480: (640, 480),
+}
+
+
+def make_fixture(path: str, res: int, seconds: int = 10, fps: int = 30,
+                 gop: int = 30) -> None:
+    w, h = RES[res]
+    av.write_test_video(path, w, h, frames=seconds * fps, fps=fps, gop=gop)
+
+
+def read_counters(bus, device_ids):
+    out = {}
+    for d in device_ids:
+        raw = bus.kv_get(KEY_STATUS_PREFIX + d)
+        if raw:
+            out[d] = json.loads(raw)
+    return out
+
+
+def run_scenario(fixture: str, shm_dir: str, streams: int, seconds: float,
+                 active: bool) -> dict:
+    bus = open_bus("shm", shm_dir)
+    device_ids = [f"bench{i}" for i in range(streams)]
+    procs = []
+    env_base = dict(os.environ, vep_shm_dir=shm_dir, PYTHONUNBUFFERED="1")
+    for d in device_ids:
+        env = dict(env_base, rtsp_endpoint=fixture, device_id=d)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "video_edge_ai_proxy_tpu.ingest.worker"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        ))
+    try:
+        # Wait for every worker's first heartbeat (imports + open).
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if len(read_counters(bus, device_ids)) == streams:
+                break
+            time.sleep(0.25)
+        else:
+            raise RuntimeError("workers never came up")
+        if active:
+            for d in device_ids:
+                bus.touch_query(d)
+        time.sleep(1.0)  # settle past startup transients
+        t0 = time.monotonic()
+        c0 = read_counters(bus, device_ids)
+        end = t0 + seconds
+        while time.monotonic() < end:
+            if active:
+                for d in device_ids:
+                    bus.touch_query(d)  # hold the gate open (engine parity)
+            time.sleep(0.5)
+        c1 = read_counters(bus, device_ids)
+        dt = time.monotonic() - t0
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for d in device_ids:
+            bus.drop_stream(d)
+            bus.kv_del(KEY_STATUS_PREFIX + d)
+        bus.close()
+
+    def rate(key):
+        return sum(c1[d][key] - c0[d][key] for d in device_ids) / dt
+
+    return {
+        "streams": streams,
+        "mode": "active" if active else "idle",
+        "demux_pps": round(rate("packets"), 1),
+        "decode_fps": round(rate("decoded"), 1),
+        "publish_fps": round(rate("published"), 1),
+        "seconds": round(dt, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--streams", type=int, default=16)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--res", type=int, default=1080, choices=sorted(RES))
+    ap.add_argument("--fixture-seconds", type=int, default=120,
+                    help="length of video in the fixture; must exceed "
+                         "seconds x (capacity/30fps) to avoid EOF loops "
+                         "deflating the measurement")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="vep_bench_host_")
+    fixture = os.path.join(tmp, f"src{args.res}.mp4")
+    make_fixture(fixture, args.res, seconds=args.fixture_seconds)
+    shm_dir = os.path.join("/dev/shm", f"vep_bench_host_{os.getpid()}")
+
+    results = []
+    for streams, active in ((1, True), (args.streams, True),
+                            (args.streams, False)):
+        r = run_scenario(fixture, shm_dir, streams, args.seconds, active)
+        r["res"] = args.res
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+    north_star_fps = 30 * args.streams
+    agg = results[1]["decode_fps"]
+    print(json.dumps({
+        "metric": f"host_decode_capacity_{args.res}p_{args.streams}stream",
+        "value": agg,
+        "unit": "fps",
+        "vs_required": round(agg / north_star_fps, 2),
+        "idle_decode_fps": results[2]["decode_fps"],
+        "idle_demux_pps": results[2]["demux_pps"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

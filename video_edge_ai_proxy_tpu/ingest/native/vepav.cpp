@@ -292,13 +292,22 @@ int va_decode(void* h, uint8_t* out, int64_t cap, VAFrameMeta* fm) {
   }
   if (d->pkt_valid && !d->pkt_sent) {
     int rc = avcodec_send_packet(d->dec, d->pkt);
-    // EAGAIN: decoder wants draining first; fall through to receive.
-    if (rc < 0 && rc != AVERROR(EAGAIN) && rc != AVERROR_INVALIDDATA) return rc;
-    d->pkt_sent = true;
+    if (rc == 0 || rc == AVERROR_INVALIDDATA) {
+      d->pkt_sent = true;
+    } else if (rc != AVERROR(EAGAIN)) {
+      return rc;
+    }
+    // EAGAIN: output queue full (multi-frame packets, e.g. PAFF fields).
+    // pkt_sent stays false — receive below frees a slot, then retry, so
+    // the packet's data is never silently dropped.
   }
   int rc = avcodec_receive_frame(d->dec, d->frame);
   if (rc == AVERROR(EAGAIN) || rc == AVERROR_EOF) return 0;
   if (rc < 0) return rc;
+  if (d->pkt_valid && !d->pkt_sent) {
+    int rc2 = avcodec_send_packet(d->dec, d->pkt);
+    if (rc2 == 0 || rc2 == AVERROR_INVALIDDATA) d->pkt_sent = true;
+  }
   return frame_to_bgr(d, out, cap, fm);
 }
 

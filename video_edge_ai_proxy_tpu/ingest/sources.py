@@ -249,10 +249,6 @@ class PacketSource(VideoSource):
         no codec work) — feeds GOP buffers for archive/pass-through."""
         return self._d.packet_data() if self._d is not None else b""
 
-    def packet(self):
-        """The grabbed packet's full metadata (av.Packet sans payload)."""
-        return self._pkt
-
     def packet_with_data(self):
         """av.Packet of the grabbed packet including its compressed
         payload (for GOP buffering / stream-copy consumers)."""
@@ -276,6 +272,14 @@ class PacketSource(VideoSource):
         the reference ships frame.pict_type in VideoFrame.frame_type
         (read_image.py:99-117); round 1 guessed it from keyframe flags."""
         return self._d.last_frame_type if self._d is not None else ""
+
+    @property
+    def last_frame_pts(self) -> Optional[int]:
+        """pts of the last DECODED frame (stream time_base). Under decoder
+        delay/reordering this lags the grabbed packet's pts — published
+        frames must carry their own presentation time, as the reference
+        does by filling VideoFrame from the frame (read_image.py:99-117)."""
+        return self._d.last_frame_pts if self._d is not None else None
 
     def close(self) -> None:
         if self._d is not None:

@@ -107,6 +107,7 @@ class IngestWorker:
         # pass-through are stream copies that never touch the decode gate.
         self._packet_mode = bool(getattr(self.source, "supports_packets", False))
         self._gop_packets: list = []
+        self._gop_bytes = 0
         self._gop_info = None  # StreamInfo captured at GOP open
 
     # -- control-plane reads (per packet; shm KV, nanosecond-cheap) --
@@ -178,13 +179,18 @@ class IngestWorker:
                 self._gop_start_ms = meta.timestamp_ms
             self._gop_frames.append(frame)
 
-    def _archive_packet(self, pkt, is_keyframe: bool, now_ms: int) -> None:
-        """Compressed-GOP archiving (packet mode): keyframe closes the
-        previous GOP and opens a new one — same grouping as the reference's
-        demux loop (rtsp_to_rtmp.py:97-110), but with real packets."""
-        if self._archiver is None:
-            return
-        if is_keyframe and self._gop_packets:
+    # Cap on a single buffered GOP (a camera that stops emitting keyframes
+    # must not grow the buffer until OOM). On overflow the buffered prefix
+    # — which starts at a keyframe, so it is decodable — is submitted as a
+    # segment, and the GOP's remaining packets are skipped until the next
+    # keyframe (the empty-buffer guard below does that naturally).
+    MAX_GOP_BYTES = 64 << 20
+
+    def _flush_gop_tail(self) -> None:
+        """Submit the buffered (keyframe-headed, keyframe-unclosed) GOP —
+        at EOF/reconnect/shutdown. Mixing packets from two demuxer
+        instances in one segment would rebase across unrelated clocks."""
+        if self._archiver is not None and self._gop_packets:
             self._archiver.submit(
                 PacketGopSegment(
                     device_id=self.cfg.device_id,
@@ -193,14 +199,28 @@ class IngestWorker:
                     packets=self._gop_packets,
                 )
             )
-            self._gop_packets = []
+        self._gop_packets = []
+
+    def _archive_packet(self, pkt, is_keyframe: bool, now_ms: int) -> None:
+        """Compressed-GOP archiving (packet mode): keyframe closes the
+        previous GOP and opens a new one — same grouping as the reference's
+        demux loop (rtsp_to_rtmp.py:97-110), but with real packets."""
+        if self._archiver is None:
+            return
+        if self._gop_packets and (
+            is_keyframe
+            or self._gop_bytes + len(pkt.data) > self.MAX_GOP_BYTES
+        ):
+            self._flush_gop_tail()
         if is_keyframe or self._gop_packets:
             if not self._gop_packets:
                 self._gop_start_ms = now_ms
+                self._gop_bytes = 0
                 # Captured at GOP open: the source may be closed (EOF) or
                 # re-opened with new params by the time the GOP is flushed.
                 self._gop_info = self.source.stream_info
             self._gop_packets.append(pkt)
+            self._gop_bytes += len(pkt.data)
 
     # -- RTMP pass-through (reference §3.4: toggle + buffered-GOP flush) --
 
@@ -262,6 +282,11 @@ class IngestWorker:
                         "stream %s EOF/gone; reconnecting in %.0fs",
                         cfg.device_id, RECONNECT_DELAY_S,
                     )
+                    # The buffered GOP is a valid keyframe-headed prefix of
+                    # the dying stream; archive it now — the re-opened
+                    # demuxer has a fresh clock (and possibly fresh codec
+                    # params) that must not be mixed into this segment.
+                    self._flush_gop_tail()
                     self.source.close()
                     if self._stop.wait(RECONNECT_DELAY_S):
                         break
@@ -302,12 +327,16 @@ class IngestWorker:
                         getattr(self.source, "last_frame_type", "")
                         or ("I" if pkt.is_keyframe else "P")
                     )
+                    # Under decoder delay the frame lags the grabbed packet;
+                    # publish the FRAME's presentation time (reference fills
+                    # VideoFrame from the frame, read_image.py:99-117).
+                    frame_pts = getattr(self.source, "last_frame_pts", None)
                     meta = FrameMeta(
                         width=frame.shape[1],
                         height=frame.shape[0],
                         channels=frame.shape[2] if frame.ndim == 3 else 1,
                         timestamp_ms=now_ms,
-                        pts=pkt.pts,
+                        pts=frame_pts if frame_pts is not None else pkt.pts,
                         dts=pkt.dts,
                         packet=pkt.packet,
                         keyframe_cnt=self._keyframes,
@@ -346,19 +375,10 @@ class IngestWorker:
         finally:
             self._publish_status(time.monotonic(), force=True)
             if self._archiver is not None:
-                if self._gop_packets:
-                    # Flush the trailing (keyframe-unclosed) GOP — file
-                    # sources end mid-GOP; dropping it would lose the tail
-                    # (the reference loses it; deliberate divergence).
-                    self._archiver.submit(
-                        PacketGopSegment(
-                            device_id=self.cfg.device_id,
-                            start_ts_ms=self._gop_start_ms,
-                            info=self._gop_info,
-                            packets=self._gop_packets,
-                        )
-                    )
-                    self._gop_packets = []
+                # Flush the trailing (keyframe-unclosed) GOP — dropping it
+                # would lose the tail (the reference loses it; deliberate
+                # divergence).
+                self._flush_gop_tail()
                 self._archiver.stop()
             if self._passthrough is not None:
                 self._passthrough.close()
