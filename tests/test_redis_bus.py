@@ -1,0 +1,176 @@
+"""Redis-wire-compatible bus backend (VERDICT round 1 missing #3).
+
+Runs against the in-proc RESP server (``bus/miniredis.py`` — fakeredis is
+not in this image) over real sockets, so the actual wire bytes are
+exercised. The contract tests assert the REFERENCE's key/value conventions
+verbatim (``server/models/RedisConstants.go:18-27``,
+``server/grpcapi/grpc_api.go:159-229``, ``python/read_image.py:36-45,121``)
+by reading raw Redis state with a bare RESP client — what a reference Go
+server or Python worker sharing the same Redis would see.
+"""
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.bus import FrameMeta, open_bus
+from video_edge_ai_proxy_tpu.bus.miniredis import MiniRedis
+from video_edge_ai_proxy_tpu.bus.redis_bus import RedisFrameBus
+from video_edge_ai_proxy_tpu.bus.resp import RespClient
+from video_edge_ai_proxy_tpu.proto import pb
+
+
+@pytest.fixture()
+def server():
+    srv = MiniRedis()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def bus(server):
+    b = open_bus("redis", redis_addr=server.addr)
+    assert isinstance(b, RedisFrameBus)
+    yield b
+    b.close()
+
+
+@pytest.fixture()
+def raw(server):
+    c = RespClient.from_addr(server.addr)
+    yield c
+    c.close()
+
+
+class TestFrameBusSemantics:
+    """Same behavioral bar the shm/memory backends pass (test_bus.py)."""
+
+    def test_publish_read_roundtrip(self, bus):
+        img = np.arange(2 * 3 * 3, dtype=np.uint8).reshape(2, 3, 3)
+        bus.create_stream("cam", img.nbytes)
+        seq = bus.publish("cam", img, FrameMeta(
+            timestamp_ms=123, pts=7, dts=6, packet=9, keyframe_cnt=1,
+            is_keyframe=True, frame_type="I", time_base=1 / 90000,
+        ))
+        f = bus.read_latest("cam")
+        assert f is not None and f.seq == seq
+        np.testing.assert_array_equal(f.data, img)
+        m = f.meta
+        assert (m.timestamp_ms, m.pts, m.dts, m.packet) == (123, 7, 6, 9)
+        assert m.is_keyframe and m.frame_type == "I"
+        assert m.time_base == pytest.approx(1 / 90000)
+
+    def test_latest_wins_and_cursor(self, bus):
+        bus.create_stream("cam", 27, slots=1)
+        img = np.zeros((3, 3, 3), np.uint8)
+        seqs = [bus.publish("cam", img + i, FrameMeta(timestamp_ms=i))
+                for i in range(5)]
+        f = bus.read_latest("cam")
+        assert f.meta.timestamp_ms == 4  # only the newest survives MAXLEN 1
+        assert bus.read_latest("cam", min_seq=f.seq) is None  # cursor honors
+        assert seqs == sorted(seqs)
+
+    def test_streams_and_drop(self, bus):
+        for name in ("a", "b"):
+            bus.create_stream(name, 27)
+            bus.publish(name, np.zeros((3, 3, 3), np.uint8), FrameMeta())
+        assert bus.streams() == ["a", "b"]
+        bus.drop_stream("a")
+        assert bus.streams() == ["b"]
+
+    def test_kv_and_hash(self, bus):
+        bus.kv_set("k", "v")
+        assert bus.kv_get("k") == "v"
+        bus.kv_del("k")
+        assert bus.kv_get("k") is None
+        bus.hset("h", "f1", "x")
+        bus.hset("h", "f2", "y")
+        assert bus.hget("h", "f1") == "x"
+        assert bus.hgetall("h") == {"f1": "x", "f2": "y"}
+        bus.hdel_all("h")
+        assert bus.hgetall("h") == {}
+
+
+class TestReferenceWireContract:
+    """Raw Redis state must match what reference components write/read."""
+
+    def test_keyframe_only_is_formatbool_string(self, bus, raw):
+        """grpc_api.go:159-163 SETs strconv.FormatBool; read_image.py:36-45
+        compares against 'true'."""
+        bus.set_keyframe_only("cam7", True)
+        assert raw.command("GET", "is_key_frame_only_cam7") == b"true"
+        bus.set_keyframe_only("cam7", False)
+        assert raw.command("GET", "is_key_frame_only_cam7") == b"false"
+        assert bus.keyframe_only("cam7") is False
+
+    def test_last_access_is_a_real_hash(self, bus, raw):
+        """grpc_api.go:166-175 HSETs last_query (epoch ms);
+        grpc_proxy_api.go:30-37 HSETs proxy_rtmp; the worker HGETALLs the
+        hash every packet (rtsp_to_rtmp.py:117)."""
+        bus.touch_query("cam7", now_ms=1700000000123)
+        bus.set_proxy_rtmp("cam7", True)
+        assert raw.command("TYPE", "last_access_time_cam7") == "hash"
+        flat = raw.command("HGETALL", "last_access_time_cam7")
+        h = {k.decode(): v.decode() for k, v in zip(flat[::2], flat[1::2])}
+        assert h["last_query"] == "1700000000123"
+        assert h["proxy_rtmp"] == "true"
+        assert bus.last_query_ms("cam7") == 1700000000123
+        assert bus.proxy_rtmp("cam7") is True
+
+    def test_stream_entry_is_reference_videoframe(self, bus, raw):
+        """XADD <device_id> MAXLEN ~ N * data <VideoFrame proto> — the exact
+        producer write (read_image.py:121) the reference Go server consumes
+        (grpc_api.go:191-229): unmarshal field 'data', rebuild the image
+        from shape dims (examples/opencv_display.py:46-53)."""
+        img = np.random.randint(0, 255, (4, 6, 3), dtype=np.uint8)
+        bus.create_stream("camx", img.nbytes, slots=1)
+        bus.publish("camx", img, FrameMeta(
+            timestamp_ms=55, pts=11, dts=10, packet=3, keyframe_cnt=2,
+            is_keyframe=True, frame_type="I", time_base=1 / 90000,
+        ))
+        entries = raw.command("XREVRANGE", "camx", "+", "-", "COUNT", "1")
+        entry_id, fields = entries[0]
+        assert b"-" in entry_id  # redis stream id shape "<ms>-<n>"
+        fd = dict(zip(fields[::2], fields[1::2]))
+        vf = pb.VideoFrame()
+        vf.ParseFromString(fd[b"data"])
+        assert (vf.width, vf.height) == (6, 4)
+        assert [d.size for d in vf.shape.dim] == [4, 6, 3]
+        rebuilt = np.frombuffer(vf.data, np.uint8).reshape(4, 6, 3)
+        np.testing.assert_array_equal(rebuilt, img)
+        assert vf.is_keyframe and vf.keyframe == 2 and vf.packet == 3
+
+    def test_maxlen_bounds_stream(self, bus, raw):
+        bus.create_stream("camy", 27, slots=2)
+        for i in range(10):
+            bus.publish("camy", np.zeros((3, 3, 3), np.uint8),
+                        FrameMeta(timestamp_ms=i))
+        assert raw.command("XLEN", "camy") <= 2
+
+
+class TestWorkerOverRedis:
+    def test_worker_publishes_via_redis_backend(self, server, tmp_path):
+        """Full ingest worker with bus_backend=redis: frames land in Redis
+        streams a reference consumer could read."""
+        from video_edge_ai_proxy_tpu.ingest import av
+        from video_edge_ai_proxy_tpu.ingest.sources import PacketSource
+        from video_edge_ai_proxy_tpu.ingest.worker import (
+            IngestWorker, WorkerConfig,
+        )
+
+        if not av.available():
+            pytest.skip("libav shim unavailable")
+        fixture = str(tmp_path / "cam.mp4")
+        av.write_test_video(fixture, 64, 48, frames=20, fps=10, gop=5)
+        cfg = WorkerConfig(
+            rtsp_endpoint=fixture, device_id="rcam",
+            bus_backend="redis", redis_addr=server.addr, max_frames=20,
+        )
+        worker = IngestWorker(cfg, source=PacketSource(fixture))
+        worker.bus.touch_query("rcam")  # open the decode gate
+        worker.run()
+        check = open_bus("redis", redis_addr=server.addr)
+        f = check.read_latest("rcam")
+        assert f is not None
+        assert f.data.shape == (48, 64, 3)
+        assert f.meta.is_keyframe in (True, False)
+        check.close()

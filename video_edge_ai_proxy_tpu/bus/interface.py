@@ -35,6 +35,13 @@ FRAME_TYPE_NAMES = {0: "", 1: "I", 2: "P", 3: "B"}
 FRAME_TYPE_CODES = {v: k for k, v in FRAME_TYPE_NAMES.items()}
 
 
+class RingSlotTooSmall(OSError):
+    """A frame exceeded its shm ring slot. Distinct type so producers can
+    grow-and-retry without confusing it with transport errors (a redis
+    TimeoutError is also an OSError — recreating the stream on those would
+    DEL live data)."""
+
+
 @dataclass
 class FrameMeta:
     """Per-frame metadata (mirrors VideoFrame proto fields,

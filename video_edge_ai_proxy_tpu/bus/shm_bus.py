@@ -18,7 +18,14 @@ from typing import Optional
 import numpy as np
 
 from ..utils.logging import get_logger
-from .interface import FRAME_TYPE_CODES, FRAME_TYPE_NAMES, Frame, FrameBus, FrameMeta
+from .interface import (
+    FRAME_TYPE_CODES,
+    FRAME_TYPE_NAMES,
+    Frame,
+    FrameBus,
+    FrameMeta,
+    RingSlotTooSmall,
+)
 from .native.build import build_library
 
 log = get_logger("bus.shm")
@@ -231,8 +238,8 @@ class ShmFrameBus(FrameBus):
                 h, _u8ptr(arr), arr.nbytes, ctypes.byref(cm)
             )
         if seq == 0:
-            raise OSError(
-                f"publish failed for {device_id} ({arr.nbytes} B > slot?)"
+            raise RingSlotTooSmall(
+                f"publish failed for {device_id} ({arr.nbytes} B > slot)"
             )
         return int(seq)
 

@@ -39,7 +39,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from ..bus import FrameBus, FrameMeta, open_bus
+from ..bus import FrameBus, FrameMeta, RingSlotTooSmall, open_bus
 from ..utils.logging import get_logger
 from .archive import GopSegment, PacketGopSegment, SegmentArchiver
 from .sources import VideoSource, open_source
@@ -63,6 +63,7 @@ class WorkerConfig:
     active_window_s: float = 10.0
     shm_dir: str = "/dev/shm/vep_tpu"
     bus_backend: str = "shm"
+    redis_addr: str = "127.0.0.1:6379"
     max_frames: int = 0  # 0 = endless; tests set a bound
 
     @classmethod
@@ -78,6 +79,8 @@ class WorkerConfig:
             in_memory_buffer=int(env.get("in_memory_buffer", "1") or 1),
             disk_buffer_path=env.get("disk_buffer_path", ""),
             shm_dir=env.get("vep_shm_dir", "/dev/shm/vep_tpu"),
+            bus_backend=env.get("vep_bus_backend", "shm"),
+            redis_addr=env.get("vep_redis_addr", "127.0.0.1:6379"),
             max_frames=int(env.get("vep_max_frames", "0") or 0),
         )
 
@@ -90,7 +93,7 @@ class IngestWorker:
         source: Optional[VideoSource] = None,
     ):
         self.cfg = cfg
-        self.bus = bus or open_bus(cfg.bus_backend, cfg.shm_dir)
+        self.bus = bus or open_bus(cfg.bus_backend, cfg.shm_dir, cfg.redis_addr)
         self.source = source or open_source(cfg.rtsp_endpoint)
         self._stop = threading.Event()
         self._packets = 0
@@ -346,8 +349,8 @@ class IngestWorker:
                     )
                     try:
                         self.bus.publish(cfg.device_id, frame, meta)
-                    except OSError:
-                        # Slot too small: the source under-reported its
+                    except RingSlotTooSmall:
+                        # The source under-reported its
                         # resolution at open (OpenCV backends may say 0x0) or
                         # the camera switched to a larger mode mid-stream.
                         # The worker owns the ring, so grow it in place
@@ -403,6 +406,8 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--memory_buffer", type=int, default=env_cfg.in_memory_buffer)
     p.add_argument("--disk_buffer_path", default=env_cfg.disk_buffer_path)
     p.add_argument("--shm_dir", default=env_cfg.shm_dir)
+    p.add_argument("--bus_backend", default=env_cfg.bus_backend)
+    p.add_argument("--redis_addr", default=env_cfg.redis_addr)
     p.add_argument("--max_frames", type=int, default=env_cfg.max_frames)
     args = p.parse_args(argv)
     if not args.rtsp or not args.device_id:
@@ -414,6 +419,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         in_memory_buffer=args.memory_buffer,
         disk_buffer_path=args.disk_buffer_path,
         shm_dir=args.shm_dir,
+        bus_backend=args.bus_backend,
+        redis_addr=args.redis_addr,
         max_frames=args.max_frames,
     )
     worker = IngestWorker(cfg)

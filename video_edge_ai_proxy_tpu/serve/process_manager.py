@@ -112,10 +112,14 @@ class ProcessManager:
         shm_dir: str = "/dev/shm/vep_tpu",
         disk_buffer_path: str = "",
         python: str = sys.executable,
+        bus_backend: str = "shm",
+        redis_addr: str = "127.0.0.1:6379",
     ):
         self._storage = storage
         self._bus = bus
         self._shm_dir = shm_dir
+        self._bus_backend = bus_backend
+        self._redis_addr = redis_addr
         self._disk_buffer_path = disk_buffer_path
         self._python = python
         self._entries: dict[str, _Entry] = {}
@@ -181,6 +185,12 @@ class ProcessManager:
             in_memory_buffer="1",
             disk_buffer_path=self._disk_buffer_path,
             vep_shm_dir=self._shm_dir,
+            # Workers are separate processes: an in-proc "memory" bus can't
+            # cross the boundary, so they get the shm fast path instead.
+            vep_bus_backend=(
+                "shm" if self._bus_backend == "memory" else self._bus_backend
+            ),
+            vep_redis_addr=self._redis_addr,
             PYTHONUNBUFFERED="1",
         )
         proc = subprocess.Popen(

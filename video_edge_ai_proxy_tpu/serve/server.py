@@ -50,7 +50,8 @@ class Server:
         self.data_dir = data_dir
         self.storage = Storage(os.path.join(data_dir, "registry.db"))
         self.bus = open_bus(
-            bus_backend or self.cfg.bus.backend, self.cfg.bus.shm_dir
+            bus_backend or self.cfg.bus.backend, self.cfg.bus.shm_dir,
+            self.cfg.bus.redis_addr,
         )
         self.settings = SettingsManager(self.storage)
         self.process_manager = ProcessManager(
@@ -60,6 +61,8 @@ class Server:
             disk_buffer_path=(
                 self.cfg.buffer.on_disk_folder if self.cfg.buffer.on_disk else ""
             ),
+            bus_backend=bus_backend or self.cfg.bus.backend,
+            redis_addr=self.cfg.bus.redis_addr,
         )
         self.annotations = AnnotationQueue(
             handler=make_batch_handler(

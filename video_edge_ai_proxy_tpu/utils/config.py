@@ -29,9 +29,13 @@ DEFAULT_CONFIG_PATH = "/data/chrysalis/conf.yaml"
 class BusConfig:
     """Frame-bus connection (reference ``RedisSubconfig``, ``config.go:28-35``)."""
 
-    backend: str = "shm"  # "shm" (native ring) | "memory" (in-proc, tests)
+    backend: str = "shm"  # "shm" (native ring) | "redis" (reference-wire
+    #                        interop) | "memory" (in-proc, tests)
     # Directory holding the shared-memory segments (one per camera + control KV).
     shm_dir: str = "/dev/shm/vep_tpu"
+    # Redis server for backend "redis" (reference ``RedisSubconfig``
+    # connection string, ``config.go:28-35``).
+    redis_addr: str = "127.0.0.1:6379"
     # Ring capacity per camera in frames; reference default is 1 in-memory frame
     # (``server/main.go:74``, latest-frame-wins semantics).
     ring_slots: int = 4
