@@ -87,6 +87,16 @@ def pm(tmp_path, shm_dir):
     storage.close()
 
 
+def _logs_grew(rest: str, cursor: int, name: str = "cam1") -> bool:
+    import urllib.request
+
+    with urllib.request.urlopen(
+        rest + f"/api/v1/process/{name}/logs?since={cursor}"
+    ) as resp:
+        out = json.loads(resp.read())
+    return out["total"] > cursor and bool(out["lines"])
+
+
 def wait_for(cond, timeout=20.0, interval=0.05):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -106,6 +116,43 @@ class TestProcessManager:
         assert record.state.running and record.state.pid > 0
         manager.stop("cam1")
         assert manager.list() == []
+
+    def test_worker_resource_limits_applied(self, pm):
+        """Reference caps each camera container (CPUShares/log limits,
+        rtsp_process_manager.go:71-78); the subprocess runner applies an
+        RLIMIT_AS + niceness in the spawn path and surfaces them in Info."""
+        manager, bus, _ = pm
+        manager.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+        record = manager.info("cam1")
+        assert record.limits["mem_limit_mb"] == manager._mem_limit_mb
+        assert record.limits["nice"] == manager._nice
+        pid = record.state.pid
+        with open(f"/proc/{pid}/limits") as fh:
+            line = next(l for l in fh if l.startswith("Max address space"))
+        assert str(manager._mem_limit_mb << 20) in line
+        with open(f"/proc/{pid}/stat") as fh:
+            nice = int(fh.read().split()[18])
+        assert nice == manager._nice
+
+    def test_runaway_worker_is_contained(self, tmp_path):
+        """A worker that tries to eat the host's memory hits RLIMIT_AS and
+        dies (MemoryError) instead of stalling the machine — the supervisor
+        restart policy then owns it."""
+        import subprocess
+        import sys as _sys
+
+        from video_edge_ai_proxy_tpu.serve.process_manager import (
+            _worker_preexec,
+        )
+
+        proc = subprocess.run(
+            [_sys.executable, "-c",
+             "import numpy; numpy.ones((1 << 29,), dtype=numpy.float64)"],
+            preexec_fn=lambda: _worker_preexec(mem_limit_mb=256, nice=0),
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode != 0
+        assert "MemoryError" in proc.stderr or "Cannot allocate" in proc.stderr
 
     def test_duplicate_start_conflicts(self, pm):
         manager, _, _ = pm
@@ -295,6 +342,20 @@ class TestEndToEnd:
             stub.Storage(pb.StorageRequest(device_id="cam1", start=True))
         assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
 
+        # live log follow (REST): cursor 0 returns the startup lines;
+        # re-asking at the tip returns nothing new (incremental contract —
+        # reference xterm streaming, process-details.component.ts:58-73)
+        assert wait_for(lambda: _logs_grew(rest, 0))
+        with urllib.request.urlopen(
+            rest + "/api/v1/process/cam1/logs?since=0"
+        ) as resp:
+            first = json.loads(resp.read())
+        with urllib.request.urlopen(
+            rest + f"/api/v1/process/cam1/logs?since={first['total']}"
+        ) as resp:
+            tip = json.loads(resp.read())
+        assert len(tip["lines"]) <= tip["total"] - first["total"]
+
         # stop camera (REST)
         req = urllib.request.Request(
             rest + "/api/v1/process/cam1", method="DELETE"
@@ -304,6 +365,34 @@ class TestEndToEnd:
         with urllib.request.urlopen(rest + "/api/v1/processlist") as resp:
             assert json.loads(resp.read()) == []
         channel.close()
+
+    def test_log_follow_incremental(self, server):
+        """?since=cursor hands back only new lines; unknown camera 400s."""
+        import urllib.error
+        import urllib.request
+
+        rest = f"http://127.0.0.1:{server._rest.bound_port}"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(rest + "/api/v1/process/ghost/logs")
+        assert exc.value.code == 400
+        # Bounded source: EOF->reconnect warnings keep appending lines, so
+        # live growth is observable, not just the startup banner.
+        server.process_manager.start(
+            StreamProcess(name="camlog", rtsp_endpoint=synth_url(frames=5))
+        )
+        try:
+            assert wait_for(lambda: _logs_grew(rest, 0, name="camlog"))
+            with urllib.request.urlopen(
+                rest + "/api/v1/process/camlog/logs?since=0"
+            ) as resp:
+                snap = json.loads(resp.read())
+            assert snap["lines"]
+            # the reconnect loop keeps producing NEW lines past the cursor
+            assert wait_for(
+                lambda: _logs_grew(rest, snap["total"], name="camlog")
+            )
+        finally:
+            server.process_manager.stop("camlog")
 
     def test_per_connection_cursors(self, server):
         """Two clients on one camera each get frames — the reference's shared

@@ -120,16 +120,7 @@ class RedisFrameBus(FrameBus):
         return Frame(seq=seq, **_unmarshal(payload))
 
     def streams(self) -> list[str]:
-        out: list[str] = []
-        cursor = b"0"
-        while True:
-            reply = self._client.command(
-                "SCAN", cursor, "COUNT", "1000", "TYPE", "stream"
-            )
-            cursor, keys = reply
-            out.extend(k.decode() for k in keys)
-            if cursor in (b"0", 0, "0"):
-                return sorted(out)
+        return self._scan_keys("stream")
 
     def drop_stream(self, device_id: str) -> None:
         self._client.command("DEL", device_id)
@@ -147,18 +138,23 @@ class RedisFrameBus(FrameBus):
         self._client.command("DEL", key)
 
     def kv_keys(self) -> list[str]:
-        # SCAN, never KEYS: this backend shares a production Redis with
-        # reference components, and KEYS blocks the whole server. TYPE
-        # string also keeps the contract shape of the other backends
+        # TYPE string keeps the contract shape of the other backends
         # (control KV only — no stream/hash names).
-        out: list[str] = []
+        return self._scan_keys("string")
+
+    def _scan_keys(self, want_type: str) -> list[str]:
+        # SCAN, never KEYS: this backend shares a production Redis with
+        # reference components, and KEYS blocks the whole server. SCAN may
+        # return a key on more than one page while the table rehashes, so
+        # results dedup through a set.
+        out: set[str] = set()
         cursor = b"0"
         while True:
             reply = self._client.command(
-                "SCAN", cursor, "COUNT", "1000", "TYPE", "string"
+                "SCAN", cursor, "COUNT", "1000", "TYPE", want_type
             )
             cursor, keys = reply
-            out.extend(k.decode() for k in keys)
+            out.update(k.decode() for k in keys)
             if cursor in (b"0", 0, "0"):
                 return sorted(out)
 

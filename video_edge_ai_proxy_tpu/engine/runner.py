@@ -189,14 +189,24 @@ class InferenceEngine:
             # replicated (inference weights are small; fsdp-style sharding
             # belongs to training). Buckets must divide evenly across dp so
             # every chip gets identical static shapes.
-            from ..parallel import make_mesh, replicated
+            from ..parallel import factor_mesh, make_mesh, replicated
 
-            n_need = 1
-            for v in self._cfg.mesh.values():
-                n_need *= v
-            self._mesh = make_mesh(
-                **self._cfg.mesh, devices=jax.devices()[:n_need]
-            )
+            if isinstance(self._cfg.mesh, str):
+                if self._cfg.mesh != "auto":
+                    raise ValueError(
+                        f"engine.mesh: unknown value {self._cfg.mesh!r} — "
+                        "use 'auto', an axis dict like {'dp': 4}, or empty "
+                        "for single-chip"
+                    )
+                # Serving profile: every visible device on the batch axis.
+                self._mesh = factor_mesh(prefer=("dp",))
+            else:
+                n_need = 1
+                for v in self._cfg.mesh.values():
+                    n_need *= v
+                self._mesh = make_mesh(
+                    **self._cfg.mesh, devices=jax.devices()[:n_need]
+                )
             dp = self._mesh.shape["dp"]
             buckets = tuple(b for b in buckets if b % dp == 0) or (dp,)
             self._variables = jax.device_put(

@@ -53,6 +53,10 @@ class StreamProcess:
     rtmp_stream_status: Optional[RTMPStreamStatus] = None
     # New (no reference counterpart): per-stream inference toggle + model.
     inference_model: str = ""
+    # Resource limits applied to the worker process (reference caps
+    # containers via CPUShares + json-file log limits,
+    # ``rtsp_process_manager.go:71-78``); filled by Info, not persisted.
+    limits: Optional[dict] = None
 
     def to_json(self) -> bytes:
         def drop_none(obj: Any) -> Any:
@@ -80,6 +84,7 @@ class StreamProcess:
             modified=data.get("modified", 0),
             rtmp_stream_status=RTMPStreamStatus(**rss) if rss else None,
             inference_model=data.get("inference_model", ""),
+            limits=data.get("limits"),
         )
 
     @staticmethod

@@ -70,6 +70,38 @@ def _pdeathsig() -> None:
         _LIBC_PRCTL(_PR_SET_PDEATHSIG, _SIGTERM)
 
 
+# Per-worker resource limits — the reference caps each camera container
+# (CPUShares 1024 equal weight, json-file logs 3x3 MB,
+# ``rtsp_process_manager.go:71-78``). Subprocess equivalents: an address-
+# space rlimit so one leaking worker cannot eat the host's decode budget,
+# and a nice level so N busy decoders stay preemptible by the server/engine
+# (niceness is the scheduler-weight analogue of equal CPUShares). The log
+# cap is the in-memory tail ring (_Tail, LOG_TAIL_LINES).
+WORKER_MEM_LIMIT_MB = 2048
+WORKER_NICE = 5
+
+
+# Imported at module load, NOT inside _worker_preexec: preexec_fn runs in
+# the forked child of a multithreaded server, where the import machinery's
+# locks may be held by a thread that no longer exists — touching it there
+# can deadlock the child before exec.
+try:
+    import resource as _resource
+except ImportError:  # non-POSIX; preexec is linux-gated at the call site
+    _resource = None
+
+
+def _worker_preexec(mem_limit_mb: int = WORKER_MEM_LIMIT_MB,
+                    nice: int = WORKER_NICE) -> None:
+    """Runs between fork and exec (no locks, no imports, no allocation)."""
+    _pdeathsig()
+    if mem_limit_mb > 0 and _resource is not None:
+        lim = mem_limit_mb << 20
+        _resource.setrlimit(_resource.RLIMIT_AS, (lim, lim))
+    if nice:
+        os.nice(nice)
+
+
 class ProcessError(RuntimeError):
     pass
 
@@ -80,6 +112,8 @@ class _Tail:
 
     def __init__(self, proc: subprocess.Popen, maxlen: int = 2000):
         self.lines: collections.deque[str] = collections.deque(maxlen=maxlen)
+        self.total = 0  # lines ever pumped (monotone; live-follow cursor)
+        self._lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._pump, args=(proc,), daemon=True
         )
@@ -88,7 +122,28 @@ class _Tail:
     def _pump(self, proc: subprocess.Popen) -> None:
         assert proc.stdout is not None
         for line in proc.stdout:
-            self.lines.append(line.rstrip("\n"))
+            with self._lock:
+                self.lines.append(line.rstrip("\n"))
+                self.total += 1
+
+    def since(self, cursor: int) -> tuple[int, list[str]]:
+        """(total, lines appended after ``cursor``). A cursor from before a
+        worker restart (> total) or older than the ring resyncs to
+        whatever the ring still holds."""
+        with self._lock:
+            total = self.total
+            if cursor > total:
+                cursor = total - len(self.lines)  # restarted: resend ring
+            first_kept = total - len(self.lines)
+            skip = max(0, cursor - first_kept)
+            new = list(self.lines)[skip:]
+        return total, new
+
+    def snapshot(self, n: int) -> tuple[int, list[str]]:
+        """(total, last n lines) — one consistent view; the pump thread
+        mutates the deque, so iterating it unlocked can raise."""
+        with self._lock:
+            return self.total, list(self.lines)[-n:]
 
 
 class _Entry:
@@ -114,6 +169,8 @@ class ProcessManager:
         python: str = sys.executable,
         bus_backend: str = "shm",
         redis_addr: str = "127.0.0.1:6379",
+        mem_limit_mb: int = WORKER_MEM_LIMIT_MB,
+        nice: int = WORKER_NICE,
     ):
         self._storage = storage
         self._bus = bus
@@ -122,6 +179,8 @@ class ProcessManager:
         self._redis_addr = redis_addr
         self._disk_buffer_path = disk_buffer_path
         self._python = python
+        self._mem_limit_mb = mem_limit_mb
+        self._nice = nice
         self._entries: dict[str, _Entry] = {}
         self._stopping: set[str] = set()  # mid-stop ids (see stop())
         self._lock = threading.Lock()
@@ -199,7 +258,10 @@ class ProcessManager:
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
-            preexec_fn=_pdeathsig if sys.platform == "linux" else None,
+            preexec_fn=(
+                (lambda: _worker_preexec(self._mem_limit_mb, self._nice))
+                if sys.platform == "linux" else None
+            ),
         )
         entry.proc = proc
         entry.last_spawn = time.monotonic()
@@ -275,9 +337,34 @@ class ProcessManager:
             record.state.dead = False
             record.state.status = "exited"
         record.status = record.state.status
+        record.limits = {
+            "mem_limit_mb": self._mem_limit_mb,
+            "nice": self._nice,
+            "log_tail_lines": LOG_TAIL_LINES,
+        }
         if entry and entry.tail:
-            record.logs = {"stdout": list(entry.tail.lines)[-LOG_TAIL_LINES:]}
+            total, lines = entry.tail.snapshot(LOG_TAIL_LINES)
+            record.logs = {
+                "stdout": lines,
+                # Live-follow cursor: pass back as ?since= on the logs
+                # endpoint to receive only lines appended after this tail.
+                "total": total,
+            }
         return record
+
+    def logs_since(self, device_id: str, cursor: int) -> dict:
+        """Incremental log tail for live following (the reference streams
+        container stdout into the portal's xterm view,
+        ``process-details.component.ts:58-73``; a subprocess runner serves
+        the same need with an offset cursor over the tail ring)."""
+        with self._lock:
+            entry = self._entries.get(device_id)
+        if entry is None or entry.tail is None:
+            if self._storage.get_or_none(PREFIX_RTSP_PROCESS, device_id) is None:
+                raise ProcessError(f"process {device_id!r} not found")
+            return {"total": 0, "lines": []}
+        total, lines = entry.tail.since(cursor)
+        return {"total": total, "lines": lines}
 
     def list(self) -> list[StreamProcess]:
         out = []

@@ -117,6 +117,22 @@ def build_app(
         records = await asyncio.to_thread(pm.list)
         return web.json_response([_to_dict(r) for r in records])
 
+    async def process_logs(request: web.Request) -> web.Response:
+        """Incremental log tail: ``?since=<total from the last reply>``
+        returns only newly appended lines — the portal's live follow
+        (reference streams container stdout into xterm.js,
+        ``process-details.component.ts:58-73``)."""
+        name = request.match_info["name"]
+        try:
+            since = int(request.query.get("since", "0"))
+        except ValueError:
+            return _error(400, "since must be an integer")
+        try:
+            out = await asyncio.to_thread(pm.logs_since, name, since)
+        except ProcessError as exc:
+            return _error(400, str(exc))
+        return web.json_response(out)
+
     async def settings_get(_request: web.Request) -> web.Response:
         s = await asyncio.to_thread(settings.get)
         return web.json_response(_to_dict(s))
@@ -231,6 +247,7 @@ def build_app(
     app.router.add_post("/api/v1/process", start_process)
     app.router.add_delete("/api/v1/process/{name}", stop_process)
     app.router.add_get("/api/v1/process/{name}", process_info)
+    app.router.add_get("/api/v1/process/{name}/logs", process_logs)
     app.router.add_get("/api/v1/processlist", process_list)
     app.router.add_get("/api/v1/settings", settings_get)
     app.router.add_post("/api/v1/settings", settings_overwrite)

@@ -87,8 +87,10 @@ class EngineConfig:
     # ``python/rtsp_to_rtmp.py:144-145``).
     active_window_s: float = 10.0
     dtype: str = "bfloat16"
-    # Mesh shape for multi-chip serving; empty = single chip.
-    mesh: dict[str, int] = field(default_factory=dict)
+    # Mesh shape for multi-chip serving; empty = single chip. The string
+    # "auto" serves data-parallel over every visible device (dp-heavy
+    # factoring — a fleet operator needs no hand-written shape).
+    mesh: "dict[str, int] | str" = field(default_factory=dict)
     # msgpack params checkpoint; empty = random init (no pretrained weights
     # are bundled). Loaded at warmup so restart = load + compile cache.
     checkpoint_path: str = ""
