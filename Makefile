@@ -30,10 +30,12 @@ proto:
 	fi
 	python -c "from video_edge_ai_proxy_tpu.proto import pb, pb_grpc; pb.VideoFrame(); pb_grpc.ImageStub"
 
-# Force-rebuild the C++ shm bus core (normally built+cached on first import).
+# Force-rebuild the native libs (normally built+cached on first import):
+# the C++ shm bus core and the libav demux/mux shim.
 native:
 	rm -rf ~/.cache/vep_tpu
 	python -c "from video_edge_ai_proxy_tpu.bus.native.build import build_library; print(build_library())"
+	python -c "from video_edge_ai_proxy_tpu.utils.cbuild import build_library; import video_edge_ai_proxy_tpu.ingest.av as av; print(build_library(av._SRC, 'vepav', av._LDFLAGS))"
 
 # Tooling for the proto target (reference Makefile:20-24).
 install:

@@ -4,6 +4,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -67,6 +69,23 @@ def test_yolo_raw_levels():
         side = spec.input_size // stride
         assert box.shape == (1, side, side, 4 * cfg.reg_max)
         assert cls.shape == (1, side, side, cfg.num_classes)
+
+
+def test_yolo_s2d_stem_same_output_contract():
+    """s2d_stem (lane-fill experiment, BASELINE.md perf levers) must keep
+    the exact output geometry of the stride-2 stem — only the stem's
+    parameterization differs."""
+    cfg = dataclasses.replace(tiny_yolov8_config(), s2d_stem=True)
+    model = YOLOv8(cfg)
+    x = jnp.ones((2, 64, 64, 3), jnp.bfloat16)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), x)
+    boxes, scores = jax.jit(lambda p, a: model.apply(p, a))(params, x)
+    anchors = sum((64 // st) ** 2 for st in cfg.strides)
+    assert boxes.shape == (2, anchors, 4)
+    assert scores.shape == (2, anchors, cfg.num_classes)
+    # The stem consumes 4x the input channels (2x2 block fold).
+    stem_kernel = params["params"]["stem"]["conv"]["kernel"]
+    assert stem_kernel.shape[2] == 12
 
 
 def test_anchor_points_centers():

@@ -9,6 +9,7 @@ an engine change.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -78,6 +79,15 @@ register(ModelSpec(
     "yolov8n", lambda: YOLOv8(yolov8n_config()),
     input_size=640, preprocess="letterbox", kind="detect",
     description="config 2 + north star: batched detection",
+))
+register(ModelSpec(
+    "yolov8n_s2d", lambda: YOLOv8(
+        dataclasses.replace(yolov8n_config(), s2d_stem=True)
+    ),
+    input_size=640, preprocess="letterbox", kind="detect",
+    description="north-star variant: space-to-depth stem (lane-fill "
+                "experiment, BASELINE.md perf notes; checkpoints do not "
+                "transfer from yolov8n)",
 ))
 register(ModelSpec(
     "yolov8s", lambda: YOLOv8(yolov8s_config()),

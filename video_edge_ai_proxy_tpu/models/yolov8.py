@@ -34,6 +34,12 @@ class YOLOv8Config:
     max_channels: int = 1024
     reg_max: int = 16             # DFL bins
     strides: Sequence[int] = (8, 16, 32)
+    # Space-to-depth stem (BASELINE.md perf notes): fold 2x2 spatial blocks
+    # into channels (3 -> 12) before a stride-1 conv, so the P1 stage feeds
+    # the VPU/MXU 12 input lanes instead of 3 (the stock stem underfills
+    # the 128-lane registers at 3 channels). Same output geometry as the
+    # stride-2 stem; DIFFERENT architecture — checkpoints do not transfer.
+    s2d_stem: bool = False
 
     def ch(self, c: int) -> int:
         return make_divisible(min(c, self.max_channels) * self.width_mult)
@@ -190,7 +196,13 @@ class YOLOv8(nn.Module):
         x = x.astype(self.dtype)
 
         # Backbone
-        x = ConvBN(ch(64), stride=2, dtype=self.dtype, name="stem")(x, train)       # P1
+        if c.s2d_stem:
+            b, h, w, ci = x.shape
+            x = x.reshape(b, h // 2, 2, w // 2, 2, ci)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * ci)
+            x = ConvBN(ch(64), dtype=self.dtype, name="stem")(x, train)             # P1
+        else:
+            x = ConvBN(ch(64), stride=2, dtype=self.dtype, name="stem")(x, train)   # P1
         x = ConvBN(ch(128), stride=2, dtype=self.dtype, name="down2")(x, train)     # P2
         x = C2f(ch(128), d(3), True, self.dtype, name="c2f_2")(x, train)
         x = ConvBN(ch(256), stride=2, dtype=self.dtype, name="down3")(x, train)     # P3
