@@ -123,6 +123,24 @@ class TestWorkerRealVideo:
                     total += 1
         assert total == N  # every packet archived, none transcoded away
 
+    def test_stream_copy_archive_feeds_training_loader(self, fixture_mp4, tmp_path):
+        """The self-train loop's data plane (data/segments.py) must decode
+        the NEW stream-copy segments — edge archive to training batch,
+        end to end (SURVEY.md §7: archive is the training-data source)."""
+        from video_edge_ai_proxy_tpu.data.segments import (
+            read_segment, scan_archive,
+        )
+
+        bus = MemoryFrameBus()
+        arch = str(tmp_path / "archive")
+        _run_worker(fixture_mp4, bus, tmp_path, disk_buffer_path=arch)
+        refs = scan_archive(arch)
+        assert len(refs) == N // GOP
+        assert all(r.device_id == "camfile" for r in refs)
+        clip = read_segment(refs[0])
+        assert clip.shape == (GOP, H, W, 3)
+        assert clip.dtype == np.uint8
+
     def test_passthrough_remuxes_packets(self, fixture_mp4, tmp_path):
         """Proxy toggle-on mid-stream: sink starts at the buffered GOP head
         (keyframe) and carries real H.264 — reference

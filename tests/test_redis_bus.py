@@ -147,6 +147,67 @@ class TestReferenceWireContract:
         assert raw.command("XLEN", "camy") <= 2
 
 
+class TestEngineOverRedis:
+    def test_inference_plane_rides_redis_fabric(self, server):
+        """The TPU engine's collector consumes frames straight off the
+        Redis backend — the whole inference plane works on the interop
+        fabric, not just the shm fast path."""
+        import time as _time
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+        bus = open_bus("redis", redis_addr=server.addr)
+        eng = InferenceEngine(
+            bus,
+            EngineConfig(
+                model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=10,
+            ),
+        )
+        eng.warmup()
+        img = np.random.randint(0, 255, (32, 32, 3), dtype=np.uint8)
+        bus.create_stream("rcam", img.nbytes, slots=2)
+        results = []
+        eng.start()
+        try:
+            # Publish continuously from a thread: the subscriber queue
+            # only registers on the first next(), so a single pre-next
+            # publish could fan out to nobody and next() would then block
+            # with nothing left to deliver. The watchdog stops the engine
+            # at the deadline, which unblocks subscribe() (StopIteration
+            # path) instead of hanging CI.
+            import threading
+
+            stop_pub = threading.Event()
+
+            def publisher():
+                while not stop_pub.is_set():
+                    bus.publish("rcam", img, FrameMeta(
+                        timestamp_ms=int(_time.time() * 1000),
+                    ))
+                    _time.sleep(0.05)
+
+            pub = threading.Thread(target=publisher, daemon=True)
+            pub.start()
+            watchdog = threading.Timer(20.0, eng.stop)
+            watchdog.start()
+            try:
+                results.append(next(eng.subscribe(device_ids=["rcam"],
+                                                  timeout=0.2)))
+            except StopIteration:
+                pass
+            finally:
+                watchdog.cancel()
+                stop_pub.set()
+                pub.join(timeout=5)
+        finally:
+            eng.stop()
+            bus.close()
+        assert results
+        assert results[0].device_id == "rcam"
+        assert results[0].model == "tiny_mobilenet_v2"
+
+
 class TestWorkerOverRedis:
     def test_worker_publishes_via_redis_backend(self, server, tmp_path):
         """Full ingest worker with bus_backend=redis: frames land in Redis

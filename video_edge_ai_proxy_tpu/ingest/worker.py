@@ -93,8 +93,14 @@ class IngestWorker:
         source: Optional[VideoSource] = None,
     ):
         self.cfg = cfg
+        self._owns_bus = bus is None
         self.bus = bus or open_bus(cfg.bus_backend, cfg.shm_dir, cfg.redis_addr)
-        self.source = source or open_source(cfg.rtsp_endpoint)
+        try:
+            self.source = source or open_source(cfg.rtsp_endpoint)
+        except Exception:
+            if self._owns_bus:
+                self.bus.close()  # don't leak the live socket/mappings
+            raise
         self._stop = threading.Event()
         self._packets = 0
         self._keyframes = 0
@@ -376,20 +382,28 @@ class IngestWorker:
                 if cfg.max_frames and self._packets >= cfg.max_frames:
                     break
         finally:
-            self._publish_status(time.monotonic(), force=True)
-            if self._archiver is not None:
-                # Flush the trailing (keyframe-unclosed) GOP — dropping it
-                # would lose the tail (the reference loses it; deliberate
-                # divergence).
-                self._flush_gop_tail()
-                self._archiver.stop()
-            if self._passthrough is not None:
-                self._passthrough.close()
-            self.source.close()
-            log.info(
-                "ingest worker down: device=%s packets=%d decoded=%d",
-                cfg.device_id, self._packets, self._decoded,
-            )
+            try:
+                self._publish_status(time.monotonic(), force=True)
+                if self._archiver is not None:
+                    # Flush the trailing (keyframe-unclosed) GOP — dropping
+                    # it would lose the tail (the reference loses it;
+                    # deliberate divergence).
+                    self._flush_gop_tail()
+                    self._archiver.stop()
+                if self._passthrough is not None:
+                    self._passthrough.close()
+                self.source.close()
+                log.info(
+                    "ingest worker down: device=%s packets=%d decoded=%d",
+                    cfg.device_id, self._packets, self._decoded,
+                )
+            finally:
+                if self._owns_bus:
+                    # A redis-backed bus holds a live socket; injected
+                    # buses (tests, embedded use) belong to the caller.
+                    # Nested finally: a teardown error above must not
+                    # leak it.
+                    self.bus.close()
 
     def stop(self) -> None:
         self._stop.set()
