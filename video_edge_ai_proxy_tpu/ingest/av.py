@@ -98,7 +98,9 @@ def _load() -> ctypes.CDLL:
         p8 = ctypes.POINTER(ctypes.c_uint8)
         vp, i32, i64 = ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64
         lib.va_open.restype = vp
-        lib.va_open.argtypes = [ctypes.c_char_p, i64, ctypes.c_char_p, i32]
+        lib.va_open.argtypes = [
+            ctypes.c_char_p, i64, ctypes.c_char_p, ctypes.c_char_p, i32,
+        ]
         lib.va_stream_info.argtypes = [vp, ctypes.POINTER(_CStreamInfo)]
         lib.va_extradata.argtypes = [vp, p8, i32]
         lib.va_read.argtypes = [vp, ctypes.POINTER(_CPacketMeta)]
@@ -109,7 +111,7 @@ def _load() -> ctypes.CDLL:
         lib.vm_open.restype = vp
         lib.vm_open.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(_CStreamInfo),
-            p8, i32, ctypes.c_char_p, i32,
+            p8, i32, ctypes.c_char_p, ctypes.c_char_p, i32,
         ]
         lib.vm_write.argtypes = [vp, p8, i32, i64, i64, i64, i32]
         lib.vm_close.argtypes = [vp]
@@ -206,10 +208,14 @@ class PacketDemuxer:
     """Demux-only reader with optional per-packet decode — the two-phase
     lazy split of the reference worker, at packet granularity."""
 
-    def __init__(self, url: str, timeout_s: float = 5.0):
+    def __init__(self, url: str, timeout_s: float = 5.0, options: str = ""):
+        """``options``: extra "k=v:k=v" AVOptions for the demuxer/protocol
+        (e.g. ``rtsp_flags=listen`` to accept a pushed RTSP session)."""
         lib = _load()
         err = ctypes.create_string_buffer(_ERRCAP)
-        self._h = lib.va_open(url.encode(), int(timeout_s * 1e6), err, _ERRCAP)
+        self._h = lib.va_open(
+            url.encode(), int(timeout_s * 1e6), options.encode(), err, _ERRCAP
+        )
         if not self._h:
             raise ConnectionError(
                 f"failed to open {url!r}: {err.value.decode(errors='replace')}"
@@ -322,7 +328,11 @@ class StreamCopyMuxer:
     bit-exact, ~zero CPU (reference ``python/archive.py:75-100`` and
     ``rtsp_to_rtmp.py:163-182``)."""
 
-    def __init__(self, url: str, info: StreamInfo, format: str = ""):
+    def __init__(self, url: str, info: StreamInfo, format: str = "",
+                 options: str = ""):
+        """``options`` is a "k=v:k=v" AVOption string for the muxer/protocol
+        (e.g. ``rtsp_flags=listen`` makes the RTSP muxer serve one client —
+        the tests' stand-in for a real camera)."""
         lib = _load()
         err = ctypes.create_string_buffer(_ERRCAP)
         c = info._to_c()
@@ -330,7 +340,8 @@ class StreamCopyMuxer:
             else np.empty(0, np.uint8)
         self._h = lib.vm_open(
             url.encode(), format.encode(), ctypes.byref(c),
-            _u8(extra) if extra.size else None, extra.size, err, _ERRCAP,
+            _u8(extra) if extra.size else None, extra.size,
+            options.encode(), err, _ERRCAP,
         )
         if not self._h:
             raise IOError(

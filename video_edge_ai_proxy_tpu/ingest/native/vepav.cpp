@@ -172,9 +172,13 @@ extern "C" {
 // ---------------------------------------------------------------- demux --
 
 // Open url for demuxing. timeout_us guards RTSP/network I/O (reference uses
-// tcp transport + 5 s socket timeouts, rtsp_to_rtmp.py:63). Returns handle
-// or null (err filled).
-void* va_open(const char* url, int64_t timeout_us, char* err, int errcap) {
+// tcp transport + 5 s socket timeouts, rtsp_to_rtmp.py:63). `options` is an
+// optional "k=v:k=v" AVOption string merged on top (e.g.
+// "rtsp_flags=listen" accepts a pushed RTSP session — how the tests drive
+// the real rtsp:// network path without a camera). Returns handle or null
+// (err filled).
+void* va_open(const char* url, int64_t timeout_us, const char* options,
+              char* err, int errcap) {
   net_init();
   Demux* d = new Demux();
   AVDictionary* opts = nullptr;
@@ -185,6 +189,15 @@ void* va_open(const char* url, int64_t timeout_us, char* err, int errcap) {
     av_dict_set(&opts, "timeout", buf, 0);   // ffmpeg5 rtsp socket timeout
     av_dict_set(&opts, "stimeout", buf, 0);  // older name; ignored if unknown
     av_dict_set(&opts, "max_delay", "5000000", 0);
+  }
+  if (options && *options) {
+    int prc = av_dict_parse_string(&opts, options, "=", ":", 0);
+    if (prc < 0) {
+      set_err(err, errcap, "malformed options string (want k=v:k=v)");
+      av_dict_free(&opts);
+      delete d;
+      return nullptr;
+    }
   }
   int rc = avformat_open_input(&d->fmt, url, nullptr, &opts);
   av_dict_free(&opts);
@@ -341,9 +354,13 @@ void va_close(void* h) {
 // Open a stream-copy muxer: MP4 archive segments (reference
 // python/archive.py:75-100) or FLV/RTMP relay (rtsp_to_rtmp.py:163-182).
 // `si` describes the *input* packets (codec, geometry, and the time base
-// pts/dts handed to vm_write are in); format is guessed from url when null.
+// pts/dts handed to vm_write are in); format is guessed from url when
+// null. `options` is an optional "k=v:k=v" AVOption string (e.g.
+// "rtsp_flags=listen" turns the RTSP muxer into a one-client server —
+// how the tests stand up a real rtsp:// camera).
 void* vm_open(const char* url, const char* format, const VAStreamInfo* si,
-              const uint8_t* extradata, int extralen, char* err, int errcap) {
+              const uint8_t* extradata, int extralen, const char* options,
+              char* err, int errcap) {
   net_init();
   Mux* m = new Mux();
   int rc = avformat_alloc_output_context2(&m->fmt, nullptr,
@@ -373,16 +390,29 @@ void* vm_open(const char* url, const char* format, const VAStreamInfo* si,
   }
   m->in_tb = {si->tb_num, si->tb_den ? si->tb_den : 90000};
   m->st->time_base = m->in_tb;  // muxer may override in write_header
-  if (!(m->fmt->oformat->flags & AVFMT_NOFILE)) {
-    rc = avio_open(&m->fmt->pb, url, AVIO_FLAG_WRITE);
-    if (rc < 0) {
-      set_averr(err, errcap, rc);
+  AVDictionary* opts = nullptr;
+  if (options && *options) {
+    int prc = av_dict_parse_string(&opts, options, "=", ":", 0);
+    if (prc < 0) {
+      set_err(err, errcap, "malformed options string (want k=v:k=v)");
+      av_dict_free(&opts);
       avformat_free_context(m->fmt);
       delete m;
       return nullptr;
     }
   }
-  rc = avformat_write_header(m->fmt, nullptr);
+  if (!(m->fmt->oformat->flags & AVFMT_NOFILE)) {
+    rc = avio_open2(&m->fmt->pb, url, AVIO_FLAG_WRITE, nullptr, &opts);
+    if (rc < 0) {
+      set_averr(err, errcap, rc);
+      av_dict_free(&opts);
+      avformat_free_context(m->fmt);
+      delete m;
+      return nullptr;
+    }
+  }
+  rc = avformat_write_header(m->fmt, &opts);
+  av_dict_free(&opts);
   if (rc < 0) {
     set_averr(err, errcap, rc);
     if (!(m->fmt->oformat->flags & AVFMT_NOFILE)) avio_closep(&m->fmt->pb);

@@ -203,9 +203,11 @@ class PacketSource(VideoSource):
 
     supports_packets = True
 
-    def __init__(self, url: str, timeout_s: float = 5.0):
+    def __init__(self, url: str, timeout_s: float = 5.0,
+                 av_options: str = ""):
         self.url = url
         self.timeout_s = timeout_s
+        self.av_options = av_options   # e.g. "rtsp_flags=listen" (push mode)
         self._d = None
         self._n = -1
         self._pkt = None
@@ -213,7 +215,9 @@ class PacketSource(VideoSource):
     def open(self) -> None:
         from . import av
 
-        self._d = av.PacketDemuxer(self.url, timeout_s=self.timeout_s)
+        self._d = av.PacketDemuxer(
+            self.url, timeout_s=self.timeout_s, options=self.av_options
+        )
         info = self._d.info
         self.width, self.height = info.width, info.height
         self.fps = info.fps or 30.0

@@ -382,28 +382,34 @@ class IngestWorker:
                 if cfg.max_frames and self._packets >= cfg.max_frames:
                     break
         finally:
-            try:
-                self._publish_status(time.monotonic(), force=True)
-                if self._archiver is not None:
-                    # Flush the trailing (keyframe-unclosed) GOP — dropping
-                    # it would lose the tail (the reference loses it;
-                    # deliberate divergence).
-                    self._flush_gop_tail()
-                    self._archiver.stop()
-                if self._passthrough is not None:
-                    self._passthrough.close()
-                self.source.close()
-                log.info(
-                    "ingest worker down: device=%s packets=%d decoded=%d",
-                    cfg.device_id, self._packets, self._decoded,
-                )
-            finally:
-                if self._owns_bus:
-                    # A redis-backed bus holds a live socket; injected
-                    # buses (tests, embedded use) belong to the caller.
-                    # Nested finally: a teardown error above must not
-                    # leak it.
-                    self.bus.close()
+            # Every teardown step runs even when an earlier one raises (a
+            # dead bus makes the status publish the likeliest raiser; it
+            # must not cost the trailing-GOP flush or leak the demuxer).
+            def _safe(what, fn):
+                try:
+                    fn()
+                except Exception:
+                    log.exception("worker teardown: %s failed", what)
+
+            _safe("status", lambda: self._publish_status(
+                time.monotonic(), force=True))
+            if self._archiver is not None:
+                # Flush the trailing (keyframe-unclosed) GOP — dropping it
+                # would lose the tail (the reference loses it; deliberate
+                # divergence).
+                _safe("gop flush", self._flush_gop_tail)
+                _safe("archiver", self._archiver.stop)
+            if self._passthrough is not None:
+                _safe("passthrough", self._passthrough.close)
+            _safe("source", self.source.close)
+            log.info(
+                "ingest worker down: device=%s packets=%d decoded=%d",
+                cfg.device_id, self._packets, self._decoded,
+            )
+            if self._owns_bus:
+                # A redis-backed bus holds a live socket; injected buses
+                # (tests, embedded use) belong to the caller.
+                _safe("bus", self.bus.close)
 
     def stop(self) -> None:
         self._stop.set()
