@@ -36,6 +36,20 @@ def fixture_mp4(tmp_path_factory):
     return path
 
 
+def _free_port() -> int:
+    """Ephemeral free port (bind-0 probe). Tiny TOCTOU window between
+    close and reuse — acceptable in tests, centralized so a future fix
+    (holding the socket) lands once."""
+    import socket
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        return probe.getsockname()[1]
+
+
+_PEER_CLOSED = ("Broken pipe", "Connection reset")  # receiver quit first
+
+
 def _run_worker(fixture, bus, tmp_path, **cfg_kwargs):
     cfg = WorkerConfig(
         rtsp_endpoint=fixture,
@@ -261,12 +275,7 @@ class TestWorkerRealVideo:
                 pkts.append(pkt)
             info = d.info
 
-        import socket
-
-        with socket.socket() as probe:  # ephemeral free port, no collisions
-            probe.bind(("127.0.0.1", 0))
-            port = probe.getsockname()[1]
-        url = f"rtsp://127.0.0.1:{port}/cam"
+        url = f"rtsp://127.0.0.1:{_free_port()}/cam"
         push_err = []
 
         def push():
@@ -288,8 +297,9 @@ class TestWorkerRealVideo:
                     time.sleep(0.004)
                 mux.close()
             except IOError as exc:
-                # Receiver bounded at max_frames closes first: benign.
-                if "Broken pipe" not in str(exc):
+                # Receiver bounded at max_frames closes first: benign
+                # (FIN -> EPIPE, or RST when unread data was buffered).
+                if not any(s in str(exc) for s in _PEER_CLOSED):
                     push_err.append(exc)
 
         t = threading.Thread(target=push, daemon=True)
@@ -312,6 +322,58 @@ class TestWorkerRealVideo:
         f = bus.read_latest("netcam")
         assert f is not None and f.data.shape == (H, W, 3)
         assert f.meta.pts > 0  # RTP 90 kHz clock, not a synthesized counter
+
+    def test_proxy_relay_over_real_rtmp_socket(self, fixture_mp4, tmp_path):
+        """The Proxy toggle's actual transport: the worker's packet
+        passthrough pushes H.264/FLV to an rtmp:// URL over a real socket
+        (libav's RTMP listen mode plays the ingest server). The remote
+        stream must start decodable (keyframe-first flush) and carry the
+        source's packets untranscoded."""
+        import threading
+
+        url = f"rtmp://127.0.0.1:{_free_port()}/live/cam"
+
+        got: dict = {}
+
+        def receiver():
+            try:
+                r = av.PacketDemuxer(url, timeout_s=20, options="listen=1")
+                n = dec = 0
+                first_kf = None
+                while n < 2 * GOP:
+                    pkt = r.read()
+                    if pkt is None:
+                        break
+                    if first_kf is None:
+                        first_kf = pkt.is_keyframe
+                    n += 1
+                    if r.decode() is not None:
+                        dec += 1
+                got.update(n=n, dec=dec, first_kf=first_kf,
+                           codec=r.info.codec_name)
+                r.close()
+            except Exception as exc:  # surfaces as assertion below
+                got["err"] = repr(exc)
+
+        recv = threading.Thread(target=receiver, daemon=True)
+        recv.start()
+
+        bus = MemoryFrameBus()
+        cfg = WorkerConfig(
+            rtsp_endpoint=fixture_mp4, device_id="rtmpcam",
+            rtmp_endpoint=url, max_frames=3 * N,  # loop the file: the relay
+            # needs time for the RTMP handshake before packets flow
+        )
+        worker = IngestWorker(cfg, bus=bus, source=PacketSource(fixture_mp4))
+        bus.set_proxy_rtmp("rtmpcam", True)  # toggle on from the start
+        time.sleep(0.5)  # listener binds inside va_open; let it come up
+        worker.run()
+        recv.join(timeout=20)
+        assert "err" not in got, got["err"]
+        assert got.get("n", 0) >= GOP       # a full GOP+ arrived
+        assert got["first_kf"] is True      # stream starts decodable
+        assert got["codec"] == "h264"       # no transcode to FLV1
+        assert got["dec"] >= got["n"] - 2
 
     def test_worker_via_open_source_env(self, fixture_mp4, tmp_path, monkeypatch):
         """End-to-end through the default routing (no source injection) —

@@ -182,13 +182,18 @@ void* va_open(const char* url, int64_t timeout_us, const char* options,
   net_init();
   Demux* d = new Demux();
   AVDictionary* opts = nullptr;
+  char buf[32];
+  std::snprintf(buf, sizeof buf, "%lld", (long long)timeout_us);
   if (std::strncmp(url, "rtsp", 4) == 0) {
     av_dict_set(&opts, "rtsp_transport", "tcp", 0);
-    char buf[32];
-    std::snprintf(buf, sizeof buf, "%lld", (long long)timeout_us);
     av_dict_set(&opts, "timeout", buf, 0);   // ffmpeg5 rtsp socket timeout
     av_dict_set(&opts, "stimeout", buf, 0);  // older name; ignored if unknown
     av_dict_set(&opts, "max_delay", "5000000", 0);
+  } else if (std::strstr(url, "://") != nullptr) {
+    // Every other network protocol (rtmp incl. listen mode, http, tcp):
+    // the generic avio I/O timeout, so a peer that never speaks cannot
+    // block a caller forever.
+    av_dict_set(&opts, "rw_timeout", buf, 0);
   }
   if (options && *options) {
     int prc = av_dict_parse_string(&opts, options, "=", ":", 0);
@@ -200,12 +205,35 @@ void* va_open(const char* url, int64_t timeout_us, const char* options,
     }
   }
   int rc = avformat_open_input(&d->fmt, url, nullptr, &opts);
-  av_dict_free(&opts);
   if (rc < 0) {
     set_averr(err, errcap, rc);
+    av_dict_free(&opts);
     delete d;
     return nullptr;
   }
+  // Caller-supplied keys still in `opts` were never consumed — a typo'd
+  // option silently ignored would surface as a baffling connection error
+  // (the built-in defaults above are exempt: "stimeout" is intentionally
+  // speculative across ffmpeg versions).
+  if (options && *options) {
+    AVDictionary* user = nullptr;
+    av_dict_parse_string(&user, options, "=", ":", 0);
+    const AVDictionaryEntry* e = nullptr;
+    while ((e = av_dict_get(user, "", e, AV_DICT_IGNORE_SUFFIX)) != nullptr) {
+      if (av_dict_get(opts, e->key, nullptr, 0) != nullptr) {
+        char msg[128];
+        std::snprintf(msg, sizeof msg, "unknown option '%s'", e->key);
+        set_err(err, errcap, msg);
+        av_dict_free(&user);
+        av_dict_free(&opts);
+        avformat_close_input(&d->fmt);
+        delete d;
+        return nullptr;
+      }
+    }
+    av_dict_free(&user);
+  }
+  av_dict_free(&opts);
   rc = avformat_find_stream_info(d->fmt, nullptr);
   if (rc < 0) {
     set_averr(err, errcap, rc);
@@ -412,6 +440,27 @@ void* vm_open(const char* url, const char* format, const VAStreamInfo* si,
     }
   }
   rc = avformat_write_header(m->fmt, &opts);
+  // Same unknown-option surfacing as va_open (write_header leaves
+  // unconsumed entries in opts).
+  if (rc >= 0 && options && *options) {
+    AVDictionary* user = nullptr;
+    av_dict_parse_string(&user, options, "=", ":", 0);
+    const AVDictionaryEntry* e = nullptr;
+    while ((e = av_dict_get(user, "", e, AV_DICT_IGNORE_SUFFIX)) != nullptr) {
+      if (av_dict_get(opts, e->key, nullptr, 0) != nullptr) {
+        char msg[128];
+        std::snprintf(msg, sizeof msg, "unknown option '%s'", e->key);
+        set_err(err, errcap, msg);
+        av_dict_free(&user);
+        av_dict_free(&opts);
+        if (!(m->fmt->oformat->flags & AVFMT_NOFILE)) avio_closep(&m->fmt->pb);
+        avformat_free_context(m->fmt);
+        delete m;
+        return nullptr;
+      }
+    }
+    av_dict_free(&user);
+  }
   av_dict_free(&opts);
   if (rc < 0) {
     set_averr(err, errcap, rc);
