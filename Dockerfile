@@ -9,6 +9,7 @@ FROM python:3.12-slim
 
 RUN apt-get update && apt-get install -y --no-install-recommends \
         g++ make libgl1 libglib2.0-0 \
+        libavformat-dev libavcodec-dev libavutil-dev libswscale-dev \
     && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /app
@@ -20,8 +21,10 @@ RUN pip install --no-cache-dir \
         jax flax optax orbax-checkpoint chex einops numpy \
         grpcio protobuf aiohttp pyyaml opencv-python-headless
 
-# Pre-build the native bus core into the image
-RUN python -c "from video_edge_ai_proxy_tpu.bus.native.build import build_library; build_library()"
+# Pre-build the native libs into the image: the shm bus core and the libav
+# demux/mux shim (packet-level ingest, stream-copy archive/relay).
+RUN python -c "from video_edge_ai_proxy_tpu.bus.native.build import build_library; build_library()" \
+ && python -c "from video_edge_ai_proxy_tpu.ingest import av; assert av.available()"
 
 EXPOSE 8080 50001
 VOLUME ["/data/chrysalis"]

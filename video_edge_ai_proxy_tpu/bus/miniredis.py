@@ -228,6 +228,22 @@ class MiniRedis:
             flat += [f, v]
         return self._arr(flat)
 
+    def _cmd_hkeys(self, args):
+        return self._arr(list(self._hashes.get(args[0], {}).keys()))
+
+    def _cmd_xgroup(self, args):
+        sub = args[0].upper()
+        if sub == b"CREATE":
+            key = args[1]
+            if key not in self._streams:
+                if b"MKSTREAM" not in (a.upper() for a in args):
+                    return b"-ERR The XGROUP subcommand requires the key to exist\r\n"
+                self._streams[key] = []  # MKSTREAM: empty stream, no entries
+            return b"+OK\r\n"
+        if sub == b"DESTROY":
+            return b":1\r\n"  # groups aren't modeled beyond stream creation
+        return b"-ERR unsupported XGROUP subcommand\r\n"
+
     def _cmd_hdel(self, args):
         h = self._hashes.get(args[0], {})
         n = 0
@@ -269,6 +285,16 @@ class MiniRedis:
 
     def _cmd_xlen(self, args):
         return b":%d\r\n" % len(self._streams.get(args[0], []))
+
+    def _cmd_xdel(self, args):
+        entries = self._streams.get(args[0], [])
+        want = set()
+        for raw in args[1:]:
+            ms, _, n = raw.partition(b"-")
+            want.add((int(ms), int(n or 0)))
+        before = len(entries)
+        entries[:] = [e for e in entries if e[0] not in want]
+        return b":%d\r\n" % (before - len(entries))
 
     def _cmd_xinfo(self, args):
         if args[0].upper() != b"STREAM":

@@ -62,6 +62,15 @@ class RedisFrameBus(FrameBus):
         # no Redis equivalent (streams size dynamically).
         self._maxlen[device_id] = max(1, slots)
         self._client.command("DEL", device_id)
+        # The FrameBus contract lists a created stream before its first
+        # frame (streams()). XGROUP CREATE MKSTREAM materializes an EMPTY
+        # stream key atomically — unlike an XADD+XDEL placeholder, no
+        # co-reading reference consumer can ever observe a phantom entry
+        # (the mixed-fleet case this backend exists for).
+        self._client.command(
+            "XGROUP", "CREATE", device_id, "_init", "$", "MKSTREAM"
+        )
+        self._client.command("XGROUP", "DESTROY", device_id, "_init")
 
     def publish(self, device_id: str, data: np.ndarray, meta: FrameMeta) -> int:
         from ..proto import pb
@@ -126,21 +135,42 @@ class RedisFrameBus(FrameBus):
         self._client.command("DEL", device_id)
 
     # -- control plane: plain KV --
+    #
+    # The cross-backend contract speaks flattened hash fields as
+    # "<key>::<field>" (bus/interface.py's helpers); on Redis those live in
+    # REAL hashes for reference interop, so the kv_* surface translates:
+    # "::"-shaped names route to HGET/HSET/HDEL and kv_keys lists hash
+    # fields in flattened form. list-then-get therefore works identically
+    # on every backend.
 
     def kv_set(self, key: str, value: str) -> None:
+        if "::" in key:
+            base, _, field = key.partition("::")
+            self._client.command("HSET", base, field, value)
+            return
         self._client.command("SET", key, value)
 
     def kv_get(self, key: str) -> Optional[str]:
-        out = self._client.command("GET", key)
+        if "::" in key:
+            base, _, field = key.partition("::")
+            out = self._client.command("HGET", base, field)
+        else:
+            out = self._client.command("GET", key)
         return out.decode() if isinstance(out, bytes) else out
 
     def kv_del(self, key: str) -> None:
+        if "::" in key:
+            base, _, field = key.partition("::")
+            self._client.command("HDEL", base, field)
+            return
         self._client.command("DEL", key)
 
     def kv_keys(self) -> list[str]:
-        # TYPE string keeps the contract shape of the other backends
-        # (control KV only — no stream/hash names).
-        return self._scan_keys("string")
+        out = set(self._scan_keys("string"))
+        for h in self._scan_keys("hash"):
+            fields = self._client.command("HKEYS", h) or []
+            out.update(f"{h}::{f.decode()}" for f in fields)
+        return sorted(out)
 
     def _scan_keys(self, want_type: str) -> list[str]:
         # SCAN, never KEYS: this backend shares a production Redis with

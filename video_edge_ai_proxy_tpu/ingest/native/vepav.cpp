@@ -116,6 +116,32 @@ struct Enc {
   int64_t next_pts = 0;
 };
 
+// After avformat_open_input / avformat_write_header, entries the consumer
+// didn't take remain in `opts`. A CALLER-supplied key among them is a typo
+// or unsupported option that would otherwise degrade silently into a
+// baffling connection error; built-in defaults (e.g. the speculative
+// "stimeout") are exempt because only keys parsed from `options` are
+// checked. Returns true and fills err when one is found.
+bool unconsumed_user_option(AVDictionary* opts, const char* options,
+                            char* err, int errcap) {
+  if (!options || !*options) return false;
+  AVDictionary* user = nullptr;
+  av_dict_parse_string(&user, options, "=", ":", 0);
+  const AVDictionaryEntry* e = nullptr;
+  bool found = false;
+  while ((e = av_dict_get(user, "", e, AV_DICT_IGNORE_SUFFIX)) != nullptr) {
+    if (av_dict_get(opts, e->key, nullptr, 0) != nullptr) {
+      char msg[128];
+      std::snprintf(msg, sizeof msg, "unknown option '%s'", e->key);
+      set_err(err, errcap, msg);
+      found = true;
+      break;
+    }
+  }
+  av_dict_free(&user);
+  return found;
+}
+
 int open_decoder(Demux* d) {
   const AVCodecParameters* par = d->fmt->streams[d->vstream]->codecpar;
   const AVCodec* codec = avcodec_find_decoder(par->codec_id);
@@ -211,27 +237,11 @@ void* va_open(const char* url, int64_t timeout_us, const char* options,
     delete d;
     return nullptr;
   }
-  // Caller-supplied keys still in `opts` were never consumed — a typo'd
-  // option silently ignored would surface as a baffling connection error
-  // (the built-in defaults above are exempt: "stimeout" is intentionally
-  // speculative across ffmpeg versions).
-  if (options && *options) {
-    AVDictionary* user = nullptr;
-    av_dict_parse_string(&user, options, "=", ":", 0);
-    const AVDictionaryEntry* e = nullptr;
-    while ((e = av_dict_get(user, "", e, AV_DICT_IGNORE_SUFFIX)) != nullptr) {
-      if (av_dict_get(opts, e->key, nullptr, 0) != nullptr) {
-        char msg[128];
-        std::snprintf(msg, sizeof msg, "unknown option '%s'", e->key);
-        set_err(err, errcap, msg);
-        av_dict_free(&user);
-        av_dict_free(&opts);
-        avformat_close_input(&d->fmt);
-        delete d;
-        return nullptr;
-      }
-    }
-    av_dict_free(&user);
+  if (unconsumed_user_option(opts, options, err, errcap)) {
+    av_dict_free(&opts);
+    avformat_close_input(&d->fmt);
+    delete d;
+    return nullptr;
   }
   av_dict_free(&opts);
   rc = avformat_find_stream_info(d->fmt, nullptr);
@@ -440,26 +450,12 @@ void* vm_open(const char* url, const char* format, const VAStreamInfo* si,
     }
   }
   rc = avformat_write_header(m->fmt, &opts);
-  // Same unknown-option surfacing as va_open (write_header leaves
-  // unconsumed entries in opts).
-  if (rc >= 0 && options && *options) {
-    AVDictionary* user = nullptr;
-    av_dict_parse_string(&user, options, "=", ":", 0);
-    const AVDictionaryEntry* e = nullptr;
-    while ((e = av_dict_get(user, "", e, AV_DICT_IGNORE_SUFFIX)) != nullptr) {
-      if (av_dict_get(opts, e->key, nullptr, 0) != nullptr) {
-        char msg[128];
-        std::snprintf(msg, sizeof msg, "unknown option '%s'", e->key);
-        set_err(err, errcap, msg);
-        av_dict_free(&user);
-        av_dict_free(&opts);
-        if (!(m->fmt->oformat->flags & AVFMT_NOFILE)) avio_closep(&m->fmt->pb);
-        avformat_free_context(m->fmt);
-        delete m;
-        return nullptr;
-      }
-    }
-    av_dict_free(&user);
+  if (rc >= 0 && unconsumed_user_option(opts, options, err, errcap)) {
+    av_dict_free(&opts);
+    if (!(m->fmt->oformat->flags & AVFMT_NOFILE)) avio_closep(&m->fmt->pb);
+    avformat_free_context(m->fmt);
+    delete m;
+    return nullptr;
   }
   av_dict_free(&opts);
   if (rc < 0) {

@@ -22,6 +22,7 @@ Two transports:
 from __future__ import annotations
 
 import os
+import time
 from collections import deque
 from typing import Deque, Optional, Tuple
 
@@ -39,6 +40,12 @@ class PacketPassthroughWriter:
     starts the remote stream at a keyframe (reference
     ``rtsp_to_rtmp.py:136-139,155-157``)."""
 
+    # A failed sink open retries while the toggle stays on (a slow-to-boot
+    # RTMP ingest must not require an operator re-toggle), but not on every
+    # packet — connect attempts to a dead endpoint block for the protocol
+    # timeout.
+    RETRY_COOLDOWN_S = 2.0
+
     def __init__(self, endpoint: str, info, max_buffer_bytes: int = 16 << 20):
         self.endpoint = endpoint
         self.info = info                     # av.StreamInfo of the source
@@ -48,6 +55,7 @@ class PacketPassthroughWriter:
         self._mux = None
         self._base_ts: Optional[int] = None  # first relayed dts -> 0
         self._failed = False
+        self._failed_at = 0.0
         self.requested = False
         self.active = False
         self.written = 0
@@ -99,6 +107,22 @@ class PacketPassthroughWriter:
 
     def set_active(self, active: bool) -> None:
         if active == self.requested:
+            if (
+                active and not self.active and self._failed
+                and time.monotonic() - self._failed_at > self.RETRY_COOLDOWN_S
+            ):
+                # Toggle still on but transport down (sink wasn't up yet,
+                # or died mid-relay): retry instead of staying dead until
+                # an operator re-toggles.
+                self._failed = False
+                if self._open():
+                    self.active = True
+                    for pkt in self._gop:
+                        self._write(pkt)
+                    log.info(
+                        "packet passthrough to %s recovered (flushed %d "
+                        "buffered packets)", self.endpoint, len(self._gop),
+                    )
             return
         self.requested = active
         if not active:
@@ -158,10 +182,11 @@ class PacketPassthroughWriter:
         if not self._failed:
             log.warning(
                 "RTMP packet passthrough to %s unavailable (%s); toggle "
-                "state is tracked only, transport off until re-toggled",
-                self.endpoint, why,
+                "state tracked, transport retries every %.0fs while the "
+                "toggle stays on", self.endpoint, why, self.RETRY_COOLDOWN_S,
             )
         self._failed = True
+        self._failed_at = time.monotonic()
         self.active = False
 
     def _close(self) -> None:
