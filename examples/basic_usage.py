@@ -28,7 +28,9 @@ def frame_requests(device_id: str, keyframe_only: bool):
         yield pb.VideoFrameRequest(device_id=device_id, key_frame_only=keyframe_only)
 
 
-def watch(stub, device_id: str, keyframe_only: bool):
+def watch(stub, device_id: str, keyframe_only: bool, frames: int = 0):
+    """``frames`` bounds the watch (0 = endless, the camera-monitor use)."""
+    seen = 0
     while True:
         try:
             for frame in stub.VideoLatestImage(
@@ -41,6 +43,9 @@ def watch(stub, device_id: str, keyframe_only: bool):
                     f"keyframe={frame.is_keyframe} pts={frame.pts} "
                     f"packet={frame.packet}"
                 )
+                seen += 1
+                if frames and seen >= frames:
+                    return
         except grpc.RpcError as err:
             if err.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
                 continue   # 15 s stream deadline: reconnect (by design)
@@ -53,10 +58,12 @@ if __name__ == "__main__":
     parser.add_argument("--device", type=str, default=None)
     parser.add_argument("--keyframe_only", action="store_true")
     parser.add_argument("--host", type=str, default="127.0.0.1:50001")
+    parser.add_argument("--frames", type=int, default=0,
+                        help="stop after N frames (0 = watch forever)")
     args = parser.parse_args()
 
     stub = pb_grpc.ImageStub(grpc.insecure_channel(args.host))
     if args.list:
         list_streams(stub)
     if args.device:
-        watch(stub, args.device, args.keyframe_only)
+        watch(stub, args.device, args.keyframe_only, args.frames)

@@ -310,6 +310,45 @@ class TestEngine:
         out = eng._step(groups[0].src_hw, groups[0].bucket)(eng._variables, placed)
         assert np.asarray(out["top_probs"]).shape == (4, 5)
 
+    def test_compile_cache_dir_populated(self, bus, tmp_path):
+        """cfg.compile_cache_dir turns on the persistent XLA compile cache
+        (SURVEY.md §5.4: restart = load + compile cache): compiling one
+        serving program must leave cache entries on disk."""
+        import os
+
+        import jax
+
+        cache = str(tmp_path / "xla_cache")
+        cfg = EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1,), tick_ms=5,
+            compile_cache_dir=cache,
+        )
+        prev = jax.config.jax_compilation_cache_dir  # conftest's shared dir
+        prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            eng = InferenceEngine(bus, cfg)
+            eng.warmup()
+            # Tiny programs compile under the engine's 0.5 s persistence
+            # threshold; drop it so the write is deterministic, and use a
+            # geometry no earlier test compiled (the in-process executable
+            # cache would otherwise skip compilation entirely).
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            eng.compile_for((40, 56), 1)
+            assert os.path.isdir(cache)
+            assert os.listdir(cache)  # at least one persisted program
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prev_min
+            )
+            # The cache OBJECT bound the tmp dir; restoring the config
+            # alone would leave later tests persisting there.
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+
     def test_mesh_auto_serves_dp_over_all_devices(self, bus):
         """cfg.mesh='auto' (fleet-operator default): dp over every visible
         device with no hand-written shape (VERDICT round-1 weak #5)."""

@@ -1,8 +1,12 @@
 import json
+import os
+import pathlib
 import time
 
 import grpc
 import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
 
 from video_edge_ai_proxy_tpu.bus import MemoryFrameBus, open_bus
 from video_edge_ai_proxy_tpu.proto import pb, pb_grpc
@@ -365,6 +369,42 @@ class TestEndToEnd:
         with urllib.request.urlopen(rest + "/api/v1/processlist") as resp:
             assert json.loads(resp.read()) == []
         channel.close()
+
+    def test_reference_example_runs_unchanged(self, server):
+        """The compatibility bar made executable: examples/basic_usage.py —
+        the reference's client pattern — runs as a real subprocess against
+        a live server and sees frames (SURVEY.md §7: "so examples/*.py run
+        unchanged")."""
+        import subprocess
+        import sys as _sys
+
+        server.process_manager.start(
+            StreamProcess(name="excam", rtsp_endpoint=synth_url())
+        )
+        try:
+            host = f"127.0.0.1:{server.bound_grpc_port}"
+            env = dict(os.environ, PYTHONPATH=str(REPO))
+            listing = subprocess.run(
+                [_sys.executable, "examples/basic_usage.py", "--list",
+                 "--host", host],
+                cwd=str(REPO), env=env, capture_output=True, text=True,
+                timeout=60,
+            )
+            assert listing.returncode == 0, listing.stderr
+            assert 'name: "excam"' in listing.stdout
+            watch = subprocess.run(
+                [_sys.executable, "examples/basic_usage.py",
+                 "--device", "excam", "--frames", "3", "--host", host],
+                cwd=str(REPO), env=env, capture_output=True, text=True,
+                timeout=60,
+            )
+            assert watch.returncode == 0, watch.stderr
+            frames = [l for l in watch.stdout.splitlines()
+                      if l.startswith("excam: ")]
+            assert len(frames) == 3
+            assert "64x48" in frames[0]
+        finally:
+            server.process_manager.stop("excam")
 
     def test_log_follow_incremental(self, server):
         """?since=cursor hands back only new lines; unknown camera 400s."""

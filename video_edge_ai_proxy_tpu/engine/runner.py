@@ -166,6 +166,35 @@ class InferenceEngine:
 
         from ..models import registry
 
+        if self._cfg.compile_cache_dir:
+            # Persistent XLA compile cache: a restarted server re-loads
+            # compiled programs instead of paying tens of seconds to
+            # minutes per (geometry, bucket) again (SURVEY.md §5.4).
+            jax.config.update(
+                "jax_compilation_cache_dir", self._cfg.compile_cache_dir
+            )
+            if jax.config.jax_persistent_cache_min_compile_time_secs == 1.0:
+                # Lower the jax-default persistence threshold so mid-size
+                # serving programs cache too — but never clobber a value
+                # the operator set (env/config before boot).
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.5
+                )
+            try:
+                # The cache object binds its directory on first use; if
+                # anything compiled before warmup (another engine, a
+                # preloaded model), the config change alone is ignored.
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc,
+                )
+
+                _cc.reset_cache()
+            except Exception:
+                log.warning(
+                    "could not reset the XLA compilation cache; programs "
+                    "compiled before warmup may persist elsewhere",
+                    exc_info=True,
+                )
         if self._spec is None:
             self._spec = registry.get(self._cfg.model)
         self._model, self._variables = self._spec.init_params(

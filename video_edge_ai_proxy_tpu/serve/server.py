@@ -81,8 +81,19 @@ class Server:
                     "TPU inference engine requested but the engine package "
                     "is unavailable"
                 ) from exc
+            engine_cfg = self.cfg.engine
+            if engine_cfg.compile_cache_dir == "auto":
+                # "auto" resolves into the data dir (persists across
+                # restarts like the registry) WITHOUT mutating the
+                # caller's Config; empty stays off, per the config doc.
+                import dataclasses
+
+                engine_cfg = dataclasses.replace(
+                    engine_cfg,
+                    compile_cache_dir=os.path.join(data_dir, "compile_cache"),
+                )
             self.engine = InferenceEngine(
-                self.bus, self.cfg.engine, annotations=self.annotations,
+                self.bus, engine_cfg, annotations=self.annotations,
                 model_resolver=self.process_manager.inference_model_of,
             )
         self.cron = CronJobs(self.cfg.buffer)

@@ -94,6 +94,12 @@ class EngineConfig:
     # msgpack params checkpoint; empty = random init (no pretrained weights
     # are bundled). Loaded at warmup so restart = load + compile cache.
     checkpoint_path: str = ""
+    # Persistent XLA compile cache (SURVEY.md §5.4: "warmup = load +
+    # compile-cache"): big serving programs take tens of seconds to
+    # minutes to compile; with a cache dir a restarted server skips
+    # recompiling every (geometry, bucket) program it has seen. "" = off
+    # (jax default); "auto" = the server resolves <data_dir>/compile_cache.
+    compile_cache_dir: str = ""
     # Geometries to compile at boot instead of on first frame: list of
     # [height, width, bucket]. Big programs (e.g. ViT at bucket 32) can take
     # minutes to compile; prewarming moves that cost out of the hot path.
