@@ -37,6 +37,9 @@ class PacketInfo:
     dts: int
     timestamp_ms: int    # wall-clock at demux (reference uses wallclock PTS)
     time_base: float
+    # Demuxer-flagged corruption, shipped through VideoFrame.is_corrupt
+    # (reference ``read_image.py:111``: vf.is_corrupt = packet.is_corrupt).
+    is_corrupt: bool = False
 
 
 class VideoSource(ABC):
@@ -246,6 +249,7 @@ class PacketSource(VideoSource):
             dts=pkt.dts,
             timestamp_ms=int(time.time() * 1000),
             time_base=num / den,
+            is_corrupt=pkt.is_corrupt,
         )
 
     def packet_bytes(self) -> bytes:

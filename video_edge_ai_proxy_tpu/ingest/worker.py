@@ -350,6 +350,7 @@ class IngestWorker:
                         packet=pkt.packet,
                         keyframe_cnt=self._keyframes,
                         is_keyframe=pkt.is_keyframe,
+                        is_corrupt=pkt.is_corrupt,
                         frame_type=frame_type,
                         time_base=pkt.time_base,
                     )
