@@ -1,0 +1,29 @@
+"""video_edge_ai_proxy_tpu — a TPU-native video edge AI proxy framework.
+
+A from-scratch rebuild of the capabilities of the reference system
+"Chrysalis Video Edge AI Proxy" (tangtang888/video-edge-ai-proxy), designed
+TPU-first:
+
+- ``bus``      — the frame data plane: a native (C++) shared-memory seqlock
+  ring per camera plus a control KV, replacing the reference's Redis streams
+  (reference: ``python/read_image.py:121``, ``server/grpcapi/grpc_api.go:191``).
+- ``ingest``   — per-camera worker processes: demux/decode pipeline with lazy
+  decode gating, keyframe-only mode, GOP grouping and archiving
+  (reference: ``python/rtsp_to_rtmp.py``, ``python/read_image.py``).
+- ``serve``    — the gRPC ``Image`` service (5 RPCs) and REST camera lifecycle
+  API (reference: ``server/grpcapi/``, ``server/api/``, ``server/router/``).
+- ``engine``   — the new TPU inference plane: batch collector with bucketed
+  static shapes, XLA-compiled preprocess + model forward, Pallas NMS.
+- ``ops``      — JAX/Pallas ops (preprocess, NMS, box utilities).
+- ``models``   — Flax model zoo (MobileNetV2, ResNet-50, ViT-B/16, YOLOv8n,
+  VideoMAE) covering BASELINE configs 1-5.
+- ``parallel`` — device mesh, sharding rules, collectives and the sharded
+  training step (dp/fsdp/tp/sp/ep axes over ``jax.sharding.Mesh``).
+- ``uplink``   — batched annotation uplink with HMAC-signed cloud client
+  (reference: ``server/batch/annotation_consumer.go``,
+  ``server/services/edge_service.go``).
+- ``utils``    — config, logging, signing, parsing helpers
+  (reference: ``server/globals/config.go``, ``server/utils/parser_utils.go``).
+"""
+
+__version__ = "0.1.0"

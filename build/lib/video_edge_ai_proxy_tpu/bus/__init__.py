@@ -1,0 +1,46 @@
+from .interface import (
+    FIELD_LAST_QUERY,
+    FIELD_PROXY_RTMP,
+    FIELD_STORE,
+    KEY_KEYFRAME_ONLY_PREFIX,
+    KEY_LAST_ACCESS_PREFIX,
+    Frame,
+    FrameBus,
+    FrameMeta,
+    RingSlotTooSmall,
+)
+from .memory_bus import MemoryFrameBus
+
+
+def open_bus(backend: str = "shm", shm_dir: str = "/dev/shm/vep_tpu",
+             redis_addr: str = "127.0.0.1:6379") -> FrameBus:
+    """Factory: ``shm`` (native shared-memory, same-host fast path),
+    ``redis`` (wire-compatible with the reference's Redis fabric — interop
+    with reference workers/clients, SURVEY.md §7.2), or ``memory``
+    (in-proc, tests)."""
+    if backend == "shm":
+        from .shm_bus import ShmFrameBus
+
+        return ShmFrameBus(shm_dir)
+    if backend == "redis":
+        from .redis_bus import RedisFrameBus
+
+        return RedisFrameBus(redis_addr)
+    if backend == "memory":
+        return MemoryFrameBus()
+    raise ValueError(f"unknown bus backend {backend!r}")
+
+
+__all__ = [
+    "Frame",
+    "FrameBus",
+    "FrameMeta",
+    "MemoryFrameBus",
+    "open_bus",
+    "KEY_LAST_ACCESS_PREFIX",
+    "KEY_KEYFRAME_ONLY_PREFIX",
+    "RingSlotTooSmall",
+    "FIELD_LAST_QUERY",
+    "FIELD_PROXY_RTMP",
+    "FIELD_STORE",
+]

@@ -1,0 +1,171 @@
+"""Frame-bus interface and shared key contract.
+
+The bus is the framework's data+control fabric between per-camera ingest
+workers, the serving layer and the TPU engine. It replaces the reference's
+Redis fabric while keeping its *semantics*:
+
+- frame plane: latest-wins ring per camera (reference ``XADD <device_id>
+  MAXLEN N`` / ``XREAD``, ``python/read_image.py:121``,
+  ``server/grpcapi/grpc_api.go:187-229``). Readers carry a per-connection
+  cursor (sequence number) — deliberately fixing the reference's shared-cursor
+  race (``grpc_api.go:42,182``, SURVEY.md §3.2).
+- control plane: string KV with the reference's key contract
+  (``server/models/RedisConstants.go:18-27``): ``last_access_time_<id>`` is a
+  JSON hash with ``last_query``/``proxy_rtmp``/``store`` fields and
+  ``is_key_frame_only_<id>`` a boolean flag.
+"""
+
+from __future__ import annotations
+
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+# Control-key contract (reference server/models/RedisConstants.go:18-27).
+KEY_LAST_ACCESS_PREFIX = "last_access_time_"
+KEY_KEYFRAME_ONLY_PREFIX = "is_key_frame_only_"
+FIELD_LAST_QUERY = "last_query"
+FIELD_PROXY_RTMP = "proxy_rtmp"
+FIELD_STORE = "store"
+
+FRAME_TYPE_NAMES = {0: "", 1: "I", 2: "P", 3: "B"}
+FRAME_TYPE_CODES = {v: k for k, v in FRAME_TYPE_NAMES.items()}
+
+
+class RingSlotTooSmall(OSError):
+    """A frame exceeded its shm ring slot. Distinct type so producers can
+    grow-and-retry without confusing it with transport errors (a redis
+    TimeoutError is also an OSError — recreating the stream on those would
+    DEL live data)."""
+
+
+@dataclass
+class FrameMeta:
+    """Per-frame metadata (mirrors VideoFrame proto fields,
+    proto/video_streaming.proto)."""
+
+    width: int = 0
+    height: int = 0
+    channels: int = 3
+    timestamp_ms: int = 0
+    pts: int = 0
+    dts: int = 0
+    packet: int = 0
+    keyframe_cnt: int = 0
+    is_keyframe: bool = False
+    is_corrupt: bool = False
+    frame_type: str = ""
+    time_base: float = 0.0
+
+
+@dataclass
+class Frame:
+    seq: int
+    data: np.ndarray  # HWC uint8 BGR24
+    meta: FrameMeta = field(default_factory=FrameMeta)
+
+
+class FrameBus(ABC):
+    """Abstract frame bus: per-stream latest-wins rings + control KV."""
+
+    # -- frame plane --
+
+    @abstractmethod
+    def create_stream(self, device_id: str, frame_bytes: int, slots: int = 4) -> None:
+        """Producer-side: (re)create the ring for a camera."""
+
+    @abstractmethod
+    def publish(self, device_id: str, data: np.ndarray, meta: FrameMeta) -> int:
+        """Publish one frame; returns its sequence number."""
+
+    @abstractmethod
+    def read_latest(self, device_id: str, min_seq: int = 0) -> Optional[Frame]:
+        """Newest frame with seq > min_seq, or None. Non-blocking."""
+
+    @abstractmethod
+    def streams(self) -> list[str]:
+        """Device ids with a live ring."""
+
+    @abstractmethod
+    def drop_stream(self, device_id: str) -> None:
+        """Producer-side: remove the ring (camera stopped)."""
+
+    # -- control plane --
+
+    @abstractmethod
+    def kv_set(self, key: str, value: str) -> None: ...
+
+    @abstractmethod
+    def kv_get(self, key: str) -> Optional[str]: ...
+
+    @abstractmethod
+    def kv_del(self, key: str) -> None: ...
+
+    @abstractmethod
+    def kv_keys(self) -> list[str]: ...
+
+    def close(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    # -- hash-shaped helpers over the KV (reference uses Redis hashes) --
+    #
+    # Fields are stored as flat keys "<key>::<field>" so each hset is one
+    # atomic kv_set — no read-modify-write, so concurrent writers to
+    # different fields of one hash (touch_query vs set_proxy_rtmp from
+    # different gRPC threads) can't lose updates. Redis HSET is atomic; this
+    # preserves that property on the shm KV.
+
+    _HASH_FIELDS = (FIELD_LAST_QUERY, FIELD_PROXY_RTMP, FIELD_STORE)
+
+    def hset(self, key: str, field_name: str, value: str) -> None:
+        self.kv_set(f"{key}::{field_name}", value)
+
+    def hget(self, key: str, field_name: str) -> Optional[str]:
+        return self.kv_get(f"{key}::{field_name}")
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for field_name in self._HASH_FIELDS:
+            val = self.kv_get(f"{key}::{field_name}")
+            if val is not None:
+                out[field_name] = val
+        return out
+
+    def hdel_all(self, key: str) -> None:
+        for field_name in self._HASH_FIELDS:
+            self.kv_del(f"{key}::{field_name}")
+
+    # -- control-contract helpers --
+
+    def touch_query(self, device_id: str, now_ms: Optional[int] = None) -> None:
+        """Record a client query (reference ``grpc_api.go:166-175``)."""
+        ts = now_ms if now_ms is not None else int(time.time() * 1000)
+        self.hset(KEY_LAST_ACCESS_PREFIX + device_id, FIELD_LAST_QUERY, str(ts))
+
+    def last_query_ms(self, device_id: str) -> Optional[int]:
+        val = self.hget(KEY_LAST_ACCESS_PREFIX + device_id, FIELD_LAST_QUERY)
+        return int(val) if val else None
+
+    def set_keyframe_only(self, device_id: str, enabled: bool) -> None:
+        """Reference ``grpc_api.go:159-163`` / worker ``read_image.py:36-45``."""
+        self.kv_set(KEY_KEYFRAME_ONLY_PREFIX + device_id, "1" if enabled else "0")
+
+    def keyframe_only(self, device_id: str) -> bool:
+        return self.kv_get(KEY_KEYFRAME_ONLY_PREFIX + device_id) == "1"
+
+    def set_proxy_rtmp(self, device_id: str, enabled: bool) -> None:
+        """Reference ``grpc_proxy_api.go:30-37``."""
+        self.hset(
+            KEY_LAST_ACCESS_PREFIX + device_id,
+            FIELD_PROXY_RTMP,
+            "true" if enabled else "false",
+        )
+
+    def proxy_rtmp(self, device_id: str) -> bool:
+        return (
+            self.hgetall(KEY_LAST_ACCESS_PREFIX + device_id).get(FIELD_PROXY_RTMP)
+            == "true"
+        )

@@ -1,0 +1,77 @@
+"""In-process frame bus for tests and single-process deployments.
+
+Same semantics as :class:`ShmFrameBus` (latest-wins ring, per-reader cursors,
+string KV) with plain Python data structures — the moral equivalent of the
+fakeredis the reference's test strategy lacks (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from .interface import Frame, FrameBus, FrameMeta
+
+
+class MemoryFrameBus(FrameBus):
+    def __init__(self, shm_dir: str = ""):  # signature-compatible with ShmFrameBus
+        self._lock = threading.Lock()
+        self._rings: dict[str, deque[Frame]] = {}
+        self._seq: dict[str, int] = {}
+        self._kv: dict[str, str] = {}
+
+    def create_stream(self, device_id: str, frame_bytes: int, slots: int = 4) -> None:
+        with self._lock:
+            self._rings[device_id] = deque(maxlen=max(1, slots))
+            self._seq[device_id] = 0
+
+    def publish(self, device_id: str, data: np.ndarray, meta: FrameMeta) -> int:
+        with self._lock:
+            if device_id not in self._rings:
+                raise ValueError(f"stream {device_id!r} not created")
+            self._seq[device_id] += 1
+            seq = self._seq[device_id]
+            self._rings[device_id].append(
+                Frame(seq=seq, data=np.array(data, copy=True), meta=meta)
+            )
+            return seq
+
+    def read_latest(self, device_id: str, min_seq: int = 0) -> Optional[Frame]:
+        with self._lock:
+            ring = self._rings.get(device_id)
+            if not ring:
+                return None
+            frame = ring[-1]
+            if frame.seq <= min_seq:
+                return None
+            # Copy out, matching ShmFrameBus (whose read path memcpys into a
+            # private buffer) — consumers may mutate pixels in place.
+            return Frame(seq=frame.seq, data=frame.data.copy(), meta=frame.meta)
+
+    def streams(self) -> list[str]:
+        with self._lock:
+            return sorted(self._rings)
+
+    def drop_stream(self, device_id: str) -> None:
+        with self._lock:
+            self._rings.pop(device_id, None)
+            self._seq.pop(device_id, None)
+
+    def kv_set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def kv_get(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+
+    def kv_keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._kv)

@@ -1,0 +1,340 @@
+"""In-process mini Redis server (RESP2) for tests.
+
+fakeredis is not in this image, so the subset of Redis the bus backend and
+the reference contract use is implemented directly: strings, hashes and
+streams with MAXLEN trimming, served over real sockets so the RESP client
+and any reference tooling exercise the actual wire format. Semantics match
+Redis 6 for the commands listed in ``_Handler.COMMANDS`` — nothing more.
+
+This is test infrastructure: production deployments point
+``bus.backend: redis`` at a real Redis (the point of wire compatibility).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from fnmatch import fnmatchcase
+from typing import Dict, List, Optional, Tuple
+
+StreamEntry = Tuple[Tuple[int, int], List[bytes]]  # ((ms, n), flat fields)
+
+
+class MiniRedis:
+    """``with MiniRedis() as addr: RespClient.from_addr(addr)``."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._strings: Dict[bytes, bytes] = {}
+        self._hashes: Dict[bytes, Dict[bytes, bytes]] = {}
+        self._streams: Dict[bytes, List[StreamEntry]] = {}
+        self._last_stream_id: Dict[bytes, Tuple[int, int]] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.addr = "%s:%d" % self._srv.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="miniredis", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- lifecycle --
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> str:
+        return self.addr
+
+    def __exit__(self, *_exc) -> None:
+        self.close()
+
+    # -- socket plumbing --
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        buf = b""
+
+        def read_line() -> Optional[bytes]:
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return None
+                buf += chunk
+            line, buf = buf.split(b"\r\n", 1)
+            return line
+
+        def read_exact(n: int) -> Optional[bytes]:
+            nonlocal buf
+            while len(buf) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return None
+                buf += chunk
+            out, buf = buf[:n], buf[n:]
+            return out
+
+        try:
+            while not self._stop.is_set():
+                line = read_line()
+                if line is None:
+                    return
+                if not line.startswith(b"*"):
+                    conn.sendall(b"-ERR protocol error\r\n")
+                    return
+                parts: List[bytes] = []
+                for _ in range(int(line[1:])):
+                    hdr = read_line()
+                    if hdr is None or not hdr.startswith(b"$"):
+                        return
+                    data = read_exact(int(hdr[1:]))
+                    if data is None or read_exact(2) is None:
+                        return
+                    parts.append(data)
+                conn.sendall(self._dispatch(parts))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    # -- RESP encoding --
+
+    @staticmethod
+    def _bulk(v: Optional[bytes]) -> bytes:
+        if v is None:
+            return b"$-1\r\n"
+        return b"$%d\r\n%s\r\n" % (len(v), v)
+
+    @classmethod
+    def _arr(cls, items: list) -> bytes:
+        out = b"*%d\r\n" % len(items)
+        for it in items:
+            if isinstance(it, list):
+                out += cls._arr(it)
+            elif isinstance(it, int):
+                out += b":%d\r\n" % it
+            else:
+                out += cls._bulk(it)
+        return out
+
+    # -- command dispatch --
+
+    def _dispatch(self, parts: List[bytes]) -> bytes:
+        cmd = parts[0].upper().decode()
+        fn = getattr(self, f"_cmd_{cmd.lower()}", None)
+        if fn is None:
+            return f"-ERR unknown command '{cmd}'\r\n".encode()
+        with self._lock:
+            try:
+                return fn(parts[1:])
+            except Exception as exc:  # malformed args -> RESP error
+                return f"-ERR {type(exc).__name__}: {exc}\r\n".encode()
+
+    def _type_of(self, key: bytes) -> str:
+        if key in self._streams:
+            return "stream"
+        if key in self._hashes:
+            return "hash"
+        if key in self._strings:
+            return "string"
+        return "none"
+
+    def _cmd_ping(self, _args):
+        return b"+PONG\r\n"
+
+    def _cmd_set(self, args):
+        self._strings[args[0]] = args[1]
+        self._hashes.pop(args[0], None)
+        self._streams.pop(args[0], None)
+        return b"+OK\r\n"
+
+    def _cmd_get(self, args):
+        return self._bulk(self._strings.get(args[0]))
+
+    def _cmd_del(self, args):
+        n = 0
+        for key in args:
+            for table in (self._strings, self._hashes, self._streams):
+                if key in table:
+                    del table[key]
+                    n += 1
+        return b":%d\r\n" % n
+
+    def _cmd_exists(self, args):
+        return b":%d\r\n" % sum(1 for k in args if self._type_of(k) != "none")
+
+    def _cmd_keys(self, args):
+        pat = args[0].decode()
+        keys = [
+            k for k in (*self._strings, *self._hashes, *self._streams)
+            if fnmatchcase(k.decode(), pat)
+        ]
+        return self._arr(sorted(keys))
+
+    def _cmd_scan(self, args):
+        # One-shot scan: returns cursor 0 with everything (valid per the
+        # SCAN contract — the server may return all keys in one page).
+        match, want_type = "*", None
+        i = 1
+        while i < len(args):
+            opt = args[i].upper()
+            if opt == b"MATCH":
+                match = args[i + 1].decode()
+            elif opt == b"TYPE":
+                want_type = args[i + 1].decode()
+            i += 2
+        keys = [
+            k for k in (*self._strings, *self._hashes, *self._streams)
+            if fnmatchcase(k.decode(), match)
+            and (want_type is None or self._type_of(k) == want_type)
+        ]
+        return self._arr([b"0", sorted(keys)])
+
+    def _cmd_type(self, args):
+        return f"+{self._type_of(args[0])}\r\n".encode()
+
+    def _cmd_hset(self, args):
+        h = self._hashes.setdefault(args[0], {})
+        added = 0
+        for f, v in zip(args[1::2], args[2::2]):
+            if f not in h:
+                added += 1
+            h[f] = v
+        return b":%d\r\n" % added
+
+    def _cmd_hget(self, args):
+        return self._bulk(self._hashes.get(args[0], {}).get(args[1]))
+
+    def _cmd_hgetall(self, args):
+        flat: list = []
+        for f, v in self._hashes.get(args[0], {}).items():
+            flat += [f, v]
+        return self._arr(flat)
+
+    def _cmd_hkeys(self, args):
+        return self._arr(list(self._hashes.get(args[0], {}).keys()))
+
+    def _cmd_xgroup(self, args):
+        sub = args[0].upper()
+        if sub == b"CREATE":
+            key = args[1]
+            if key not in self._streams:
+                if b"MKSTREAM" not in (a.upper() for a in args):
+                    return b"-ERR The XGROUP subcommand requires the key to exist\r\n"
+                self._streams[key] = []  # MKSTREAM: empty stream, no entries
+            return b"+OK\r\n"
+        if sub == b"DESTROY":
+            return b":1\r\n"  # groups aren't modeled beyond stream creation
+        return b"-ERR unsupported XGROUP subcommand\r\n"
+
+    def _cmd_hdel(self, args):
+        h = self._hashes.get(args[0], {})
+        n = 0
+        for f in args[1:]:
+            if f in h:
+                del h[f]
+                n += 1
+        return b":%d\r\n" % n
+
+    def _cmd_xadd(self, args):
+        key = args[0]
+        i = 1
+        maxlen = None
+        if args[i].upper() == b"MAXLEN":
+            i += 1
+            if args[i] in (b"~", b"="):
+                i += 1
+            maxlen = int(args[i])
+            i += 1
+        entry_id = args[i]
+        i += 1
+        fields = list(args[i:])
+        now_ms = int(time.time() * 1000)
+        if entry_id == b"*":
+            last = self._last_stream_id.get(key, (0, -1))
+            if now_ms > last[0]:
+                new = (now_ms, 0)
+            else:  # same ms (or clock went backwards): bump the sub-counter
+                new = (last[0], last[1] + 1)
+        else:
+            ms, _, n = entry_id.partition(b"-")
+            new = (int(ms), int(n or 0))
+        self._last_stream_id[key] = new
+        entries = self._streams.setdefault(key, [])
+        entries.append((new, fields))
+        if maxlen is not None and len(entries) > maxlen:
+            del entries[: len(entries) - maxlen]
+        return self._bulk(b"%d-%d" % new)
+
+    def _cmd_xlen(self, args):
+        return b":%d\r\n" % len(self._streams.get(args[0], []))
+
+    def _cmd_xdel(self, args):
+        entries = self._streams.get(args[0], [])
+        want = set()
+        for raw in args[1:]:
+            ms, _, n = raw.partition(b"-")
+            want.add((int(ms), int(n or 0)))
+        before = len(entries)
+        entries[:] = [e for e in entries if e[0] not in want]
+        return b":%d\r\n" % (before - len(entries))
+
+    def _cmd_xinfo(self, args):
+        if args[0].upper() != b"STREAM":
+            return b"-ERR syntax error\r\n"
+        key = args[1]
+        if key not in self._streams:
+            return b"-ERR no such key\r\n"
+        last = self._last_stream_id.get(key, (0, 0))
+        return self._arr([
+            b"length", len(self._streams[key]),
+            b"last-generated-id", b"%d-%d" % last,
+        ])
+
+    def _cmd_xrevrange(self, args):
+        key = args[0]
+        count = None
+        if len(args) >= 5 and args[3].upper() == b"COUNT":
+            count = int(args[4])
+        entries = list(reversed(self._streams.get(key, [])))
+        if count is not None:
+            entries = entries[:count]
+        return self._arr([
+            [b"%d-%d" % eid, fields] for eid, fields in entries
+        ])
+
+    def _cmd_xrange(self, args):
+        key = args[0]
+        count = None
+        if len(args) >= 5 and args[3].upper() == b"COUNT":
+            count = int(args[4])
+        entries = self._streams.get(key, [])
+        if count is not None:
+            entries = entries[:count]
+        return self._arr([
+            [b"%d-%d" % eid, fields] for eid, fields in entries
+        ])
+
+    def _cmd_flushall(self, _args):
+        self._strings.clear()
+        self._hashes.clear()
+        self._streams.clear()
+        self._last_stream_id.clear()
+        return b"+OK\r\n"

@@ -1,0 +1,3 @@
+from .build import build_library
+
+__all__ = ["build_library"]

@@ -1,0 +1,15 @@
+"""Compile-on-demand build of the native bus library (see utils/cbuild.py)."""
+
+from __future__ import annotations
+
+import os
+
+from ...utils.cbuild import build_library as _build
+
+_SRC = os.path.join(os.path.dirname(__file__), "vepbus.cpp")
+
+
+def build_library() -> str:
+    """Return the path to the compiled libvepbus shared object, building it
+    if needed. Raises RuntimeError (with compiler output) on build failure."""
+    return _build(_SRC, "vepbus")

@@ -1,0 +1,137 @@
+"""Minimal RESP2 (Redis wire protocol) client.
+
+redis-py is not in this image, and the Redis bus backend only needs a dozen
+commands — so the wire protocol is spoken directly. RESP2 is tiny: a
+command is an array of bulk strings; replies are simple strings (+), errors
+(-), integers (:), bulk strings ($, binary-safe) and arrays (*, nested).
+Works against any real Redis server and against tests' in-proc
+``miniredis``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import List, Optional, Union
+
+Reply = Union[None, int, bytes, str, list]
+
+
+class RespError(Exception):
+    """Server returned a RESP error reply."""
+
+
+class RespClient:
+    """One socket, one lock: commands are request/response and the bus
+    serializes callers (same stance as the shm bus's consumer lock).
+
+    A socket error mid-command leaves the stream desynced (a partial reply
+    may sit in the buffer), so any failure drops the connection, clears the
+    buffer, reconnects, and retries the command once — the resync the
+    reference gets from go-redis/redis-py's connection pools. The retry can
+    double-apply a non-idempotent command (an XADD that executed before the
+    link died) — benign under latest-wins frame semantics."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 timeout_s: float = 5.0):
+        self._host, self._port, self._timeout = host, port, timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+
+    @classmethod
+    def from_addr(cls, addr: str, timeout_s: float = 5.0) -> "RespClient":
+        host, _, port = addr.rpartition(":")
+        if not host:  # "host" with no port, or ":6379"
+            host, port = (port, "") if not port.isdigit() else ("", port)
+        return cls(host or "127.0.0.1", int(port or 6379), timeout_s)
+
+    # -- wire --
+
+    def _read_until(self, marker: bytes = b"\r\n") -> bytes:
+        while marker not in self._buf:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self._buf += chunk
+        line, self._buf = self._buf.split(marker, 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_reply(self) -> Reply:
+        line = self._read_until()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = self._read_exact(n)
+            self._read_exact(2)  # trailing \r\n
+            return data
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise RespError(f"unexpected reply type {line[:1]!r}")
+
+    def command(self, *parts: Union[str, bytes, int]) -> Reply:
+        enc: List[bytes] = []
+        for p in parts:
+            if isinstance(p, bytes):
+                enc.append(p)
+            else:
+                enc.append(str(p).encode())
+        msg = b"*%d\r\n" % len(enc) + b"".join(
+            b"$%d\r\n%s\r\n" % (len(p), p) for p in enc
+        )
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    self._sock.sendall(msg)
+                    return self._read_reply()
+                except (OSError, ConnectionError):
+                    # Desynced or dead link: never reuse the buffer/socket.
+                    self.close()
+                    if attempt:
+                        raise
+            raise ConnectionError("unreachable")  # pragma: no cover
+
+    # -- convenience --
+
+    def command_str(self, *parts) -> Optional[str]:
+        out = self.command(*parts)
+        return out.decode() if isinstance(out, bytes) else out
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self._buf = b""

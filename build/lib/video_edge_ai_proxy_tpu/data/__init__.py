@@ -1,0 +1,5 @@
+"""Training data pipeline: archived edge footage -> device batches."""
+
+from .segments import Loader, SegmentDataset, SegmentRef, read_segment, scan_archive
+
+__all__ = ["Loader", "SegmentDataset", "SegmentRef", "read_segment", "scan_archive"]

@@ -1,0 +1,10 @@
+"""TPU inference engine: batch collector + jitted inference runner
+(SURVEY.md §7 'the new heart'; BASELINE.json north star)."""
+
+from .collector import BatchGroup, Collector, pad_to_bucket
+from .runner import InferenceEngine, StreamStats
+
+__all__ = [
+    "BatchGroup", "Collector", "pad_to_bucket",
+    "InferenceEngine", "StreamStats",
+]

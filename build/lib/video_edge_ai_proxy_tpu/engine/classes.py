@@ -1,0 +1,28 @@
+"""Default label maps for wire results (Detection.class_name).
+
+COCO-80 for detectors; classifier families ship logits only (1000-way
+ImageNet / 400-way Kinetics ids are emitted numerically — the label file is
+a deployment artifact, not framework code).
+"""
+
+COCO80 = (
+    "person", "bicycle", "car", "motorcycle", "airplane", "bus", "train",
+    "truck", "boat", "traffic light", "fire hydrant", "stop sign",
+    "parking meter", "bench", "bird", "cat", "dog", "horse", "sheep", "cow",
+    "elephant", "bear", "zebra", "giraffe", "backpack", "umbrella", "handbag",
+    "tie", "suitcase", "frisbee", "skis", "snowboard", "sports ball", "kite",
+    "baseball bat", "baseball glove", "skateboard", "surfboard",
+    "tennis racket", "bottle", "wine glass", "cup", "fork", "knife", "spoon",
+    "bowl", "banana", "apple", "sandwich", "orange", "broccoli", "carrot",
+    "hot dog", "pizza", "donut", "cake", "chair", "couch", "potted plant",
+    "bed", "dining table", "toilet", "tv", "laptop", "mouse", "remote",
+    "keyboard", "cell phone", "microwave", "oven", "toaster", "sink",
+    "refrigerator", "book", "clock", "vase", "scissors", "teddy bear",
+    "hair drier", "toothbrush",
+)
+
+
+def class_name(class_id: int, num_classes: int) -> str:
+    if num_classes == len(COCO80) and 0 <= class_id < len(COCO80):
+        return COCO80[class_id]
+    return str(class_id)

@@ -1,0 +1,161 @@
+"""Batch collector: N camera streams → padded device batches per tick.
+
+This is the fan-in point (SURVEY.md §2.3 P3): where the reference left each
+ML client to read one Redis stream at a time
+(`/root/reference/server/grpcapi/grpc_api.go:187-229`), the collector walks
+every active ring each tick, takes the newest unseen frame per stream
+(latest-wins, depth-1 semantics preserved), groups frames by source
+geometry, and pads each group to a bucketed batch size so XLA sees a small
+closed set of shapes (SURVEY.md §7 hard part 1 — no recompilation storms).
+
+Video models get clip assembly: a per-stream sliding window of the last
+``clip_len`` frames (the temporal axis is just a leading axis, SURVEY.md
+§5.7).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..bus.interface import FrameBus, FrameMeta
+
+
+@dataclass
+class BatchGroup:
+    """One shape-homogeneous device batch (before padding)."""
+
+    src_hw: tuple            # (H, W) of the source frames
+    device_ids: List[str]
+    frames: np.ndarray       # [N, H, W, C] u8, or [N, T, H, W, C] for clips
+    metas: List[FrameMeta]
+    bucket: int = 0          # padded batch size chosen by pad_to_bucket
+    model: str = ""          # registry model these streams run (engine key)
+
+
+def pad_to_bucket(group: BatchGroup, buckets: Sequence[int]) -> BatchGroup:
+    """Zero-pad the batch dim to the smallest bucket >= N. Oversized batches
+    are the caller's job (Collector.collect chunks to max bucket)."""
+    n = group.frames.shape[0]
+    bucket = next((b for b in sorted(buckets) if b >= n), None)
+    if bucket is None:
+        raise ValueError(f"batch {n} exceeds max bucket {max(buckets)}")
+    if bucket != n:
+        pad = np.zeros((bucket - n,) + group.frames.shape[1:], group.frames.dtype)
+        group.frames = np.concatenate([group.frames, pad], axis=0)
+    group.bucket = bucket
+    return group
+
+
+class Collector:
+    """Tracks per-stream cursors and assembles per-tick batches."""
+
+    def __init__(
+        self,
+        bus: FrameBus,
+        *,
+        buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
+        clip_len: int = 0,
+        active_window_s: float = 10.0,
+        model_of: Optional[callable] = None,   # device_id -> (model, clip_len)
+        default_model: str = "",
+    ):
+        self._bus = bus
+        self._buckets = tuple(sorted(buckets))
+        self._clip_len = clip_len
+        self._active_window_s = active_window_s
+        self._model_of = model_of
+        self._default_model = default_model
+        self._cursors: Dict[str, int] = {}
+        self._clips: Dict[str, deque] = {}
+        self._only: Optional[set] = None   # restrict to these ids (None = all)
+
+    def _stream_model(self, device_id: str):
+        """(model name, clip_len) for one stream — per-stream override via
+        the resolver (StreamProcess.inference_model), else engine default."""
+        if self._model_of is not None:
+            resolved = self._model_of(device_id)
+            if resolved:
+                return resolved
+        return self._default_model, self._clip_len
+
+    def restrict(self, device_ids: Optional[Sequence[str]]) -> None:
+        self._only = set(device_ids) if device_ids else None
+
+    def active_streams(self) -> List[str]:
+        ids = self._bus.streams()
+        if self._only is not None:
+            ids = [d for d in ids if d in self._only]
+        return sorted(ids)
+
+    def keep_streams_hot(self, now_ms: Optional[int] = None) -> List[str]:
+        """The engine is a frame consumer like any gRPC client: touching
+        ``last_query`` keeps the ingest workers' lazy-decode gate open
+        (reference semantics, ``python/rtsp_to_rtmp.py:144-145``).
+        Returns the ids it touched so the caller's tick can reuse the
+        enumeration instead of re-listing the bus."""
+        ids = self.active_streams()
+        for device_id in ids:
+            self._bus.touch_query(device_id, now_ms)
+        return ids
+
+    def _take_new_frames(self):
+        out = []
+        for device_id in self.active_streams():
+            frame = self._bus.read_latest(
+                device_id, min_seq=self._cursors.get(device_id, 0)
+            )
+            if frame is None:
+                continue
+            self._cursors[device_id] = frame.seq
+            out.append((device_id, frame))
+        return out
+
+    def collect(self) -> List[BatchGroup]:
+        """One tick: newest unseen frame per stream -> (model, shape)-
+        grouped, bucket-padded batches (clips for video models)."""
+        fresh = self._take_new_frames()
+        by_key: Dict[tuple, list] = {}
+
+        for device_id, frame in fresh:
+            model, clip_len = self._stream_model(device_id)
+            hw = frame.data.shape[:2]
+            if clip_len:
+                window = self._clips.get(device_id)
+                if window is None or window.maxlen != clip_len:
+                    # (Re)create on clip-length change — a re-added stream
+                    # with a different model must not inherit a stale window.
+                    window = deque(maxlen=clip_len)
+                    self._clips[device_id] = window
+                window.append(frame)
+                if len(window) < clip_len:
+                    continue
+                sample = np.stack([f.data for f in window])
+            else:
+                sample = frame.data
+            by_key.setdefault((model, hw), []).append(
+                (device_id, sample, frame.meta)
+            )
+
+        groups: List[BatchGroup] = []
+        max_bucket = self._buckets[-1]
+        for (model, hw), items in sorted(by_key.items()):
+            for start in range(0, len(items), max_bucket):
+                chunk = items[start:start + max_bucket]
+                group = BatchGroup(
+                    src_hw=hw,
+                    device_ids=[d for d, _, _ in chunk],
+                    frames=np.stack([a for _, a, _ in chunk]),
+                    metas=[m for _, _, m in chunk],
+                    model=model,
+                )
+                groups.append(pad_to_bucket(group, self._buckets))
+        return groups
+
+    def drop_stream(self, device_id: str) -> None:
+        self._cursors.pop(device_id, None)
+        self._clips.pop(device_id, None)

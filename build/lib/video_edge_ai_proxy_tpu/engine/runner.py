@@ -1,0 +1,772 @@
+"""TPU inference engine: the plane the reference doesn't have.
+
+The reference ships raw BGR24 frames to external CPU clients and calls it a
+day (`/root/reference/README.md:5-27`); results only re-enter the system if
+the client pushes `Annotate` events. This engine closes that loop on-device
+(BASELINE.json north star): collector output crosses PCIe as uint8, and one
+jitted program per (bucket, source-geometry) does preprocess → forward →
+postprocess (Pallas NMS for detectors) on the TPU. Results fan out to
+
+- gRPC `Inference` subscribers (serve/grpc_api.py), and
+- the annotation uplink queue, as the same `AnnotateRequest` protos an
+  external ML client would have sent — so the reference's cloud pipeline
+  (`examples/annotation.py` shape) keeps working with zero client code.
+
+Latency pipeline: JAX dispatch is async — each tick submits the new batch
+before draining the previous one, so H2D/compute/D2H overlap across ticks
+(double buffering, SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..bus.interface import FrameBus, FrameMeta
+from ..ops.nms import batched_nms
+from ..ops.preprocess import (
+    preprocess_classify, preprocess_clip, preprocess_letterbox,
+    unletterbox_boxes,
+)
+from ..proto import pb
+from ..utils.config import EngineConfig
+from ..utils.logging import get_logger
+from .classes import class_name
+from .collector import BatchGroup, Collector
+
+log = get_logger("engine.runner")
+
+TOP_K_CLASSES = 5
+
+
+def build_serving_step(model, spec):
+    """The per-tick device program for one model kind: uint8 frames in,
+    postprocessed results out. SINGLE source of truth — the engine compiles
+    it per (geometry, bucket), bench.py times it, __graft_entry__ exposes
+    it, so all three always run the identical program."""
+    import jax
+
+    size = spec.input_size
+
+    if spec.kind == "detect":
+        def raw(variables, frames_u8):
+            x, lb = preprocess_letterbox(frames_u8, size)
+            # decode="serving" (models/yolov8.py): class reduction happens
+            # in logit space inside the model; sigmoid is monotone, so
+            # applying it to the per-anchor winners here gives the same
+            # scores as decode=True's full sigmoid at a fraction of the
+            # elementwise work.
+            boxes, max_logit, cls_ids = model.apply(
+                variables, x, decode="serving"
+            )
+            b, s, c, valid = batched_nms(
+                boxes, jax.nn.sigmoid(max_logit), cls_ids
+            )
+            b = unletterbox_boxes(b, lb)
+            return {"boxes": b, "scores": s, "classes": c, "valid": valid}
+    elif spec.kind == "embed":
+        def raw(variables, frames_u8):
+            x = preprocess_classify(frames_u8, (size, size))
+            emb = model.apply(variables, x, features_only=True)
+            return {"embedding": emb}
+    else:  # classify | video
+        pre = preprocess_clip if spec.clip_len else preprocess_classify
+
+        def raw(variables, frames_u8):
+            import jax.numpy as jnp
+
+            x = pre(frames_u8, (size, size))
+            logits = model.apply(variables, x)
+            probs = jax.nn.softmax(logits, axis=-1)
+            top_p, top_i = jax.lax.top_k(
+                probs, min(TOP_K_CLASSES, probs.shape[-1])
+            )
+            return {"top_probs": top_p, "top_ids": top_i.astype(jnp.int32)}
+
+    return raw
+
+
+@dataclass
+class StreamStats:
+    frames: int = 0
+    last_latency_ms: float = 0.0
+    ema_latency_ms: float = 0.0
+    last_batch: int = 0
+
+
+@dataclass
+class _Inflight:
+    """A dispatched (not yet drained) device batch."""
+
+    group: BatchGroup
+    outputs: Any              # tree of jax.Arrays (async)
+    t_submit: float
+
+
+class InferenceEngine:
+    """Owns the model, the compiled step cache, and the engine thread."""
+
+    # Tracker GC debounce: longer than any worker-restart ring re-create
+    # gap, far shorter than "stream is really gone" timescales.
+    _TRACKER_GC_GRACE_S = 10.0
+
+    def __init__(
+        self,
+        bus: FrameBus,
+        cfg: Optional[EngineConfig] = None,
+        *,
+        annotations=None,                    # AnnotationQueue or None
+        spec=None,                           # ModelSpec override (tests)
+        model_resolver=None,                 # device_id -> model name or ""
+    ):
+        self._bus = bus
+        self._cfg = cfg or EngineConfig()
+        self._annotations = annotations
+        self._spec = spec
+        self._model = None
+        self._variables = None
+        self._mesh = None
+        # Per-stream model selection (StreamProcess.inference_model): other
+        # registry models load lazily on first use; name -> (spec, model,
+        # variables). The default model also lives here under its name.
+        self._model_resolver = model_resolver
+        self._models: Dict[str, tuple] = {}
+        self._bad_models: set = set()
+        self._step_cache: Dict[tuple, Any] = {}
+        self._collector: Optional[Collector] = None
+        self._subscribers: List[tuple] = []   # (queue, device_id filter set|None)
+        self._sub_lock = threading.Lock()
+        self._stats: Dict[str, StreamStats] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._profiling = False
+        self._profile_lock = threading.Lock()
+        self.ticks = 0
+        self.batches = 0
+        self.last_tick_monotonic = 0.0
+        self._trackers: Dict[str, Any] = {}      # device_id -> IoUTracker
+        self._tracker_absent: Dict[str, float] = {}  # id -> absent-since
+        self._probe_cache: tuple = (0.0, None)   # (monotonic, ok | None)
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_spawn_lock = threading.Lock()
+        self._probe_fn = None                    # jitted once, reused
+
+    # -- lifecycle --
+
+    def warmup(self) -> None:
+        """Build model + params and compile nothing yet (steps compile per
+        observed shape; call `compile_for` to prewarm a given geometry)."""
+        import jax
+
+        from ..models import registry
+
+        if self._cfg.compile_cache_dir:
+            # Persistent XLA compile cache: a restarted server re-loads
+            # compiled programs instead of paying tens of seconds to
+            # minutes per (geometry, bucket) again (SURVEY.md §5.4).
+            jax.config.update(
+                "jax_compilation_cache_dir", self._cfg.compile_cache_dir
+            )
+            if jax.config.jax_persistent_cache_min_compile_time_secs == 1.0:
+                # Lower the jax-default persistence threshold so mid-size
+                # serving programs cache too — but never clobber a value
+                # the operator set (env/config before boot).
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.5
+                )
+            try:
+                # The cache object binds its directory on first use; if
+                # anything compiled before warmup (another engine, a
+                # preloaded model), the config change alone is ignored.
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc,
+                )
+
+                _cc.reset_cache()
+            except Exception:
+                log.warning(
+                    "could not reset the XLA compilation cache; programs "
+                    "compiled before warmup may persist elsewhere",
+                    exc_info=True,
+                )
+        if self._spec is None:
+            self._spec = registry.get(self._cfg.model)
+        self._model, self._variables = self._spec.init_params(
+            jax.random.PRNGKey(0)
+        )
+        ckpt = self._cfg.checkpoint_path
+        if ckpt:
+            from ..utils.checkpoint import load_msgpack
+
+            if os.path.exists(ckpt):
+                self._variables = jax.device_put(
+                    load_msgpack(ckpt, jax.tree.map(np.asarray, self._variables))
+                )
+                log.info("loaded engine params from %s", ckpt)
+            else:
+                log.warning("checkpoint %s missing; using random init", ckpt)
+        self._variables = self._maybe_quantize(self._variables)
+        buckets = tuple(self._cfg.batch_buckets)
+        if self._cfg.mesh:
+            # Multi-chip serving: batch axis sharded over dp, params
+            # replicated (inference weights are small; fsdp-style sharding
+            # belongs to training). Buckets must divide evenly across dp so
+            # every chip gets identical static shapes.
+            from ..parallel import factor_mesh, make_mesh, replicated
+
+            if isinstance(self._cfg.mesh, str):
+                if self._cfg.mesh != "auto":
+                    raise ValueError(
+                        f"engine.mesh: unknown value {self._cfg.mesh!r} — "
+                        "use 'auto', an axis dict like {'dp': 4}, or empty "
+                        "for single-chip"
+                    )
+                # Serving profile: every visible device on the batch axis.
+                self._mesh = factor_mesh(prefer=("dp",))
+            else:
+                n_need = 1
+                for v in self._cfg.mesh.values():
+                    n_need *= v
+                self._mesh = make_mesh(
+                    **self._cfg.mesh, devices=jax.devices()[:n_need]
+                )
+            dp = self._mesh.shape["dp"]
+            buckets = tuple(b for b in buckets if b % dp == 0) or (dp,)
+            self._variables = jax.device_put(
+                self._variables, replicated(self._mesh)
+            )
+            log.info(
+                "engine mesh: %s (buckets -> %s)",
+                dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
+                buckets,
+            )
+        self._models[self._spec.name] = (self._spec, self._model, self._variables)
+        self._buckets = buckets   # effective (mesh-filtered) buckets
+        self._collector = Collector(
+            self._bus,
+            buckets=buckets,
+            clip_len=self._spec.clip_len,
+            active_window_s=self._cfg.active_window_s,
+            model_of=self._stream_model,
+            default_model=self._spec.name,
+        )
+        log.info(
+            "engine ready: model=%s kind=%s input=%d backend=%s",
+            self._spec.name, self._spec.kind, self._spec.input_size,
+            jax.default_backend(),
+        )
+
+    def _maybe_quantize(self, variables):
+        """cfg.quantize="int8": weight-only PTQ (models/quantize.py) — int8
+        device/checkpoint residency, dequantize fused into the jitted step.
+        No calibration data needed, so it is safe at engine boot."""
+        if not self._cfg.quantize:
+            return variables
+        if self._cfg.quantize != "int8":
+            raise ValueError(
+                f"engine.quantize={self._cfg.quantize!r} unsupported "
+                "(only 'int8' weight-only quantization exists)"
+            )
+        from ..models.quantize import quantize_tree, quantized_nbytes, tree_nbytes
+
+        before = tree_nbytes(variables)
+        qt = quantize_tree(variables)
+        log.info(
+            "engine params quantized int8 (weight-only): %.1f MB -> %.1f MB",
+            before / 1e6, quantized_nbytes(qt) / 1e6,
+        )
+        return qt
+
+    def _ensure_model(self, name: str):
+        """(spec, model, variables) for a registry model, lazily built.
+        Only the default model reads cfg.checkpoint_path; per-stream extras
+        start from init (their checkpoints belong to a later config)."""
+        entry = self._models.get(name)
+        if entry is None:
+            import jax
+
+            from ..models import registry
+
+            spec = registry.get(name)
+            model, variables = spec.init_params(jax.random.PRNGKey(0))
+            variables = self._maybe_quantize(variables)
+            if self._mesh is not None:
+                from ..parallel import replicated
+
+                variables = jax.device_put(variables, replicated(self._mesh))
+            entry = (spec, model, variables)
+            self._models[name] = entry
+            log.info("engine loaded extra model '%s' (kind=%s)", name, spec.kind)
+        return entry
+
+    def _stream_model(self, device_id: str):
+        """Collector resolver: (model name, clip_len) or None for default."""
+        if self._model_resolver is None:
+            return None
+        name = self._model_resolver(device_id)
+        if not name or name == self._spec.name:
+            return None
+        if name in self._bad_models:
+            return None
+        try:
+            spec, _, _ = self._ensure_model(name)
+        except Exception:
+            # Unknown name OR a model that fails to build (OOM, bug): either
+            # way confine the damage to this stream's model choice — a
+            # per-tick re-attempt of a failing multi-second init would
+            # starve every healthy stream.
+            log.exception(
+                "stream %s model '%s' unavailable; using default",
+                device_id, name,
+            )
+            self._bad_models.add(name)
+            return None
+        return name, spec.clip_len
+
+    # -- profiling (SURVEY.md §5.1: the reference has no tracing at all) --
+
+    def start_profile(self, log_dir: str) -> None:
+        """Begin a jax.profiler trace (view with TensorBoard/XProf)."""
+        import jax
+
+        with self._profile_lock:
+            if self._profiling:
+                raise RuntimeError("profiler already running")
+            jax.profiler.start_trace(log_dir)
+            self._profiling = True
+        log.info("profiler tracing to %s", log_dir)
+
+    def stop_profile(self) -> None:
+        import jax
+
+        with self._profile_lock:
+            if not self._profiling:
+                raise RuntimeError("profiler not running")
+            # stop_trace flushes to disk and can raise (e.g. unwritable
+            # log_dir); jax's session is torn down either way, so clear the
+            # flag first or the profiler API wedges until restart.
+            self._profiling = False
+            jax.profiler.stop_trace()
+        log.info("profiler trace stopped")
+
+    def save_checkpoint(self, path: Optional[str] = None) -> str:
+        """Persist current params (msgpack, atomic)."""
+        import jax
+
+        from ..utils.checkpoint import save_msgpack
+
+        if self._variables is None:
+            raise RuntimeError(
+                "save_checkpoint before warmup would overwrite the "
+                "checkpoint with empty params; call warmup() first"
+            )
+        path = path or self._cfg.checkpoint_path
+        if not path:
+            raise ValueError("no checkpoint path configured")
+        variables = self._variables
+        if self._cfg.quantize:
+            # Checkpoints stay full-precision (the canonical format every
+            # load path expects); quantization re-applies at next warmup.
+            # The exact pre-quantization weights are gone, so this write is
+            # LOSSY relative to whatever the engine originally loaded —
+            # overwriting a trained f32 checkpoint bakes in up to
+            # absmax/254 per-element error. Warn, don't silently clobber.
+            from ..models.quantize import dequantize_tree
+
+            log.warning(
+                "save_checkpoint from a quantized engine writes int8-"
+                "roundtripped weights (lossy vs the originally loaded "
+                "params); keep a copy of the source checkpoint"
+            )
+            variables = dequantize_tree(variables)
+        save_msgpack(path, jax.tree.map(np.asarray, variables))
+        return path
+
+    def start(self) -> None:
+        if self._model is None:
+            self.warmup()
+        for geom in self._cfg.prewarm:
+            # Log-and-continue like every other per-item path here: a bad
+            # prewarm entry must not abort server boot, and buckets must be
+            # ones the collector can actually dispatch (post mesh filter).
+            try:
+                h, w, bucket = (int(v) for v in geom)
+                if bucket not in self._buckets:
+                    log.warning(
+                        "prewarm bucket %d not in effective buckets %s; "
+                        "skipping", bucket, self._buckets,
+                    )
+                    continue
+                log.info("prewarming program for %dx%d bucket=%d", h, w, bucket)
+                self.compile_for((h, w), bucket)
+            except Exception:
+                log.exception("prewarm entry %r failed; continuing", geom)
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        with self._sub_lock:
+            for q, _ in self._subscribers:
+                q.put(None)
+            self._subscribers.clear()
+
+    # -- results fan-out --
+
+    def subscribe(self, device_ids=None, context=None, timeout: float = 0.5):
+        """Blocking iterator of pb.InferenceResult for gRPC serving."""
+        q: queue.Queue = queue.Queue(maxsize=256)
+        ids = set(device_ids) if device_ids else None
+        with self._sub_lock:
+            self._subscribers.append((q, ids))
+        try:
+            while not self._stop.is_set():
+                if context is not None and not context.is_active():
+                    return
+                try:
+                    item = q.get(timeout=timeout)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    return
+                yield item
+        finally:
+            with self._sub_lock:
+                self._subscribers = [
+                    (sq, si) for sq, si in self._subscribers if sq is not q
+                ]
+
+    def stats(self) -> Dict[str, StreamStats]:
+        return dict(self._stats)
+
+    def _run_probe(self) -> None:
+        """Device round-trip on a dedicated thread; writes the cache when
+        (if) the runtime answers."""
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            if self._probe_fn is None:
+                self._probe_fn = jax.jit(jnp.add)
+            ok = int(self._probe_fn(jnp.int32(1), jnp.int32(1))) == 2
+        except Exception:
+            log.exception("device health probe failed")
+            ok = False
+        self._probe_cache = (time.monotonic(), ok)
+
+    def health(self, probe_ttl_s: float = 5.0,
+               probe_wait_s: float = 2.0) -> dict:
+        """TPU-side health (SURVEY.md §5.3 — the rebuild adds device
+        liveness and compile-cache warmth on top of the reference's
+        container-level health): engine-thread liveness, last-tick age, a
+        round-trip device probe, and how many programs are compiled.
+
+        The probe (a tiny jitted add) runs on ONE dedicated thread and its
+        result is cached ``probe_ttl_s`` — a wedged runtime must neither
+        leak a new blocked thread per poll nor hang the caller, so polls
+        wait at most ``probe_wait_s`` and a probe that cannot answer by
+        then reports ``device_ok=False`` until it does.
+
+        ``stale`` compares the last completed tick against
+        cfg.health_stale_after_s, which must stay larger than any
+        legitimate in-tick XLA compile (first frame of a new geometry
+        compiles inside the tick; see cfg.prewarm to move that to boot) —
+        it flags a wedged loop, not a busy one.
+        """
+        import jax
+
+        alive = self._thread is not None and self._thread.is_alive()
+        now = time.monotonic()
+        age = (now - self.last_tick_monotonic) if self.last_tick_monotonic else None
+        with self._probe_spawn_lock:
+            # Check-then-spawn under a lock, inputs re-read inside it:
+            # concurrent /healthz polls must not each start a probe thread
+            # (one would become untracked), and a poll that waited on the
+            # lock must see the probe the winner's thread just completed.
+            now = time.monotonic()
+            ts, ok = self._probe_cache
+            if (ok is None or now - ts > probe_ttl_s) and (
+                self._probe_thread is None or not self._probe_thread.is_alive()
+            ):
+                self._probe_thread = threading.Thread(
+                    target=self._run_probe, name="tpu-health-probe", daemon=True
+                )
+                self._probe_thread.start()
+        if self._probe_thread is not None and self._probe_thread.is_alive():
+            self._probe_thread.join(timeout=probe_wait_s)
+        _, ok = self._probe_cache
+        if self._probe_thread is not None and self._probe_thread.is_alive():
+            # Probe outstanding past its wait budget: the runtime is not
+            # answering. A stale cached success must not mask that — report
+            # unhealthy until the probe actually returns.
+            ok = False
+        stale_after = self._cfg.health_stale_after_s
+        stale = age is not None and age > stale_after
+        return {
+            "healthy": bool(alive and ok and not stale),
+            "engine_thread_alive": alive,
+            "tick_age_s": round(age, 3) if age is not None else None,
+            "tick_stale": stale,
+            "device_ok": bool(ok),
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "programs_compiled": len(self._step_cache),
+            "model": self._spec.name if self._spec else None,
+            "ticks": self.ticks,
+        }
+
+    # -- compiled step construction --
+
+    def compile_for(self, src_hw: tuple, bucket: int) -> None:
+        """Prewarm the program for one (source geometry, bucket)."""
+        shape = (bucket,) + (
+            (self._spec.clip_len,) if self._spec.clip_len else ()
+        ) + tuple(src_hw) + (3,)
+        self._step(src_hw, bucket)(
+            self._variables, self._place(np.zeros(shape, np.uint8))
+        )
+
+    def _place(self, frames: np.ndarray):
+        """Shard the batch dim over dp when serving on a mesh; pass through
+        numpy (implicit single-device transfer) otherwise."""
+        if self._mesh is None:
+            return frames
+        import jax
+
+        from ..parallel import batch_sharding
+
+        return jax.device_put(frames, batch_sharding(self._mesh, frames.ndim))
+
+    def _step(self, src_hw: tuple, bucket: int, model: Optional[str] = None):
+        model = model or self._spec.name
+        key = (model, src_hw, bucket)
+        fn = self._step_cache.get(key)
+        if fn is None:
+            import jax
+
+            spec, mod, _ = self._ensure_model(model)
+            raw = build_serving_step(mod, spec)
+            if self._cfg.quantize:
+                from ..models.quantize import dequantize_tree
+
+                base = raw
+
+                def raw(qv, frames_u8, _base=base):
+                    # Dequantize inside the program: XLA fuses int8*scale
+                    # into each weight's first consumer, HBM stays int8.
+                    return _base(dequantize_tree(qv), frames_u8)
+            fn = jax.jit(raw)
+            self._step_cache[key] = fn
+        return fn
+
+    # -- engine loop --
+
+    def _run(self) -> None:
+        tick_s = self._cfg.tick_ms / 1000.0
+        inflight: Optional[_Inflight] = None
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            # The loop must outlive any single bad batch: a dead engine
+            # thread would leave subscribers blocked forever (same
+            # log-and-keep-going stance as the reference's worker loops,
+            # rtsp_to_rtmp.py:186-187).
+            try:
+                active_ids = self._collector.keep_streams_hot()
+                groups = self._collector.collect()
+                submitted: List[_Inflight] = []
+                for group in groups:
+                    step = self._step(group.src_hw, group.bucket, group.model)
+                    _, _, variables = self._ensure_model(
+                        group.model or self._spec.name
+                    )
+                    outputs = step(variables, self._place(group.frames))
+                    submitted.append(_Inflight(group, outputs, time.time()))
+                    self.batches += 1
+                # Drain the PREVIOUS tick's work while this tick's runs.
+                if inflight is not None:
+                    self._emit(inflight)
+                for extra in submitted[:-1]:
+                    self._emit(extra)
+                inflight = submitted[-1] if submitted else None
+                # Scope per-stream tracker state to streams that still
+                # exist: a long-lived engine with churning device_ids must
+                # not accumulate IoUTracker entries forever. Absence is
+                # debounced (grace period) because a restarting worker
+                # re-creates its ring unlink-then-create — one sample in
+                # that window must not reset the stream's track-id
+                # numbering (invariant in _assign_tracks).
+                if self._trackers:
+                    now = time.monotonic()
+                    active = set(active_ids)
+                    for d in list(self._trackers):
+                        if d in active:
+                            self._tracker_absent.pop(d, None)
+                            continue
+                        since = self._tracker_absent.setdefault(d, now)
+                        if now - since > self._TRACKER_GC_GRACE_S:
+                            del self._trackers[d]
+                            del self._tracker_absent[d]
+            except Exception:
+                log.exception("engine tick failed; continuing")
+                inflight = None
+            self.ticks += 1
+            self.last_tick_monotonic = time.monotonic()
+            elapsed = time.monotonic() - t0
+            if elapsed < tick_s:
+                self._stop.wait(tick_s - elapsed)
+        if inflight is not None:
+            try:
+                self._emit(inflight)
+            except Exception:
+                log.exception("final drain failed")
+
+    # -- result emission --
+
+    def _emit(self, inflight: _Inflight) -> None:
+        group = inflight.group
+        spec = self._models[group.model or self._spec.name][0]
+        host = {k: np.asarray(v) for k, v in inflight.outputs.items()}  # D2H
+        now_ms = int(time.time() * 1000)
+        for i, device_id in enumerate(group.device_ids):
+            meta = group.metas[i]
+            detections = self._to_detections(host, i, spec)
+            if self._cfg.track and spec.kind == "detect":
+                # Unconditionally — empty frames MUST reach the tracker so
+                # misses accumulate and stale tracks expire; skipping them
+                # would freeze old tracks and hand their ids to the next
+                # object that appears nearby.
+                self._assign_tracks(device_id, spec.name, detections)
+            latency = max(0.0, now_ms - meta.timestamp_ms) if meta.timestamp_ms else 0.0
+            result = pb.InferenceResult(
+                device_id=device_id,
+                timestamp=meta.timestamp_ms,
+                model=spec.name,
+                model_version="0",
+                detections=detections,
+                latency_ms=latency,
+                batch_size=group.bucket,
+                frame_packet=meta.packet,
+            )
+            self._publish(result)
+            self._annotate(device_id, meta, detections, spec)
+            st = self._stats.setdefault(device_id, StreamStats())
+            st.frames += 1
+            st.last_latency_ms = latency
+            st.ema_latency_ms = (
+                latency if st.ema_latency_ms == 0.0
+                else 0.9 * st.ema_latency_ms + 0.1 * latency
+            )
+            st.last_batch = group.bucket
+
+    def _assign_tracks(self, device_id: str, model: str, detections) -> None:
+        """Per-stream SORT-style association (engine/tracker.py): fills
+        Detection.track_id, which `_annotate` forwards as the reference's
+        AnnotateRequest.object_tracking_id — the field the reference leaves
+        to external ML clients. The tracker resets when the stream's model
+        changes: class_ids from different models are different label
+        vocabularies, so tracks must never continue across a switch."""
+        from .tracker import IoUTracker
+
+        entry = self._trackers.get(device_id)
+        if entry is None or entry[0] != model:
+            # Ids stay unique within the stream across resets: the fresh
+            # tracker continues numbering where the old one stopped.
+            first = entry[1].next_id if entry else 1
+            entry = (model, IoUTracker(next_id=first))
+            self._trackers[device_id] = entry
+        tracker = entry[1]
+        boxes = [
+            (d.box.left, d.box.top, d.box.left + d.box.width,
+             d.box.top + d.box.height)
+            for d in detections
+        ]
+        ids = tracker.update(boxes, [d.class_id for d in detections])
+        for det, tid in zip(detections, ids):
+            det.track_id = tid
+
+    def _to_detections(self, host: dict, i: int, spec=None) -> List[pb.Detection]:
+        spec = spec or self._spec
+        out: List[pb.Detection] = []
+        if spec.kind == "detect":
+            valid = host["valid"][i]
+            for j in np.nonzero(valid)[0]:
+                # BoundingBox carries int32 pixel coords (proto parity with
+                # the reference's AnnotateRequest consumers).
+                x1, y1, x2, y2 = (int(round(float(v))) for v in host["boxes"][i, j])
+                cid = int(host["classes"][i, j])
+                out.append(pb.Detection(
+                    box=pb.BoundingBox(left=x1, top=y1, width=x2 - x1, height=y2 - y1),
+                    confidence=float(host["scores"][i, j]),
+                    class_id=cid,
+                    class_name=class_name(cid, self._num_classes(spec)),
+                ))
+        elif spec.kind == "embed":
+            out.append(pb.Detection(
+                confidence=1.0, class_id=-1,
+                embedding=[float(v) for v in host["embedding"][i]],
+            ))
+        else:
+            for p, cid in zip(host["top_probs"][i], host["top_ids"][i]):
+                out.append(pb.Detection(
+                    confidence=float(p), class_id=int(cid),
+                    class_name=class_name(int(cid), self._num_classes(spec)),
+                ))
+        return out
+
+    def _num_classes(self, spec=None) -> int:
+        spec = spec or self._spec
+        model = self._models[spec.name][1] if spec.name in self._models else self._model
+        cfg = getattr(model, "cfg", None)
+        return getattr(cfg, "num_classes", 0) if cfg is not None else 0
+
+    def _publish(self, result: pb.InferenceResult) -> None:
+        with self._sub_lock:
+            subs = list(self._subscribers)
+        for q, ids in subs:
+            if ids is not None and result.device_id not in ids:
+                continue
+            try:
+                q.put_nowait(result)
+            except queue.Full:
+                pass  # slow subscriber: latest-wins spirit, drop
+
+    def _annotate(
+        self, device_id: str, meta: FrameMeta, detections: Sequence[pb.Detection],
+        spec=None,
+    ) -> None:
+        spec = spec or self._spec
+        if self._annotations is None:
+            return
+        for det in detections:
+            if det.confidence <= 0.0:
+                continue
+            if det.class_id < 0 and not det.embedding:
+                continue
+            req = pb.AnnotateRequest(
+                device_name=device_id,
+                type="detection" if spec.kind == "detect" else spec.kind,
+                start_timestamp=meta.timestamp_ms or int(time.time() * 1000),
+                object_type=det.class_name,
+                object_tracking_id=det.track_id,
+                confidence=det.confidence,
+                object_bouding_box=det.box if det.HasField("box") else None,
+                # Re-ID feature vectors ride the proto's embedding field
+                # (AnnotateRequest.object_signature, video_streaming.proto:26)
+                object_signature=list(det.embedding),
+                ml_model=spec.name,
+                ml_model_version="0",
+                width=meta.width,
+                height=meta.height,
+                is_keyframe=meta.is_keyframe,
+            )
+            self._annotations.publish(req.SerializeToString())

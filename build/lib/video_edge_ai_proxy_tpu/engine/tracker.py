@@ -1,0 +1,144 @@
+"""Per-stream multi-object tracking for the inference plane.
+
+The wire contract always had a slot for this — ``AnnotateRequest
+.object_tracking_id`` (`/root/reference/proto/video_streaming.proto:15`) —
+but the reference expects *external* ML clients to fill it. Our engine
+produces the detections, so it can produce stable track ids too:
+a SORT-style tracker (greedy IoU association + constant-velocity
+extrapolation, no Kalman filter — at 10-30 fps per stream the linear
+motion model is the part that matters) runs host-side per stream on the
+already-fetched NMS output. Device work is untouched: tracking is O(tracks
+× detections) numpy on ≤100 boxes, microseconds next to a device batch.
+
+Association: detections and live tracks are matched greedily by IoU
+(same class only, predicted track box vs detection box). Unmatched
+detections open new tracks immediately; unmatched tracks coast on their
+velocity and are dropped after ``max_misses`` consecutive misses. Ids are
+``<stream-scoped monotonic int>`` rendered as strings for the proto field.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Sequence
+
+import numpy as np
+
+
+@dataclass
+class _Track:
+    track_id: int
+    box: np.ndarray            # xyxy, float32
+    velocity: np.ndarray       # d(box)/frame, float32[4]
+    class_id: int
+    misses: int = 0
+
+
+def _iou_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """[N,4] x [M,4] xyxy -> [N,M] IoU."""
+    if len(a) == 0 or len(b) == 0:
+        return np.zeros((len(a), len(b)), np.float32)
+    tl = np.maximum(a[:, None, :2], b[None, :, :2])
+    br = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    inter = np.prod(np.maximum(br - tl, 0.0), axis=-1)
+    area_a = np.prod(np.maximum(a[:, 2:] - a[:, :2], 0.0), axis=-1)
+    area_b = np.prod(np.maximum(b[:, 2:] - b[:, :2], 0.0), axis=-1)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return (inter / np.maximum(union, 1e-9)).astype(np.float32)
+
+
+@dataclass
+class IoUTracker:
+    """One tracker per stream (the engine keeps a dict keyed by device_id)."""
+
+    iou_thresh: float = 0.3
+    max_misses: int = 30       # frames a lost track coasts before dropping
+    # Wall-clock guard: miss counting only advances when update() runs, so
+    # a stream outage (no frames at all) would otherwise freeze tracks at
+    # misses=0 and hand an hour-old id to whatever appears near the stale
+    # box on reconnect. A gap longer than this clears all tracks (ids keep
+    # counting up — see next_id).
+    max_gap_s: float = 10.0
+    # First id this tracker issues. Stream-scoped uniqueness must survive a
+    # tracker reset (model switch), so a replacement tracker is constructed
+    # with next_id = predecessor.next_id rather than restarting at 1.
+    next_id: int = 1
+    _tracks: List[_Track] = field(default_factory=list)
+    _last_update: float = 0.0
+
+    def update(
+        self,
+        boxes: Sequence[Sequence[float]],
+        classes: Sequence[int],
+        now: float | None = None,
+    ) -> List[str]:
+        """One frame of detections -> one track id per detection, in order."""
+        now = time.monotonic() if now is None else now
+        if self._last_update and now - self._last_update > self.max_gap_s:
+            self._tracks = []
+        self._last_update = now
+        dets = np.asarray(boxes, np.float32).reshape(-1, 4)
+        cls = np.asarray(classes, np.int64).reshape(-1)
+
+        # Predict: coast every live track along its velocity.
+        for t in self._tracks:
+            t.box = t.box + t.velocity
+        pred = (
+            np.stack([t.box for t in self._tracks])
+            if self._tracks else np.zeros((0, 4), np.float32)
+        )
+        iou = _iou_matrix(pred, dets)
+        # Same-class gating: cross-class pairs can never match.
+        for ti, t in enumerate(self._tracks):
+            iou[ti, cls != t.class_id] = 0.0
+
+        assigned = [-1] * len(dets)
+        used_tracks = set()
+        # Greedy: repeatedly take the globally best remaining pair. With
+        # <=100 boxes this is exact enough that Hungarian buys nothing.
+        while iou.size:
+            ti, di = np.unravel_index(np.argmax(iou), iou.shape)
+            if iou[ti, di] < self.iou_thresh:
+                break
+            t = self._tracks[ti]
+            # t.box is the *predicted* position, so (det - t.box) is the
+            # prediction residual; adding half of it is an EMA (alpha=0.5)
+            # over measured per-frame deltas: v += 0.5*(md - v_old).
+            t.velocity = t.velocity + 0.5 * (dets[di] - t.box)
+            t.box = dets[di].copy()
+            t.misses = 0
+            assigned[di] = t.track_id
+            used_tracks.add(ti)
+            iou[ti, :] = -1.0
+            iou[:, di] = -1.0
+
+        # Unmatched detections: new tracks, id issued immediately.
+        for di in range(len(dets)):
+            if assigned[di] == -1:
+                t = _Track(
+                    track_id=self.next_id,
+                    box=dets[di].copy(),
+                    velocity=np.zeros(4, np.float32),
+                    class_id=int(cls[di]),
+                )
+                self.next_id += 1
+                self._tracks.append(t)
+                assigned[di] = t.track_id
+
+        # Unmatched tracks: count the miss, drop the stale.
+        survivors = []
+        for ti, t in enumerate(self._tracks):
+            if ti in used_tracks or t.track_id in assigned:
+                survivors.append(t)
+            else:
+                t.misses += 1
+                if t.misses <= self.max_misses:
+                    survivors.append(t)
+        self._tracks = survivors
+
+        return [str(a) for a in assigned]
+
+    @property
+    def live_tracks(self) -> int:
+        return len(self._tracks)

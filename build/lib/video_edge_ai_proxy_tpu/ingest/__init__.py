@@ -1,0 +1,14 @@
+from .archive import GopSegment, SegmentArchiver
+from .sources import OpenCVSource, SyntheticSource, VideoSource, open_source
+from .worker import IngestWorker, WorkerConfig
+
+__all__ = [
+    "GopSegment",
+    "SegmentArchiver",
+    "IngestWorker",
+    "WorkerConfig",
+    "VideoSource",
+    "SyntheticSource",
+    "OpenCVSource",
+    "open_source",
+]

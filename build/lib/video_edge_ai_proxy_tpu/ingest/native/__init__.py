@@ -1,0 +1,1 @@
+"""Native libav shim (vepav.cpp) — built on demand, bound in ingest/av.py."""

@@ -1,0 +1,313 @@
+"""Video sources: demux/decode abstraction for ingest workers.
+
+The reference worker demuxes RTSP with PyAV and decodes *lazily* — packets are
+always demuxed, pixels are only produced when a client asked recently
+(``python/rtsp_to_rtmp.py:92-160``, ``python/read_image.py:63-94``). The same
+two-phase contract is ``grab()`` (advance the stream, cheap — no pixel
+decode) and ``retrieve()`` (produce the BGR24 frame, expensive).
+
+URL routing (``open_source``): ``test://...`` -> SyntheticSource; everything
+else -> PacketSource (native libav shim: true demux-only grab, real
+``packet.is_keyframe``/pts/dts/time_base, compressed payload access for
+stream-copy archive/relay) with OpenCVSource as the fallback when the shim
+can't build on a host. Only PacketSource realizes the reference's lazy-decode
+CPU savings: cv2's ``grab()`` still runs the codec internally and its
+keyframe flags are a GOP-cadence guess (the round-1 gap).
+"""
+
+from __future__ import annotations
+
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+
+@dataclass
+class PacketInfo:
+    """Demux-level info available before any pixel decode (reference keys off
+    ``packet.is_keyframe`` at ``rtsp_to_rtmp.py:97-110``)."""
+
+    packet: int          # 0-based packet counter
+    is_keyframe: bool
+    pts: int
+    dts: int
+    timestamp_ms: int    # wall-clock at demux (reference uses wallclock PTS)
+    time_base: float
+    # Demuxer-flagged corruption, shipped through VideoFrame.is_corrupt
+    # (reference ``read_image.py:111``: vf.is_corrupt = packet.is_corrupt).
+    is_corrupt: bool = False
+
+
+class VideoSource(ABC):
+    """Two-phase source: grab (demux) then optionally retrieve (decode)."""
+
+    width: int = 0
+    height: int = 0
+    fps: float = 0.0
+    # True when grab() is demux-only AND packet_bytes()/stream_info expose
+    # the compressed payload for stream-copy archive/relay (PacketSource).
+    supports_packets: bool = False
+
+    @abstractmethod
+    def open(self) -> None:
+        """Connect. Raises ConnectionError on failure (worker exits hard so
+        the supervisor restarts it — reference ``rtsp_to_rtmp.py:76-78``)."""
+
+    @abstractmethod
+    def grab(self) -> Optional[PacketInfo]:
+        """Advance to the next packet without decoding pixels. None = EOF /
+        stream gone (worker falls into its reconnect loop,
+        reference ``rtsp_to_rtmp.py:186-187``)."""
+
+    @abstractmethod
+    def retrieve(self) -> Optional[np.ndarray]:
+        """Decode the grabbed packet to an HxWx3 uint8 BGR24 array."""
+
+    @abstractmethod
+    def close(self) -> None: ...
+
+
+class SyntheticSource(VideoSource):
+    """Deterministic moving test pattern — the synthetic packet source the
+    reference's test strategy lacks (SURVEY.md §4: "a synthetic RTSP/packet
+    source ... so the demux->decode->bus path is testable without cameras").
+
+    URL: ``test://pattern?w=1280&h=720&fps=30&gop=30&frames=0[&pace=1]``
+    ``frames=0`` = endless; ``pace=0`` runs flat-out (benchmarks).
+    """
+
+    def __init__(self, url: str):
+        q = {k: v[-1] for k, v in parse_qs(urlparse(url).query).items()}
+        self.width = int(q.get("w", 1280))
+        self.height = int(q.get("h", 720))
+        self.fps = float(q.get("fps", 30))
+        self.gop = int(q.get("gop", 30))
+        self.limit = int(q.get("frames", 0))
+        self.pace = q.get("pace", "1") not in ("0", "false")
+        self._n = -1
+        self._t0 = 0.0
+        self._open = False
+        # Pre-rendered gradient background; per-frame work happens in
+        # retrieve() to keep grab() demux-cheap.
+        yy, xx = np.mgrid[0 : self.height, 0 : self.width]
+        self._bg = ((xx * 255 // max(1, self.width - 1)) & 0xFF).astype(np.uint8)
+        self._yy = yy
+
+    def open(self) -> None:
+        self._t0 = time.monotonic()
+        self._open = True
+
+    def grab(self) -> Optional[PacketInfo]:
+        if not self._open:
+            return None
+        self._n += 1
+        if self.limit and self._n >= self.limit:
+            return None
+        if self.pace:
+            due = self._t0 + self._n / self.fps
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+        now_ms = int(time.time() * 1000)
+        pts = int(self._n * 90000 / self.fps)  # 90 kHz clock like RTP video
+        return PacketInfo(
+            packet=self._n,
+            is_keyframe=(self._n % self.gop == 0),
+            pts=pts,
+            dts=pts,
+            timestamp_ms=now_ms,
+            time_base=1.0 / 90000.0,
+        )
+
+    def retrieve(self) -> Optional[np.ndarray]:
+        n = self._n
+        frame = np.empty((self.height, self.width, 3), dtype=np.uint8)
+        frame[:, :, 0] = self._bg
+        frame[:, :, 1] = ((self._yy + 2 * n) & 0xFF).astype(np.uint8)
+        frame[:, :, 2] = (n * 3) & 0xFF
+        # A moving square so motion/tracking tests have a target.
+        size = max(8, self.height // 8)
+        x = (n * 7) % max(1, self.width - size)
+        y = (n * 5) % max(1, self.height - size)
+        frame[y : y + size, x : x + size] = (255, 255, 255)
+        return frame
+
+    def close(self) -> None:
+        self._open = False
+
+
+class OpenCVSource(VideoSource):
+    """RTSP/file/HTTP source via OpenCV VideoCapture (bundled FFmpeg demux —
+    the same native decode layer the reference reaches through PyAV,
+    ``python/environment.yml:10``). grab()/retrieve() map 1:1 onto
+    ``VideoCapture.grab()``/``.retrieve()``; keyframes are synthesized on a
+    GOP cadence because VideoCapture does not expose picture type."""
+
+    def __init__(self, url: str, gop_hint: int = 30):
+        self.url = url
+        self.gop = gop_hint
+        self._cap = None
+        self._n = -1
+
+    def open(self) -> None:
+        import cv2
+
+        cap = cv2.VideoCapture(self.url)
+        if not cap.isOpened():
+            raise ConnectionError(f"failed to open video source {self.url!r}")
+        self._cap = cap
+        self.width = int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)) or 0
+        self.height = int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT)) or 0
+        self.fps = float(cap.get(cv2.CAP_PROP_FPS)) or 30.0
+
+    def grab(self) -> Optional[PacketInfo]:
+        if self._cap is None or not self._cap.grab():
+            return None
+        self._n += 1
+        now_ms = int(time.time() * 1000)
+        pts = int(self._n * 90000 / (self.fps or 30.0))
+        return PacketInfo(
+            packet=self._n,
+            is_keyframe=(self._n % self.gop == 0),
+            pts=pts,
+            dts=pts,
+            timestamp_ms=now_ms,
+            time_base=1.0 / 90000.0,
+        )
+
+    def retrieve(self) -> Optional[np.ndarray]:
+        if self._cap is None:
+            return None
+        ok, frame = self._cap.retrieve()
+        if not ok:
+            return None
+        if self.width == 0 and frame is not None:
+            self.height, self.width = frame.shape[:2]
+        return frame  # OpenCV already yields BGR24
+
+    def close(self) -> None:
+        if self._cap is not None:
+            self._cap.release()
+            self._cap = None
+
+
+class PacketSource(VideoSource):
+    """Packet-level source over the native libav shim (``ingest/av.py``) —
+    the real counterpart of the reference's PyAV path: ``grab()`` is a pure
+    demux (no codec work — the lazy-decode gate saves actual decode CPU,
+    ``rtsp_to_rtmp.py:141-153``), keyframe flags/pts/dts/time_base come from
+    the demuxer (``rtsp_to_rtmp.py:97-110``, ``read_image.py:99-117``), and
+    the compressed payload of the current packet is available for
+    stream-copy archive/RTMP relay."""
+
+    supports_packets = True
+
+    def __init__(self, url: str, timeout_s: float = 5.0,
+                 av_options: str = ""):
+        self.url = url
+        self.timeout_s = timeout_s
+        self.av_options = av_options   # e.g. "rtsp_flags=listen" (push mode)
+        self._d = None
+        self._n = -1
+        self._pkt = None
+
+    def open(self) -> None:
+        from . import av
+
+        self._d = av.PacketDemuxer(
+            self.url, timeout_s=self.timeout_s, options=self.av_options
+        )
+        info = self._d.info
+        self.width, self.height = info.width, info.height
+        self.fps = info.fps or 30.0
+
+    @property
+    def stream_info(self):
+        """av.StreamInfo of the open demuxer (muxer construction)."""
+        return self._d.info if self._d is not None else None
+
+    def grab(self) -> Optional[PacketInfo]:
+        if self._d is None:
+            return None
+        try:
+            pkt = self._d.read()
+        except IOError:
+            return None  # worker treats as EOF -> reconnect loop
+        if pkt is None:
+            return None
+        self._pkt = pkt
+        self._n += 1
+        num, den = self._d.info.time_base
+        return PacketInfo(
+            packet=self._n,
+            is_keyframe=pkt.is_keyframe,
+            pts=pkt.pts,
+            dts=pkt.dts,
+            timestamp_ms=int(time.time() * 1000),
+            time_base=num / den,
+            is_corrupt=pkt.is_corrupt,
+        )
+
+    def packet_bytes(self) -> bytes:
+        """Compressed payload of the grabbed packet (demux-side memcpy,
+        no codec work) — feeds GOP buffers for archive/pass-through."""
+        return self._d.packet_data() if self._d is not None else b""
+
+    def packet_with_data(self):
+        """av.Packet of the grabbed packet including its compressed
+        payload (for GOP buffering / stream-copy consumers)."""
+        import dataclasses
+
+        if self._pkt is None:
+            return None
+        return dataclasses.replace(self._pkt, data=self.packet_bytes())
+
+    def retrieve(self) -> Optional[np.ndarray]:
+        if self._d is None:
+            return None
+        try:
+            return self._d.decode()
+        except IOError:
+            return None
+
+    @property
+    def last_frame_type(self) -> str:
+        """Real picture type ('I'/'P'/'B') of the last decoded frame —
+        the reference ships frame.pict_type in VideoFrame.frame_type
+        (read_image.py:99-117); round 1 guessed it from keyframe flags."""
+        return self._d.last_frame_type if self._d is not None else ""
+
+    @property
+    def last_frame_pts(self) -> Optional[int]:
+        """pts of the last DECODED frame (stream time_base). Under decoder
+        delay/reordering this lags the grabbed packet's pts — published
+        frames must carry their own presentation time, as the reference
+        does by filling VideoFrame from the frame (read_image.py:99-117)."""
+        return self._d.last_frame_pts if self._d is not None else None
+
+    def close(self) -> None:
+        if self._d is not None:
+            self._d.close()
+            self._d = None
+
+
+def open_source(url: str, prefer: str = "") -> VideoSource:
+    """Route a URL to a source. ``prefer`` (or env ``vep_source``) forces
+    ``opencv`` / ``packet`` for A/B and fallback testing."""
+    import os
+
+    if urlparse(url).scheme == "test":
+        return SyntheticSource(url)
+    prefer = prefer or os.environ.get("vep_source", "")
+    if prefer == "opencv":
+        return OpenCVSource(url)
+    if prefer != "packet":
+        from . import av
+
+        if not av.available():
+            return OpenCVSource(url)
+    return PacketSource(url)

@@ -1,0 +1,460 @@
+"""Per-camera ingest worker.
+
+One worker process per camera — the reference runs one Docker container per
+camera with three threads (demux -> decode -> archive,
+``python/rtsp_to_rtmp.py:207-253``). Here the demux/decode pair collapses into
+one capture loop (grab -> gated retrieve; the two-phase laziness lives in the
+source, see ``sources.py``) and the archiver remains its own thread fed by a
+queue — same pipeline shape, minus the cross-thread handshake the reference
+got wrong (its ``query_timestamp`` global never crossed modules, SURVEY.md
+§3.2; ours is an explicit read of the shared-memory control KV each packet,
+exactly as the reference *intended* with its per-packet Redis HGETALL,
+``rtsp_to_rtmp.py:117``).
+
+Decode gating (reference semantics, ``rtsp_to_rtmp.py:141-153``,
+``read_image.py:70-80``):
+- keyframes always decode;
+- non-keyframes decode only when a client queried within ``active_window``
+  seconds (default 10, reference ``rtsp_to_rtmp.py:144-145``);
+- keyframe-only mode (per-device KV flag) restricts decode to keyframes;
+- with a packet source (the default), archive and RTMP pass-through consume
+  *compressed* packets (stream copy, ``python/archive.py:75-100``,
+  ``rtsp_to_rtmp.py:163-182``) and never touch the decode gate; on the
+  OpenCV fallback they consume decoded frames and therefore force decode
+  while enabled.
+
+Failure semantics (reference ``rtsp_to_rtmp.py:61-79,186-187``): initial
+connect failure exits nonzero so the supervisor restarts the worker
+(restart-policy-always parity); mid-stream EOF loops forever re-opening the
+source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..bus import FrameBus, FrameMeta, RingSlotTooSmall, open_bus
+from ..utils.logging import get_logger
+from .archive import GopSegment, PacketGopSegment, SegmentArchiver
+from .sources import VideoSource, open_source
+
+log = get_logger("ingest.worker")
+
+KEY_STATUS_PREFIX = "stream_status_"   # worker heartbeat (new; the reference
+                                       # derives health from Docker inspect,
+                                       # rtsp_process_manager.go:283-335)
+RECONNECT_DELAY_S = 1.0
+STATUS_INTERVAL_S = 1.0
+
+
+@dataclass
+class WorkerConfig:
+    rtsp_endpoint: str
+    device_id: str
+    rtmp_endpoint: str = ""
+    in_memory_buffer: int = 1
+    disk_buffer_path: str = ""
+    active_window_s: float = 10.0
+    shm_dir: str = "/dev/shm/vep_tpu"
+    bus_backend: str = "shm"
+    redis_addr: str = "127.0.0.1:6379"
+    max_frames: int = 0  # 0 = endless; tests set a bound
+
+    @classmethod
+    def from_env(cls) -> "WorkerConfig":
+        """Env-var contract parity with the reference's server->worker
+        interface (``services/rtsp_process_manager.go:96-104``,
+        ``python/start.sh:8-12``)."""
+        env = os.environ
+        return cls(
+            rtsp_endpoint=env.get("rtsp_endpoint", ""),
+            device_id=env.get("device_id", ""),
+            rtmp_endpoint=env.get("rtmp_endpoint", ""),
+            in_memory_buffer=int(env.get("in_memory_buffer", "1") or 1),
+            disk_buffer_path=env.get("disk_buffer_path", ""),
+            shm_dir=env.get("vep_shm_dir", "/dev/shm/vep_tpu"),
+            bus_backend=env.get("vep_bus_backend", "shm"),
+            redis_addr=env.get("vep_redis_addr", "127.0.0.1:6379"),
+            max_frames=int(env.get("vep_max_frames", "0") or 0),
+        )
+
+
+class IngestWorker:
+    def __init__(
+        self,
+        cfg: WorkerConfig,
+        bus: Optional[FrameBus] = None,
+        source: Optional[VideoSource] = None,
+    ):
+        self.cfg = cfg
+        self._owns_bus = bus is None
+        self.bus = bus or open_bus(cfg.bus_backend, cfg.shm_dir, cfg.redis_addr)
+        try:
+            self.source = source or open_source(cfg.rtsp_endpoint)
+        except Exception:
+            if self._owns_bus:
+                self.bus.close()  # don't leak the live socket/mappings
+            raise
+        self._stop = threading.Event()
+        self._packets = 0
+        self._keyframes = 0
+        self._decoded = 0
+        self._published = 0
+        self._last_status = 0.0
+        self._fps_window: list[float] = []
+        self._archiver: Optional[SegmentArchiver] = None
+        self._gop_frames: list = []
+        self._gop_start_ms = 0
+        self._passthrough = None  # built in run() once source fps is known
+        # Packet mode: source exposes compressed payloads, so archive and
+        # pass-through are stream copies that never touch the decode gate.
+        self._packet_mode = bool(getattr(self.source, "supports_packets", False))
+        self._gop_packets: list = []
+        self._gop_bytes = 0
+        self._gop_info = None  # StreamInfo captured at GOP open
+
+    # -- control-plane reads (per packet; shm KV, nanosecond-cheap) --
+
+    def _client_active(self, now_ms: int) -> bool:
+        last = self.bus.last_query_ms(self.cfg.device_id)
+        return last is not None and (now_ms - last) < self.cfg.active_window_s * 1000
+
+    def _should_decode(self, is_keyframe: bool, now_ms: int) -> bool:
+        if not self._packet_mode:
+            # OpenCV fallback: archive/relay consume decoded frames, so
+            # they pin decoding on. Packet mode stream-copies instead.
+            if self._archiver is not None:
+                return True
+            if self._passthrough is not None and self._passthrough.active:
+                return True
+        if is_keyframe:
+            return True
+        if self.bus.keyframe_only(self.cfg.device_id):
+            return False
+        return self._client_active(now_ms)
+
+    # -- status heartbeat --
+
+    def _publish_status(self, now: float, error: str = "", force: bool = False) -> None:
+        if now - self._last_status < STATUS_INTERVAL_S and not (error or force):
+            return
+        self._last_status = now
+        window = [t for t in self._fps_window if now - t < 5.0]
+        self._fps_window = window
+        status = {
+            "pid": os.getpid(),
+            "running": not self._stop.is_set(),
+            "packets": self._packets,
+            "keyframes": self._keyframes,
+            "decoded": self._decoded,
+            "published": self._published,
+            "fps": round(len(window) / 5.0, 2),
+            "width": self.source.width,
+            "height": self.source.height,
+            "error": error,
+            "ts_ms": int(time.time() * 1000),  # epoch: readers check staleness
+        }
+        self.bus.kv_set(
+            KEY_STATUS_PREFIX + self.cfg.device_id,
+            json.dumps(status, separators=(",", ":")),
+        )
+
+    # -- archive plumbing --
+
+    def _archive_frame(self, frame, meta: FrameMeta) -> None:
+        if self._archiver is None or self._packet_mode:
+            return
+        if meta.is_keyframe and self._gop_frames:
+            # Keyframe closes the previous GOP -> hand to archiver thread
+            # (reference rtsp_to_rtmp.py:97-110).
+            self._archiver.submit(
+                GopSegment(
+                    device_id=self.cfg.device_id,
+                    start_ts_ms=self._gop_start_ms,
+                    end_ts_ms=meta.timestamp_ms,
+                    fps=self.source.fps or 30.0,
+                    frames=self._gop_frames,
+                )
+            )
+            self._gop_frames = []
+        if meta.is_keyframe or self._gop_frames:
+            if not self._gop_frames:
+                self._gop_start_ms = meta.timestamp_ms
+            self._gop_frames.append(frame)
+
+    # Cap on a single buffered GOP (a camera that stops emitting keyframes
+    # must not grow the buffer until OOM). On overflow the buffered prefix
+    # — which starts at a keyframe, so it is decodable — is submitted as a
+    # segment, and the GOP's remaining packets are skipped until the next
+    # keyframe (the empty-buffer guard below does that naturally).
+    MAX_GOP_BYTES = 64 << 20
+
+    def _flush_gop_tail(self) -> None:
+        """Submit the buffered (keyframe-headed, keyframe-unclosed) GOP —
+        at EOF/reconnect/shutdown. Mixing packets from two demuxer
+        instances in one segment would rebase across unrelated clocks."""
+        if self._archiver is not None and self._gop_packets:
+            self._archiver.submit(
+                PacketGopSegment(
+                    device_id=self.cfg.device_id,
+                    start_ts_ms=self._gop_start_ms,
+                    info=self._gop_info,
+                    packets=self._gop_packets,
+                )
+            )
+        self._gop_packets = []
+
+    def _archive_packet(self, pkt, is_keyframe: bool, now_ms: int) -> None:
+        """Compressed-GOP archiving (packet mode): keyframe closes the
+        previous GOP and opens a new one — same grouping as the reference's
+        demux loop (rtsp_to_rtmp.py:97-110), but with real packets."""
+        if self._archiver is None:
+            return
+        if self._gop_packets and (
+            is_keyframe
+            or self._gop_bytes + len(pkt.data) > self.MAX_GOP_BYTES
+        ):
+            self._flush_gop_tail()
+        if is_keyframe or self._gop_packets:
+            if not self._gop_packets:
+                self._gop_start_ms = now_ms
+                self._gop_bytes = 0
+                # Captured at GOP open: the source may be closed (EOF) or
+                # re-opened with new params by the time the GOP is flushed.
+                self._gop_info = self.source.stream_info
+            self._gop_packets.append(pkt)
+            self._gop_bytes += len(pkt.data)
+
+    # -- RTMP pass-through (reference §3.4: toggle + buffered-GOP flush) --
+
+    def _maybe_passthrough(self) -> None:
+        if self._passthrough is None:
+            return
+        self._passthrough.set_active(self.bus.proxy_rtmp(self.cfg.device_id))
+
+    # -- main loop --
+
+    def run(self) -> None:
+        cfg = self.cfg
+        try:
+            self.source.open()
+        except ConnectionError as exc:
+            # Exit hard: supervisor restart-policy takes over (reference
+            # rtsp_to_rtmp.py:76-78 + RestartPolicy always).
+            log.error("initial connect failed for %s: %s", cfg.device_id, exc)
+            self._publish_status(time.monotonic(), error=str(exc))
+            raise SystemExit(2)
+
+        frame_bytes = max(
+            self.source.width * self.source.height * 3, 1920 * 1080 * 3
+        )
+        self.bus.create_stream(
+            cfg.device_id, frame_bytes, slots=max(2, cfg.in_memory_buffer + 1)
+        )
+        if cfg.disk_buffer_path:
+            self._archiver = SegmentArchiver(cfg.disk_buffer_path)
+            self._archiver.start()
+        if cfg.rtmp_endpoint:
+            if self._packet_mode:
+                from .passthrough import PacketPassthroughWriter
+
+                self._passthrough = PacketPassthroughWriter(
+                    cfg.rtmp_endpoint, self.source.stream_info
+                )
+            else:
+                from .passthrough import PassthroughWriter
+
+                self._passthrough = PassthroughWriter(
+                    cfg.rtmp_endpoint, fps=self.source.fps or 30.0
+                )
+        log.info(
+            "ingest worker up: device=%s source=%s %dx%d@%.1ffps",
+            cfg.device_id, cfg.rtsp_endpoint,
+            self.source.width, self.source.height, self.source.fps,
+        )
+
+        try:
+            while not self._stop.is_set():
+                pkt = self.source.grab()
+                if pkt is None:
+                    if cfg.max_frames and self._packets >= cfg.max_frames:
+                        break
+                    # Mid-stream EOF: wait for the camera to come back,
+                    # forever (reference rtsp_to_rtmp.py:186-187).
+                    log.warning(
+                        "stream %s EOF/gone; reconnecting in %.0fs",
+                        cfg.device_id, RECONNECT_DELAY_S,
+                    )
+                    # The buffered GOP is a valid keyframe-headed prefix of
+                    # the dying stream; archive it now — the re-opened
+                    # demuxer has a fresh clock (and possibly fresh codec
+                    # params) that must not be mixed into this segment.
+                    self._flush_gop_tail()
+                    self.source.close()
+                    if self._stop.wait(RECONNECT_DELAY_S):
+                        break
+                    try:
+                        self.source.open()
+                        if self._packet_mode and self._passthrough is not None:
+                            # Fresh demuxer: new clock, possibly new codec
+                            # params. Stale GOP buffer and mux must go; an
+                            # operator-requested relay resumes on the new
+                            # stream's next keyframe.
+                            self._passthrough.reset(self.source.stream_info)
+                    except ConnectionError:
+                        pass
+                    continue
+
+                self._packets += 1
+                if pkt.is_keyframe:
+                    self._keyframes += 1
+                now_ms = pkt.timestamp_ms
+                self._maybe_passthrough()
+
+                if self._packet_mode and (
+                    self._archiver is not None or self._passthrough is not None
+                ):
+                    # Compressed consumers ride the demux path: one payload
+                    # memcpy, zero codec work, decode gate untouched.
+                    full = self.source.packet_with_data()
+                    if self._passthrough is not None:
+                        self._passthrough.feed(full)
+                    self._archive_packet(full, pkt.is_keyframe, now_ms)
+
+                if self._should_decode(pkt.is_keyframe, now_ms):
+                    frame = self.source.retrieve()
+                    if frame is None:
+                        continue
+                    self._decoded += 1
+                    frame_type = (
+                        getattr(self.source, "last_frame_type", "")
+                        or ("I" if pkt.is_keyframe else "P")
+                    )
+                    # Under decoder delay the frame lags the grabbed packet;
+                    # publish the FRAME's presentation time (reference fills
+                    # VideoFrame from the frame, read_image.py:99-117).
+                    frame_pts = getattr(self.source, "last_frame_pts", None)
+                    meta = FrameMeta(
+                        width=frame.shape[1],
+                        height=frame.shape[0],
+                        channels=frame.shape[2] if frame.ndim == 3 else 1,
+                        timestamp_ms=now_ms,
+                        pts=frame_pts if frame_pts is not None else pkt.pts,
+                        dts=pkt.dts,
+                        packet=pkt.packet,
+                        keyframe_cnt=self._keyframes,
+                        is_keyframe=pkt.is_keyframe,
+                        is_corrupt=pkt.is_corrupt,
+                        frame_type=frame_type,
+                        time_base=pkt.time_base,
+                    )
+                    try:
+                        self.bus.publish(cfg.device_id, frame, meta)
+                    except RingSlotTooSmall:
+                        # The source under-reported its
+                        # resolution at open (OpenCV backends may say 0x0) or
+                        # the camera switched to a larger mode mid-stream.
+                        # The worker owns the ring, so grow it in place
+                        # rather than dying into a restart loop that would
+                        # re-create the same undersized ring.
+                        log.warning(
+                            "ring slot too small for %s (%d B); recreating",
+                            cfg.device_id, frame.nbytes,
+                        )
+                        self.bus.create_stream(
+                            cfg.device_id, frame.nbytes,
+                            slots=max(2, cfg.in_memory_buffer + 1),
+                        )
+                        self.bus.publish(cfg.device_id, frame, meta)
+                    self._published += 1
+                    self._fps_window.append(time.monotonic())
+                    self._archive_frame(frame, meta)
+                    if self._passthrough is not None and not self._packet_mode:
+                        self._passthrough.buffer(frame, meta.is_keyframe)
+                        self._passthrough.relay(frame)
+
+                self._publish_status(time.monotonic())
+                if cfg.max_frames and self._packets >= cfg.max_frames:
+                    break
+        finally:
+            # Every teardown step runs even when an earlier one raises (a
+            # dead bus makes the status publish the likeliest raiser; it
+            # must not cost the trailing-GOP flush or leak the demuxer).
+            def _safe(what, fn):
+                try:
+                    fn()
+                except Exception:
+                    log.exception("worker teardown: %s failed", what)
+
+            _safe("status", lambda: self._publish_status(
+                time.monotonic(), force=True))
+            if self._archiver is not None:
+                # Flush the trailing (keyframe-unclosed) GOP — dropping it
+                # would lose the tail (the reference loses it; deliberate
+                # divergence).
+                _safe("gop flush", self._flush_gop_tail)
+                _safe("archiver", self._archiver.stop)
+            if self._passthrough is not None:
+                _safe("passthrough", self._passthrough.close)
+            _safe("source", self.source.close)
+            log.info(
+                "ingest worker down: device=%s packets=%d decoded=%d",
+                cfg.device_id, self._packets, self._decoded,
+            )
+            if self._owns_bus:
+                # A redis-backed bus holds a live socket; injected buses
+                # (tests, embedded use) belong to the caller.
+                _safe("bus", self.bus.close)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """CLI entrypoint; flags mirror the reference's ``start.sh:27-43`` argv
+    translation, and every flag falls back to the env-var contract."""
+    env_cfg = WorkerConfig.from_env()
+    p = argparse.ArgumentParser(description="per-camera ingest worker")
+    p.add_argument("--rtsp", default=env_cfg.rtsp_endpoint)
+    p.add_argument("--device_id", default=env_cfg.device_id)
+    p.add_argument("--rtmp", default=env_cfg.rtmp_endpoint)
+    p.add_argument("--memory_buffer", type=int, default=env_cfg.in_memory_buffer)
+    p.add_argument("--disk_buffer_path", default=env_cfg.disk_buffer_path)
+    p.add_argument("--shm_dir", default=env_cfg.shm_dir)
+    p.add_argument("--bus_backend", default=env_cfg.bus_backend)
+    p.add_argument("--redis_addr", default=env_cfg.redis_addr)
+    p.add_argument("--max_frames", type=int, default=env_cfg.max_frames)
+    args = p.parse_args(argv)
+    if not args.rtsp or not args.device_id:
+        p.error("--rtsp and --device_id are required (or env contract)")
+    cfg = WorkerConfig(
+        rtsp_endpoint=args.rtsp,
+        device_id=args.device_id,
+        rtmp_endpoint=args.rtmp,
+        in_memory_buffer=args.memory_buffer,
+        disk_buffer_path=args.disk_buffer_path,
+        shm_dir=args.shm_dir,
+        bus_backend=args.bus_backend,
+        redis_addr=args.redis_addr,
+        max_frames=args.max_frames,
+    )
+    worker = IngestWorker(cfg)
+
+    import signal
+
+    def _sig(_s, _f):
+        worker.stop()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    worker.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""TPU model zoo: the inference plane the reference leaves to external
+clients (SURVEY.md §7 — "the new heart"). Five families = BASELINE configs."""
+
+from . import registry
+from .mobilenet_v2 import MobileNetV2, MobileNetV2Config
+from .registry import ModelSpec, get, names, register
+from .resnet import ResNet, ResNetConfig
+from .transformer import Encoder, EncoderConfig, default_attention
+from .videomae import VideoMAE, VideoMAEConfig, VideoMAEDecoder
+from .vit import ViT, ViTConfig
+from .yolov8 import YOLOv8, YOLOv8Config, yolov8n_config
+
+__all__ = [
+    "registry", "ModelSpec", "get", "names", "register",
+    "MobileNetV2", "MobileNetV2Config", "ResNet", "ResNetConfig",
+    "Encoder", "EncoderConfig", "default_attention",
+    "ViT", "ViTConfig", "VideoMAE", "VideoMAEConfig", "VideoMAEDecoder",
+    "YOLOv8", "YOLOv8Config", "yolov8n_config",
+]

@@ -1,0 +1,87 @@
+"""Shared building blocks for the model zoo.
+
+The reference ships no models at all — it is plumbing that feeds raw BGR24
+frames to external CPU clients (`/root/reference/README.md:5-27`). The five
+model families here are the TPU inference plane that replaces that void
+(BASELINE.json configs 1-5), built MXU-first:
+
+- NHWC layout end to end (XLA's native conv layout on TPU).
+- bfloat16 compute / float32 params ("mixed" policy): matmuls and convs hit
+  the MXU at bf16, normalization statistics stay fp32.
+- Static shapes only; every model is shape-polymorphic *at trace time* via
+  its config, never at run time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+# SiLU is the activation of the YOLO family; convnets here default to their
+# canonical activations via explicit args.
+ACT: dict[str, Callable] = {
+    "relu": nn.relu,
+    "relu6": lambda x: jnp.minimum(nn.relu(x), 6.0),
+    "silu": nn.silu,
+    "gelu": nn.gelu,
+    "identity": lambda x: x,
+}
+
+
+class ConvBN(nn.Module):
+    """Conv → BatchNorm → activation, the convnet workhorse.
+
+    BatchNorm keeps fp32 statistics regardless of compute dtype; `train`
+    toggles running-average use so the same module serves the inference
+    plane (frozen stats) and fine-tuning (mutable `batch_stats`).
+    """
+
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    groups: int = 1
+    act: str = "silu"
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = nn.Conv(
+            self.features,
+            kernel_size=(self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=self.groups,
+            use_bias=False,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.97,
+            epsilon=1e-3,
+            dtype=jnp.float32,
+            name="bn",
+        )(x.astype(jnp.float32))
+        return ACT[self.act](x.astype(self.dtype))
+
+
+def adaptive_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
+    """Global average pool [N, H, W, C] -> [N, C] in fp32 for stability."""
+    return jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+
+
+def make_divisible(v: float, divisor: int = 8) -> int:
+    """Channel rounding used by the mobile-net family width multiplier."""
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def round_depth(n: int, depth_multiple: float) -> int:
+    """YOLO-family per-stage block-count scaling."""
+    return max(1, round(n * depth_multiple))

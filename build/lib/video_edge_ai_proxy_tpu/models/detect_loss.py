@@ -1,0 +1,232 @@
+"""YOLOv8 detection loss: task-aligned assignment + CIoU + DFL.
+
+The reference has no training at all; this module makes the flagship
+detector fine-tunable on-TPU (edge deployments retrain on site footage).
+Everything is static-shape: ground truth arrives padded to ``max_boxes``
+with a validity mask, assignment is a dense [B, M, A] tensor computation
+(no data-dependent gathers), so the whole loss jits cleanly and shards
+over the dp axis like any other step.
+
+Components (standard YOLOv8 formulation):
+- Task-aligned assigner: align = cls_prob^alpha * IoU^beta over anchors
+  whose center lies inside the GT box; top-k per GT; conflicts resolved to
+  the highest-align GT.
+- Classification: BCE against IoU-scaled soft targets.
+- Box: CIoU loss on assigned anchors.
+- DFL: two-hot cross-entropy on the ltrb bin distribution.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .yolov8 import YOLOv8Config, _anchor_points
+
+ALPHA, BETA = 0.5, 6.0          # TAL exponents
+TOP_K = 10
+W_BOX, W_CLS, W_DFL = 7.5, 0.5, 1.5
+EPS = 1e-9
+
+
+def flatten_levels(head_out, cfg: YOLOv8Config):
+    """Per-level head outputs -> flat [B, A, ...] plus anchor geometry."""
+    box_l, cls_l, anchors, strides = [], [], [], []
+    for (box, cls), stride in zip(head_out, cfg.strides):
+        b, h, w, _ = box.shape
+        box_l.append(box.reshape(b, h * w, 4 * cfg.reg_max))
+        cls_l.append(cls.reshape(b, h * w, cfg.num_classes))
+        anchors.append(_anchor_points(h, w, stride))
+        strides.append(jnp.full((h * w,), stride, jnp.float32))
+    return (
+        jnp.concatenate(box_l, 1),
+        jnp.concatenate(cls_l, 1),
+        jnp.concatenate(anchors, 0),     # [A, 2] px
+        jnp.concatenate(strides, 0),     # [A]
+    )
+
+
+def _decode_dfl(box_logits: jnp.ndarray, anchors: jnp.ndarray,
+                strides: jnp.ndarray, reg_max: int) -> jnp.ndarray:
+    """[B, A, 4*reg_max] -> xyxy px (same math as inference decode)."""
+    b, a, _ = box_logits.shape
+    probs = nn.softmax(box_logits.reshape(b, a, 4, reg_max), axis=-1)
+    dist = probs @ jnp.arange(reg_max, dtype=jnp.float32)   # [B, A, 4] strides
+    dist = dist * strides[None, :, None]
+    x1y1 = anchors[None] - dist[..., :2]
+    x2y2 = anchors[None] + dist[..., 2:]
+    return jnp.concatenate([x1y1, x2y2], -1)
+
+
+def iou_pairwise(gt: jnp.ndarray, pred: jnp.ndarray) -> jnp.ndarray:
+    """[B, M, 4] x [B, A, 4] -> IoU [B, M, A]."""
+    gt_ = gt[:, :, None, :]       # [B, M, 1, 4]
+    pr_ = pred[:, None, :, :]     # [B, 1, A, 4]
+    lt = jnp.maximum(gt_[..., :2], pr_[..., :2])
+    rb = jnp.minimum(gt_[..., 2:], pr_[..., 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_g = jnp.maximum(gt_[..., 2] - gt_[..., 0], 0) * jnp.maximum(
+        gt_[..., 3] - gt_[..., 1], 0)
+    area_p = jnp.maximum(pr_[..., 2] - pr_[..., 0], 0) * jnp.maximum(
+        pr_[..., 3] - pr_[..., 1], 0)
+    return inter / jnp.maximum(area_g + area_p - inter, EPS)
+
+
+def ciou(box1: jnp.ndarray, box2: jnp.ndarray) -> jnp.ndarray:
+    """Complete IoU between aligned boxes [..., 4] xyxy -> [...]."""
+    lt = jnp.maximum(box1[..., :2], box2[..., :2])
+    rb = jnp.minimum(box1[..., 2:], box2[..., 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    w1, h1 = box1[..., 2] - box1[..., 0], box1[..., 3] - box1[..., 1]
+    w2, h2 = box2[..., 2] - box2[..., 0], box2[..., 3] - box2[..., 1]
+    union = w1 * h1 + w2 * h2 - inter
+    iou = inter / jnp.maximum(union, EPS)
+    # enclosing box diagonal
+    elt = jnp.minimum(box1[..., :2], box2[..., :2])
+    erb = jnp.maximum(box1[..., 2:], box2[..., 2:])
+    ewh = jnp.maximum(erb - elt, 0.0)
+    c2 = ewh[..., 0] ** 2 + ewh[..., 1] ** 2
+    # center distance
+    cx1, cy1 = (box1[..., 0] + box1[..., 2]) / 2, (box1[..., 1] + box1[..., 3]) / 2
+    cx2, cy2 = (box2[..., 0] + box2[..., 2]) / 2, (box2[..., 1] + box2[..., 3]) / 2
+    rho2 = (cx1 - cx2) ** 2 + (cy1 - cy2) ** 2
+    # aspect-ratio consistency
+    v = (4 / jnp.pi ** 2) * (
+        jnp.arctan(w2 / jnp.maximum(h2, EPS)) - jnp.arctan(w1 / jnp.maximum(h1, EPS))
+    ) ** 2
+    alpha = v / jnp.maximum(1 - iou + v, EPS)
+    alpha = jax.lax.stop_gradient(alpha)
+    return iou - rho2 / jnp.maximum(c2, EPS) - alpha * v
+
+
+def assign(
+    cls_logits: jnp.ndarray,     # [B, A, C]
+    pred_boxes: jnp.ndarray,     # [B, A, 4] px
+    anchors: jnp.ndarray,        # [A, 2]
+    gt_boxes: jnp.ndarray,       # [B, M, 4] px xyxy
+    gt_labels: jnp.ndarray,      # [B, M] int32
+    gt_mask: jnp.ndarray,        # [B, M] bool
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Task-aligned assignment. Returns (fg [B, A] bool, gt_idx [B, A] int32,
+    norm_align [B, A] — the IoU-scaled soft target weight)."""
+    b, a, _ = cls_logits.shape
+    m = gt_boxes.shape[1]
+
+    # anchor center inside GT
+    ax = anchors[None, None, :, 0]
+    ay = anchors[None, None, :, 1]
+    in_gt = (
+        (ax >= gt_boxes[..., 0:1]) & (ax < gt_boxes[..., 2:3])
+        & (ay >= gt_boxes[..., 1:2]) & (ay < gt_boxes[..., 3:4])
+    )                                                     # [B, M, A]
+    valid = in_gt & gt_mask[..., None]
+
+    probs = nn.sigmoid(cls_logits)                        # [B, A, C]
+    cls_score = jnp.take_along_axis(
+        probs.transpose(0, 2, 1),                          # [B, C, A]
+        jnp.clip(gt_labels, 0, probs.shape[-1] - 1)[..., None], axis=1,
+    )                                                      # [B, M, A]
+    ious = iou_pairwise(gt_boxes, pred_boxes)              # [B, M, A]
+    align = (cls_score ** ALPHA) * (jnp.maximum(ious, 0) ** BETA)
+    align = jnp.where(valid, align, 0.0)
+
+    # top-k anchors per GT (dense mask, no gathers)
+    k = min(TOP_K, a)
+    kth = jnp.sort(align, axis=-1)[..., -k][..., None]     # [B, M, 1]
+    topk = (align >= jnp.maximum(kth, EPS)) & (align > 0)
+
+    # conflicts: anchor claimed by the GT with max align
+    align_masked = jnp.where(topk, align, 0.0)
+    gt_idx = jnp.argmax(align_masked, axis=1)              # [B, A]
+    best = jnp.max(align_masked, axis=1)                   # [B, A]
+    fg = best > 0
+
+    # normalize: per-GT max align -> per-GT max IoU (YOLOv8 target scaling)
+    pos_iou = jnp.where(topk, ious, 0.0)
+    gt_max_align = jnp.max(align_masked, axis=-1)          # [B, M]
+    gt_max_iou = jnp.max(pos_iou, axis=-1)                 # [B, M]
+    scale = gt_max_iou / jnp.maximum(gt_max_align, EPS)    # [B, M]
+    norm_align = best * jnp.take_along_axis(scale, gt_idx, axis=1)
+    return fg, gt_idx, jnp.where(fg, norm_align, 0.0)
+
+
+def detection_loss(
+    head_out,
+    targets: Dict[str, jnp.ndarray],
+    cfg: YOLOv8Config,
+) -> jnp.ndarray:
+    """Total loss for raw head output (model.apply(..., decode=False)).
+
+    targets: {"boxes": [B, M, 4] px xyxy, "labels": [B, M] int32,
+              "mask": [B, M] bool}.
+    """
+    box_logits, cls_logits, anchors, strides = flatten_levels(head_out, cfg)
+    pred_boxes = _decode_dfl(box_logits, anchors, strides, cfg.reg_max)
+    fg, gt_idx, weight = assign(
+        cls_logits, pred_boxes, anchors,
+        targets["boxes"], targets["labels"], targets["mask"],
+    )
+
+    b, a, c = cls_logits.shape
+    t_boxes = jnp.take_along_axis(
+        targets["boxes"], gt_idx[..., None], axis=1
+    )                                                      # [B, A, 4]
+    t_labels = jnp.take_along_axis(targets["labels"], gt_idx, axis=1)
+    t_scores = jax.nn.one_hot(t_labels, c) * weight[..., None]
+
+    # classification BCE over every anchor
+    cls_loss = optax_bce(cls_logits, t_scores).sum() / jnp.maximum(
+        t_scores.sum(), 1.0
+    )
+
+    # CIoU on foreground anchors, weighted by alignment
+    iou_term = (1.0 - ciou(pred_boxes, t_boxes)) * weight
+    denom = jnp.maximum(weight.sum(), 1.0)
+    box_loss = jnp.where(fg, iou_term, 0.0).sum() / denom
+
+    # DFL: two-hot cross entropy on ltrb distances in stride units
+    lt = (anchors[None] - t_boxes[..., :2]) / strides[None, :, None]
+    rb = (t_boxes[..., 2:] - anchors[None]) / strides[None, :, None]
+    dist = jnp.clip(
+        jnp.concatenate([lt, rb], -1), 0, cfg.reg_max - 1 - 0.01
+    )                                                      # [B, A, 4]
+    lo = jnp.floor(dist)
+    hi_w = dist - lo
+    logp = nn.log_softmax(
+        box_logits.reshape(b, a, 4, cfg.reg_max), axis=-1
+    )
+    lo_i = lo.astype(jnp.int32)
+    lp_lo = jnp.take_along_axis(logp, lo_i[..., None], -1)[..., 0]
+    lp_hi = jnp.take_along_axis(
+        logp, jnp.clip(lo_i + 1, 0, cfg.reg_max - 1)[..., None], -1
+    )[..., 0]
+    dfl = -((1 - hi_w) * lp_lo + hi_w * lp_hi).mean(-1) * weight
+    dfl_loss = jnp.where(fg, dfl, 0.0).sum() / denom
+
+    return W_BOX * box_loss + W_CLS * cls_loss + W_DFL * dfl_loss
+
+
+def optax_bce(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise sigmoid BCE (kept local: optax's version reduces)."""
+    return jnp.maximum(logits, 0) - logits * targets + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+
+
+def make_detection_loss_fn(cfg: YOLOv8Config):
+    """Adapter for parallel.make_trainer: loss_fn(model, params, aux,
+    batch, targets) with targets as the padded dict above. BatchNorm runs
+    with frozen statistics (train=False) — the standard fine-tune stance,
+    and what keeps the step purely functional."""
+    def loss_fn(model, params, aux, batch, targets):
+        head_out = model.apply(
+            {"params": params, **(aux or {})}, batch, train=False, decode=False
+        )
+        return detection_loss(head_out, targets, cfg)
+
+    return loss_fn

@@ -1,0 +1,96 @@
+"""MobileNetV2 classifier — BASELINE config 1 (single-stream classify path).
+
+Standard inverted-residual architecture (Sandler et al. 2018) in NHWC bf16.
+Depthwise convs map to XLA's grouped-conv path; the pointwise 1×1 convs are
+the MXU work. The reference has no model here — config 1's job in the old
+system was done by an external CPU client reading raw frames off the bus
+(`/root/reference/examples/opencv_display.py:46-53`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import ConvBN, Dtype, adaptive_avg_pool, make_divisible
+
+# (expansion t, out channels c, repeats n, first stride s)
+_MNV2_STAGES = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+@dataclass(frozen=True)
+class MobileNetV2Config:
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    stages: Sequence[tuple] = field(default=_MNV2_STAGES)
+    stem_features: int = 32
+    head_features: int = 1280
+
+
+def tiny_mobilenet_v2_config(num_classes: int = 10) -> MobileNetV2Config:
+    """Small config for CPU tests: 2 stages, thin channels."""
+    return MobileNetV2Config(
+        num_classes=num_classes,
+        stages=((1, 16, 1, 1), (6, 24, 2, 2)),
+        stem_features=16,
+        head_features=64,
+    )
+
+
+class InvertedResidual(nn.Module):
+    features: int
+    stride: int
+    expand: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        h = x
+        hidden = in_ch * self.expand
+        if self.expand != 1:
+            h = ConvBN(hidden, kernel=1, act="relu6", dtype=self.dtype, name="expand")(h, train)
+        h = ConvBN(
+            hidden, kernel=3, stride=self.stride, groups=hidden,
+            act="relu6", dtype=self.dtype, name="depthwise",
+        )(h, train)
+        h = ConvBN(self.features, kernel=1, act="identity", dtype=self.dtype, name="project")(h, train)
+        if self.stride == 1 and in_ch == self.features:
+            h = h + x
+        return h
+
+
+class MobileNetV2(nn.Module):
+    cfg: MobileNetV2Config
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        c = self.cfg
+        x = x.astype(self.dtype)
+        x = ConvBN(
+            make_divisible(c.stem_features * c.width_mult), stride=2,
+            act="relu6", dtype=self.dtype, name="stem",
+        )(x, train)
+        for si, (t, ch, n, s) in enumerate(c.stages):
+            out_ch = make_divisible(ch * c.width_mult)
+            for bi in range(n):
+                x = InvertedResidual(
+                    out_ch, stride=s if bi == 0 else 1, expand=t,
+                    dtype=self.dtype, name=f"stage{si}_block{bi}",
+                )(x, train)
+        head = make_divisible(c.head_features * max(1.0, c.width_mult))
+        x = ConvBN(head, kernel=1, act="relu6", dtype=self.dtype, name="head")(x, train)
+        x = adaptive_avg_pool(x)
+        return nn.Dense(c.num_classes, dtype=jnp.float32, name="classifier")(x)

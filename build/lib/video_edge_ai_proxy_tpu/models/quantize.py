@@ -1,0 +1,105 @@
+"""Weight-only int8 quantization for the serving plane.
+
+The reference has no models, so it has no quantization story (SURVEY.md
+§2.2 — it ships raw frames to external CPU clients); an edge box that
+serves models from device memory wants one. This is post-training,
+weight-only, symmetric int8:
+
+- every kernel (ndim >= 2) is stored as int8 with a float32 scale per
+  output channel (max-abs / 127, the standard symmetric PTQ rule);
+- 1-D leaves (biases, norm scales/statistics) stay exact — they are tiny
+  and precision-critical;
+- at serving time the weights are dequantized *inside* the jitted program
+  (`int8 * scale -> bf16`), so HBM holds int8 (4x smaller than f32
+  checkpoints, 2x smaller than bf16 residency) and XLA fuses the
+  dequantize into each consumer. Compute stays bf16 on the MXU —
+  activation quantization (int8 matmuls) is deliberately out of scope:
+  weight-only is accuracy-safe without calibration data, which an edge
+  deployment rarely has.
+
+`engine/runner.py` enables this via ``engine.quantize: int8`` in the
+config. On-disk checkpoints deliberately stay full precision — the
+canonical format every load path expects — so quantization is re-applied
+at each warmup; only device/HBM residency shrinks. Note the consequence:
+an engine running quantized can only save the int8-roundtripped values
+(the exact weights are gone after warmup), so `save_checkpoint` warns
+before overwriting a full-precision file.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class QuantizedTree:
+    """A params pytree split into int8 payloads + their scales.
+
+    ``q``: same structure as the source tree; quantized leaves are int8,
+    skipped leaves are kept verbatim. ``scale``: same structure; f32
+    per-output-channel scale arrays for quantized leaves, None markers
+    (empty arrays) for skipped ones.
+    """
+
+    q: Any
+    scale: Any
+
+
+def _quantize_leaf(w: jnp.ndarray):
+    """[..., out] kernel -> (int8 [..., out], f32 scale [out])."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=tuple(range(w.ndim - 1)))
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def _should_quantize(w) -> bool:
+    return hasattr(w, "ndim") and w.ndim >= 2 and w.size >= 1024
+
+
+def quantize_tree(tree: Any) -> QuantizedTree:
+    """Quantize every kernel-shaped leaf of a params tree (ndim >= 2 and at
+    least 1024 elements — embeddings, conv and dense kernels); leave small
+    or 1-D leaves (biases, norms, BN statistics) untouched."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    qs, scales = [], []
+    for w in leaves:
+        if _should_quantize(w):
+            q, s = _quantize_leaf(jnp.asarray(w))
+            qs.append(q)
+            scales.append(s)
+        else:
+            qs.append(jnp.asarray(w))
+            scales.append(jnp.zeros((0,), jnp.float32))   # marker: not quantized
+    return QuantizedTree(
+        q=jax.tree_util.tree_unflatten(treedef, qs),
+        scale=jax.tree_util.tree_unflatten(treedef, scales),
+    )
+
+
+def dequantize_tree(qt: QuantizedTree, dtype=jnp.float32) -> Any:
+    """Inverse of :func:`quantize_tree`; call INSIDE the jitted consumer so
+    XLA fuses `int8 * scale` into each weight's first use and HBM keeps the
+    int8 residency."""
+    def deq(q, s):
+        if q.dtype == jnp.int8 and s.size:
+            return (q.astype(jnp.float32) * s).astype(dtype)
+        return q
+
+    return jax.tree_util.tree_map(deq, qt.q, qt.scale)
+
+
+def quantized_nbytes(qt: QuantizedTree) -> int:
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(qt.q)) + sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(qt.scale))
+
+
+def tree_nbytes(tree: Any) -> int:
+    return sum(np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(tree))

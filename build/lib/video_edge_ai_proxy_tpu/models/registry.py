@@ -1,0 +1,140 @@
+"""Model registry: one name → everything the inference engine needs.
+
+The engine (`engine/runner.py`) is model-agnostic; a `ModelSpec` bundles the
+module, its input geometry, which device-side preprocess to use, and how to
+turn raw outputs into wire-ready results. The five registered defaults are
+the five BASELINE.json configs; registering a new family is one entry, not
+an engine change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .mobilenet_v2 import MobileNetV2, MobileNetV2Config, tiny_mobilenet_v2_config
+from .resnet import ResNet, ResNetConfig, tiny_resnet_config
+from .videomae import VideoMAE, VideoMAEConfig, tiny_videomae_config
+from .vit import ViT, ViTConfig, tiny_vit_config
+from .yolov8 import YOLOv8, tiny_yolov8_config, yolov8n_config, yolov8s_config
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    build: Callable[[], Any]              # () -> nn.Module
+    input_size: int                       # square side the model consumes
+    preprocess: str                       # "classify" | "letterbox" | "clip"
+    kind: str                             # "classify" | "detect" | "embed" | "video"
+    clip_len: int = 0                     # >0 for video models
+    description: str = ""
+
+    def init_params(self, rng: Optional[jax.Array] = None, batch: int = 1):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        model = self.build()
+        x = jnp.zeros(self.example_shape(batch), jnp.bfloat16)
+        # jit the init: eager per-op dispatch costs seconds of compile time
+        # per op on some backends; one fused compile is orders faster.
+        return model, jax.jit(model.init)(rng, x)
+
+    def example_shape(self, batch: int = 1) -> Tuple[int, ...]:
+        s = self.input_size
+        if self.clip_len:
+            return (batch, self.clip_len, s, s, 3)
+        return (batch, s, s, 3)
+
+
+_REGISTRY: Dict[str, ModelSpec] = {}
+
+
+def register(spec: ModelSpec) -> ModelSpec:
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get(name: str) -> ModelSpec:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown model '{name}'; registered: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name]
+
+
+def names() -> list:
+    return sorted(_REGISTRY)
+
+
+# --- BASELINE.json configs 1-5 -------------------------------------------
+
+register(ModelSpec(
+    "mobilenet_v2", lambda: MobileNetV2(MobileNetV2Config()),
+    input_size=224, preprocess="classify", kind="classify",
+    description="config 1: single-stream frame classification",
+))
+register(ModelSpec(
+    "yolov8n", lambda: YOLOv8(yolov8n_config()),
+    input_size=640, preprocess="letterbox", kind="detect",
+    description="config 2 + north star: batched detection",
+))
+register(ModelSpec(
+    "yolov8n_s2d", lambda: YOLOv8(
+        dataclasses.replace(yolov8n_config(), s2d_stem=True)
+    ),
+    input_size=640, preprocess="letterbox", kind="detect",
+    description="north-star variant: space-to-depth stem (lane-fill "
+                "experiment, BASELINE.md perf notes; checkpoints do not "
+                "transfer from yolov8n)",
+))
+register(ModelSpec(
+    "yolov8s", lambda: YOLOv8(yolov8s_config()),
+    input_size=640, preprocess="letterbox", kind="detect",
+    description="small-variant detection",
+))
+register(ModelSpec(
+    "resnet50", lambda: ResNet(ResNetConfig()),
+    input_size=224, preprocess="classify", kind="embed",
+    description="config 3: 16-stream re-ID feature extraction",
+))
+register(ModelSpec(
+    "vit_b16", lambda: ViT(ViTConfig()),
+    input_size=224, preprocess="classify", kind="classify",
+    description="config 4: 32-stream frame tagging",
+))
+register(ModelSpec(
+    "videomae_b", lambda: VideoMAE(VideoMAEConfig()),
+    input_size=224, preprocess="clip", kind="video", clip_len=8,
+    description="config 5: 8-frame clip action recognition",
+))
+register(ModelSpec(
+    "videomae_b_long", lambda: VideoMAE(VideoMAEConfig(num_frames=64)),
+    input_size=224, preprocess="clip", kind="video", clip_len=64,
+    description="long-context clips: 64 frames -> 6272 tokens, attention "
+                "auto-dispatches to the Pallas flash kernel",
+))
+
+# --- tiny twins (tests / CI on CPU) --------------------------------------
+
+register(ModelSpec(
+    "tiny_mobilenet_v2", lambda: MobileNetV2(tiny_mobilenet_v2_config()),
+    input_size=32, preprocess="classify", kind="classify",
+))
+register(ModelSpec(
+    "tiny_yolov8", lambda: YOLOv8(tiny_yolov8_config()),
+    input_size=64, preprocess="letterbox", kind="detect",
+))
+register(ModelSpec(
+    "tiny_resnet", lambda: ResNet(tiny_resnet_config()),
+    input_size=32, preprocess="classify", kind="embed",
+))
+register(ModelSpec(
+    "tiny_vit", lambda: ViT(tiny_vit_config()),
+    input_size=32, preprocess="classify", kind="classify",
+))
+register(ModelSpec(
+    "tiny_videomae", lambda: VideoMAE(tiny_videomae_config()),
+    input_size=32, preprocess="clip", kind="video", clip_len=4,
+))

@@ -1,0 +1,74 @@
+"""ResNet-50 feature extractor / classifier — BASELINE config 3 (16-stream
+re-ID features).
+
+Bottleneck-v1.5 (stride on the 3×3) in NHWC bf16. `features_only=True` at
+call time returns the pooled 2048-d embedding instead of logits — config 3
+consumes embeddings, config 1-style classification consumes logits; one set
+of params serves both.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import ConvBN, Dtype, adaptive_avg_pool
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
+    width: int = 64
+
+
+def tiny_resnet_config(num_classes: int = 10) -> ResNetConfig:
+    return ResNetConfig(num_classes=num_classes, stage_sizes=(1, 1), width=16)
+
+
+class Bottleneck(nn.Module):
+    features: int      # inner width; output is 4×
+    stride: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        out_ch = self.features * 4
+        residual = x
+        h = ConvBN(self.features, kernel=1, act="relu", dtype=self.dtype, name="conv1")(x, train)
+        h = ConvBN(self.features, kernel=3, stride=self.stride, act="relu", dtype=self.dtype, name="conv2")(h, train)
+        h = ConvBN(out_ch, kernel=1, act="identity", dtype=self.dtype, name="conv3")(h, train)
+        if residual.shape[-1] != out_ch or self.stride != 1:
+            residual = ConvBN(
+                out_ch, kernel=1, stride=self.stride, act="identity",
+                dtype=self.dtype, name="downsample",
+            )(x, train)
+        return nn.relu(h + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, train: bool = False, features_only: bool = False
+    ) -> jnp.ndarray:
+        c = self.cfg
+        x = x.astype(self.dtype)
+        x = ConvBN(c.width, kernel=7, stride=2, act="relu", dtype=self.dtype, name="stem")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for si, n_blocks in enumerate(c.stage_sizes):
+            feats = c.width * (2 ** si)
+            for bi in range(n_blocks):
+                x = Bottleneck(
+                    feats, stride=2 if (bi == 0 and si > 0) else 1,
+                    dtype=self.dtype, name=f"stage{si}_block{bi}",
+                )(x, train)
+        x = adaptive_avg_pool(x)
+        if features_only:
+            return x
+        return nn.Dense(c.num_classes, dtype=jnp.float32, name="classifier")(x)

@@ -1,0 +1,51 @@
+"""JAX/Pallas TPU ops: the compute primitives of the inference plane.
+
+The reference framework ships raw BGR24 frames to external CPU clients and
+leaves preprocessing/inference/postprocessing to them (e.g. OpenCV in
+``examples/opencv_display.py:19``). Here those stages are first-class,
+XLA-compiled device ops:
+
+- ``preprocess`` — uint8 H2D then resize/normalize/letterbox *inside* the
+  jitted graph (1 byte/pixel over PCIe, bf16 on device).
+- ``boxes``     — box-format conversion + IoU (building blocks for the head
+  decode and NMS).
+- ``nms``       — fixed-iteration greedy NMS: a Pallas TPU kernel with an
+  exact XLA (``lax.fori_loop``) twin for CPU/interpret execution.
+- ``augment``   — training-time augmentations (mosaic, flip, color jitter,
+  cutout) that run inside the jitted train step: static shapes, PRNG-keyed.
+"""
+
+from .augment import (
+    augment_detection_batch, color_jitter, cutout, mosaic4, random_hflip,
+)
+from .boxes import box_iou_matrix, cxcywh_to_xyxy, xyxy_to_cxcywh
+from .nms import batched_nms, nms_keep_mask, nms_keep_mask_pallas, nms_keep_mask_xla
+from .preprocess import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    letterbox_params,
+    preprocess_classify,
+    preprocess_clip,
+    preprocess_letterbox,
+)
+
+__all__ = [
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+    "augment_detection_batch",
+    "batched_nms",
+    "box_iou_matrix",
+    "color_jitter",
+    "cutout",
+    "cxcywh_to_xyxy",
+    "letterbox_params",
+    "mosaic4",
+    "nms_keep_mask",
+    "nms_keep_mask_pallas",
+    "nms_keep_mask_xla",
+    "preprocess_classify",
+    "preprocess_clip",
+    "preprocess_letterbox",
+    "random_hflip",
+    "xyxy_to_cxcywh",
+]

@@ -1,0 +1,197 @@
+"""Device-side training augmentations — jittable, static-shape, batched.
+
+The reference has no training, so no augmentation pipeline (SURVEY.md §5.4
+— "no model checkpoints (no models)"); CPU frameworks bolt one onto the
+data loader. On TPU the idiomatic place is *inside the jitted train step*:
+the host ships raw uint8 batches (`data/segments.py`) and every random
+transform runs on-device, fused by XLA, keyed by the step's PRNG — zero
+host-side image work, bitwise-reproducible given the key.
+
+All transforms keep static shapes (CLAUDE.md convention): geometry changes
+are expressed as flips (reverse), dynamic_slice with *traced offsets but
+static sizes* (mosaic, cutout), and arithmetic on box coordinates — no
+data-dependent shapes ever reach XLA.
+
+Detection boxes ride along: `[B, N, 4]` xyxy with `[B, N]` validity
+(padded slots), matching `models/detect_loss.py`'s target format.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def random_hflip(
+    key: jax.Array,
+    images: jnp.ndarray,
+    boxes: Optional[jnp.ndarray] = None,
+):
+    """Per-sample coin-flip horizontal mirror. images [B, H, W, C];
+    boxes [B, N, 4] xyxy in pixels (optional)."""
+    b, _, w, _ = images.shape
+    flip = jax.random.bernoulli(key, 0.5, (b,))
+    flipped = images[:, :, ::-1, :]
+    out = jnp.where(flip[:, None, None, None], flipped, images)
+    if boxes is None:
+        return out, None
+    x1, y1, x2, y2 = (boxes[..., i] for i in range(4))
+    fb = jnp.stack([w - x2, y1, w - x1, y2], axis=-1)
+    return out, jnp.where(flip[:, None, None], fb, boxes)
+
+
+def color_jitter(
+    key: jax.Array,
+    images: jnp.ndarray,
+    brightness: float = 0.2,
+    contrast: float = 0.2,
+    saturation: float = 0.4,
+) -> jnp.ndarray:
+    """YOLO-style photometric jitter on float images in [0, 1]:
+    per-sample brightness/contrast/saturation gains, uniformly drawn in
+    ``1 ± strength``. Grayscale axis for saturation is the luma mean."""
+    kb, kc, ks = jax.random.split(key, 3)
+    b = images.shape[0]
+    x = images.astype(jnp.float32)
+
+    def gains(k, s):
+        return jax.random.uniform(
+            k, (b, 1, 1, 1), minval=1.0 - s, maxval=1.0 + s
+        )
+
+    x = x * gains(kb, brightness)
+    mean = x.mean(axis=(1, 2, 3), keepdims=True)
+    x = (x - mean) * gains(kc, contrast) + mean
+    gray = x.mean(axis=-1, keepdims=True)
+    x = (x - gray) * gains(ks, saturation) + gray
+    return jnp.clip(x, 0.0, 1.0).astype(images.dtype)
+
+
+def cutout(
+    key: jax.Array,
+    images: jnp.ndarray,
+    size_frac: float = 0.25,
+    fill: float = 0.5,
+) -> jnp.ndarray:
+    """Random-erasing: one ``size_frac``-sized square per sample is filled
+    with ``fill``. Static mask size, traced offsets (iota compare — no
+    scatter, no dynamic shapes)."""
+    b, h, w, _ = images.shape
+    ch = max(1, int(h * size_frac))
+    cw = max(1, int(w * size_frac))
+    ky, kx = jax.random.split(key)
+    y0 = jax.random.randint(ky, (b,), 0, h - ch + 1)
+    x0 = jax.random.randint(kx, (b,), 0, w - cw + 1)
+    ys = jnp.arange(h)[None, :, None]
+    xs = jnp.arange(w)[None, None, :]
+    inside = (
+        (ys >= y0[:, None, None]) & (ys < (y0 + ch)[:, None, None])
+        & (xs >= x0[:, None, None]) & (xs < (x0 + cw)[:, None, None])
+    )
+    return jnp.where(inside[..., None], jnp.asarray(fill, images.dtype), images)
+
+
+def mosaic4(
+    key: jax.Array,
+    images: jnp.ndarray,
+    boxes: jnp.ndarray,
+    valid: jnp.ndarray,
+    labels: Optional[jnp.ndarray] = None,
+):
+    """YOLO mosaic: each output sample is a 2×2 collage of four batch
+    samples, randomly shifted, cropped back to the input size.
+
+    images [B, H, W, C] (B a multiple of 4 is not required — partners are a
+    batch roll, so every sample stays used exactly 3 extra times);
+    boxes [B, N, 4] xyxy px; valid [B, N] bool; labels [B, N] int (optional
+    — it must ride along through the same batch roll as its boxes, so
+    callers cannot reproduce it with a tile). Returns the same shapes with
+    N' = 4N box slots (plus labels' counterpart when given).
+
+    Static-shape recipe: build the [2H, 2W] collage with static placement,
+    then ``dynamic_slice`` an [H, W] window at a traced offset. Boxes are
+    translated per quadrant, shifted by the crop, and re-validated by
+    post-crop area (degenerate slivers are masked out, not removed — the
+    slot count stays static)."""
+    b, h, w, c = images.shape
+    n = boxes.shape[1]
+    # partners: batch rolled by 1..3 — static gather-free pairing
+    quad_imgs = [images] + [jnp.roll(images, -i, axis=0) for i in range(1, 4)]
+    quad_boxes = [boxes] + [jnp.roll(boxes, -i, axis=0) for i in range(1, 4)]
+    quad_valid = [valid] + [jnp.roll(valid, -i, axis=0) for i in range(1, 4)]
+    all_labels = None
+    if labels is not None:
+        all_labels = jnp.concatenate(
+            [labels] + [jnp.roll(labels, -i, axis=0) for i in range(1, 4)],
+            axis=1,
+        )
+
+    top = jnp.concatenate([quad_imgs[0], quad_imgs[1]], axis=2)
+    bot = jnp.concatenate([quad_imgs[2], quad_imgs[3]], axis=2)
+    collage = jnp.concatenate([top, bot], axis=1)          # [B, 2H, 2W, C]
+
+    offsets = jnp.asarray(
+        [[0, 0], [0, w], [h, 0], [h, w]], jnp.float32
+    )                                                       # per quadrant (y, x)
+    all_boxes = jnp.concatenate(
+        [qb + jnp.asarray([ox, oy, ox, oy], jnp.float32)
+         for qb, (oy, ox) in zip(quad_boxes, offsets)],
+        axis=1,
+    )                                                       # [B, 4N, 4]
+    all_valid = jnp.concatenate(quad_valid, axis=1)         # [B, 4N]
+
+    ky, kx = jax.random.split(key)
+    y0 = jax.random.randint(ky, (b,), 0, h + 1)             # crop origin in collage
+    x0 = jax.random.randint(kx, (b,), 0, w + 1)
+
+    def crop_one(img, yy, xx):
+        return lax.dynamic_slice(img, (yy, xx, 0), (h, w, c))
+
+    out = jax.vmap(crop_one)(collage, y0, x0)
+
+    shift = jnp.stack([x0, y0, x0, y0], axis=-1).astype(jnp.float32)
+    bx = all_boxes - shift[:, None, :]
+    bx = jnp.stack([
+        bx[..., 0].clip(0, w), bx[..., 1].clip(0, h),
+        bx[..., 2].clip(0, w), bx[..., 3].clip(0, h),
+    ], axis=-1)
+    area = (bx[..., 2] - bx[..., 0]) * (bx[..., 3] - bx[..., 1])
+    ok = all_valid & (area > 4.0)                           # drop slivers
+    if all_labels is not None:
+        return out, bx, ok, all_labels
+    return out, bx, ok
+
+
+def augment_detection_batch(
+    key: jax.Array,
+    images: jnp.ndarray,
+    boxes: jnp.ndarray,
+    valid: jnp.ndarray,
+    labels: Optional[jnp.ndarray] = None,
+    *,
+    use_mosaic: bool = True,
+):
+    """The standard detection-training recipe, composed: mosaic → hflip →
+    color jitter → cutout. Call INSIDE the jitted train step with that
+    step's PRNG key; everything runs on-device. images float [0,1].
+
+    Returns (images, boxes, valid) — with labels appended when given
+    (labels MUST go through here when mosaic is on: the box slots
+    quadruple via a batch roll the caller cannot reproduce with a tile).
+    """
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    if use_mosaic:
+        if labels is not None:
+            images, boxes, valid, labels = mosaic4(
+                k1, images, boxes, valid, labels)
+        else:
+            images, boxes, valid = mosaic4(k1, images, boxes, valid)
+    images, boxes = random_hflip(k2, images, boxes)
+    images = color_jitter(k3, images)
+    images = cutout(k4, images)
+    if labels is not None:
+        return images, boxes, valid, labels
+    return images, boxes, valid

@@ -1,0 +1,62 @@
+"""Box utilities (format conversion, IoU) as pure jittable functions.
+
+These are the building blocks for the detector head decode (models/yolov8)
+and NMS (ops/nms). All functions take/return plain ``jnp`` arrays, carry no
+state, and are shape-polymorphic only in the leading (batch/box-count) axes —
+inner shapes are static so XLA can tile them.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cxcywh_to_xyxy(boxes: jnp.ndarray) -> jnp.ndarray:
+    """[..., 4] (cx, cy, w, h) -> (x1, y1, x2, y2)."""
+    cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+    half_w, half_h = w * 0.5, h * 0.5
+    return jnp.concatenate(
+        [cx - half_w, cy - half_h, cx + half_w, cy + half_h], axis=-1
+    )
+
+
+def xyxy_to_cxcywh(boxes: jnp.ndarray) -> jnp.ndarray:
+    """[..., 4] (x1, y1, x2, y2) -> (cx, cy, w, h)."""
+    x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate(
+        [(x1 + x2) * 0.5, (y1 + y2) * 0.5, x2 - x1, y2 - y1], axis=-1
+    )
+
+
+def box_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    """[..., 4] xyxy -> [...] area (clamped at 0 for degenerate boxes)."""
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0], 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1], 0.0)
+    return w * h
+
+
+def box_iou_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU. a: [N, 4] xyxy, b: [M, 4] xyxy -> [N, M] float32.
+
+    Fully vectorized (one broadcasted min/max + multiply) so XLA maps it onto
+    the VPU; no data-dependent control flow.
+    """
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])  # [N, M, 2]
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])  # [N, M, 2]
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(a)[:, None] + box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def dist_to_bbox(distances: jnp.ndarray, anchor_points: jnp.ndarray) -> jnp.ndarray:
+    """Anchor-free head decode: per-anchor (l, t, r, b) distances -> xyxy.
+
+    distances: [..., A, 4], anchor_points: [A, 2] (x, y) in feature-grid
+    units already scaled by stride. This is the standard DFL-regression
+    decode used by modern anchor-free detectors (BASELINE config 2).
+    """
+    lt, rb = distances[..., :2], distances[..., 2:]
+    x1y1 = anchor_points - lt
+    x2y2 = anchor_points + rb
+    return jnp.concatenate([x1y1, x2y2], axis=-1)

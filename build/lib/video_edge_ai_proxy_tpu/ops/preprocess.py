@@ -1,0 +1,168 @@
+"""On-device preprocessing: uint8 frames in, model-ready bf16 batches out.
+
+Design (SURVEY.md §7 hard part 2 — H2D bandwidth): frames cross PCIe as
+uint8 NHWC BGR24 exactly as they sit on the frame bus (1 byte/px; 16×1080p
+×30fps ≈ 186 MB/s instead of 745 MB/s as f32). Everything downstream —
+BGR→RGB flip, cast, resize, normalize, dtype pack — happens inside the jitted
+graph so XLA fuses it into the first conv's input pipeline.
+
+The reference leaves all of this to external clients (``README.md:202``
+documents raw BGR24 on the bus; ``examples/opencv_display.py:46-53`` rebuilds
+the numpy array client-side). Here it is a device op.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Standard ImageNet statistics (RGB order), used by every classifier in the
+# model zoo.
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+@functools.lru_cache(maxsize=64)
+def _resize_matrix(src: int, dst: int) -> np.ndarray:
+    """[dst, src] bilinear resize matrix (antialiased triangle filter for
+    downscaling, matching jax.image.resize(method='bilinear') semantics:
+    half-pixel centers, per-row weight normalization)."""
+    scale = src / dst
+    s = max(1.0, scale)                 # antialias: widen kernel when shrinking
+    out = np.zeros((dst, src), np.float32)
+    for o in range(dst):
+        center = (o + 0.5) * scale - 0.5
+        lo = int(np.floor(center - s)) + 1
+        hi = int(np.ceil(center + s))
+        idx = np.arange(lo, hi + 1)
+        w = np.maximum(0.0, 1.0 - np.abs(idx - center) / s)
+        valid = (idx >= 0) & (idx < src)
+        idx, w = idx[valid], w[valid]
+        out[o, idx] = w / w.sum()
+    return out
+
+
+def resize_bilinear_mxu(x: jnp.ndarray, dst_hw: tuple[int, int]) -> jnp.ndarray:
+    """Separable bilinear resize as two dense matmuls.
+
+    [N, H, W, C] -> [N, h, w, C]. On TPU a gather-based image resize of
+    full-HD frames is HBM-layout-bound (~4.5 ms for 16x1080p); expressing
+    the same linear map as [h,H] and [w,W] contractions puts it on the MXU
+    (~2 ms measured, bounded by the u8->bf16 cast). Weights are trace-time
+    constants (lru-cached per geometry).
+    """
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        raise TypeError(
+            f"resize_bilinear_mxu needs a float input, got {x.dtype}; "
+            "scale uint8 frames first (frames.astype(...) / 255)"
+        )
+    h, w = x.shape[1], x.shape[2]
+    th, tw = dst_hw
+    if (h, w) == (th, tw):
+        return x
+    rh = jnp.asarray(_resize_matrix(h, th), x.dtype)
+    rw = jnp.asarray(_resize_matrix(w, tw), x.dtype)
+    y = jnp.einsum("hH,nHWc->nhWc", rh, x)
+    return jnp.einsum("wW,nhWc->nhwc", rw, y)
+
+
+def preprocess_classify(
+    frames_u8: jnp.ndarray,
+    size: tuple[int, int] = (224, 224),
+    mean: tuple[float, ...] = IMAGENET_MEAN,
+    std: tuple[float, ...] = IMAGENET_STD,
+    out_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Classifier path: [N, H, W, 3] uint8 BGR -> [N, h, w, 3] normalized.
+
+    Resize is plain bilinear (stretch, no aspect preservation) — matching
+    what CPU clients of the reference typically do before a classifier.
+    """
+    x = frames_u8.astype(out_dtype) * (1.0 / 255.0)
+    x = resize_bilinear_mxu(x, size)[..., ::-1]          # BGR -> RGB, small
+    mean_a = jnp.asarray(mean, dtype=jnp.float32)
+    inv_std = jnp.asarray([1.0 / s for s in std], dtype=jnp.float32)
+    x = (x.astype(jnp.float32) - mean_a) * inv_std
+    return x.astype(out_dtype)
+
+
+def preprocess_clip(
+    clips_u8: jnp.ndarray,
+    size: tuple[int, int] = (224, 224),
+    mean: tuple[float, ...] = IMAGENET_MEAN,
+    std: tuple[float, ...] = IMAGENET_STD,
+    out_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Video path (BASELINE config 5): [N, T, H, W, 3] uint8 -> normalized.
+
+    The temporal axis is just an extra leading axis folded into the batch for
+    the resize (SURVEY.md §5.7 — clip length 8 needs no sequence tricks at
+    preprocess time).
+    """
+    n, t = clips_u8.shape[:2]
+    flat = clips_u8.reshape((n * t,) + clips_u8.shape[2:])
+    out = preprocess_classify(flat, size=size, mean=mean, std=std, out_dtype=out_dtype)
+    return out.reshape((n, t) + out.shape[1:])
+
+
+class LetterboxParams(NamedTuple):
+    """Static geometry of a letterbox resize — needed to map detector boxes
+    back to source-frame pixel coordinates."""
+
+    scale: float      # source px * scale = letterboxed px
+    pad_x: float      # left padding in letterboxed px
+    pad_y: float      # top padding in letterboxed px
+    new_w: int
+    new_h: int
+
+
+def letterbox_params(src_hw: tuple[int, int], dst: int) -> LetterboxParams:
+    """Compute letterbox geometry for a (static) source shape.
+
+    Shapes are static per batch bucket, so this runs in Python at trace time
+    and bakes constants into the graph — no dynamic shapes reach XLA.
+    """
+    h, w = src_hw
+    scale = min(dst / h, dst / w)
+    new_h, new_w = int(round(h * scale)), int(round(w * scale))
+    pad_y = (dst - new_h) / 2.0
+    pad_x = (dst - new_w) / 2.0
+    return LetterboxParams(scale, pad_x, pad_y, new_w, new_h)
+
+
+def preprocess_letterbox(
+    frames_u8: jnp.ndarray,
+    dst: int = 640,
+    pad_value: float = 114.0 / 255.0,
+    out_dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[jnp.ndarray, LetterboxParams]:
+    """Detector path: [N, H, W, 3] uint8 BGR -> [N, dst, dst, 3] letterboxed
+    RGB in [0, 1] (the YOLO-family input convention), plus the geometry to
+    undo it on output boxes.
+    """
+    params = letterbox_params(frames_u8.shape[1:3], dst)
+    x = frames_u8.astype(out_dtype) * (1.0 / 255.0)
+    x = resize_bilinear_mxu(x, (params.new_h, params.new_w))[..., ::-1]
+    top = int(round(params.pad_y))
+    left = int(round(params.pad_x))
+    x = jnp.pad(
+        x,
+        ((0, 0), (top, dst - params.new_h - top), (left, dst - params.new_w - left), (0, 0)),
+        constant_values=pad_value,
+    )
+    return x.astype(out_dtype), params
+
+
+def unletterbox_boxes(
+    boxes_xyxy: jnp.ndarray, params: LetterboxParams
+) -> jnp.ndarray:
+    """Map detector-output xyxy boxes (letterboxed px) back to source px."""
+    shift = jnp.asarray(
+        [params.pad_x, params.pad_y, params.pad_x, params.pad_y],
+        dtype=boxes_xyxy.dtype,
+    )
+    return (boxes_xyxy - shift) / params.scale

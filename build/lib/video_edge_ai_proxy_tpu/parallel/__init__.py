@@ -1,0 +1,31 @@
+"""Device-parallelism: mesh, sharding rules, ring attention, train step.
+
+The TPU-native replacement for the distributed story in SURVEY.md §2.3/§2.4
+— data parallel over cameras (P7), plus fsdp/tp/sp/ep axes the reference
+never had, all expressed as shardings over one `jax.sharding.Mesh`.
+"""
+
+from . import pipeline
+from .distributed import initialize as initialize_distributed
+from .mesh import AXES, factor_mesh, make_mesh, single_device_mesh
+from .ring_attention import make_ring_attn_fn, ring_attention_local
+from .sharding import (
+    DEFAULT_RULES, batch_sharding, param_shardings, place_params, replicated,
+    unbox,
+)
+from .train import (
+    TrainState, Trainer, cross_entropy_loss, make_trainer,
+    with_ring_attention, with_ulysses_attention,
+)
+from .ulysses import make_ulysses_attn_fn, ulysses_attention_local
+
+__all__ = [
+    "AXES", "factor_mesh", "make_mesh", "single_device_mesh",
+    "initialize_distributed", "pipeline",
+    "make_ring_attn_fn", "ring_attention_local",
+    "make_ulysses_attn_fn", "ulysses_attention_local",
+    "DEFAULT_RULES", "batch_sharding", "param_shardings", "place_params",
+    "replicated", "unbox",
+    "TrainState", "Trainer", "cross_entropy_loss", "make_trainer",
+    "with_ring_attention", "with_ulysses_attention",
+]

@@ -1,0 +1,62 @@
+"""Multi-host (DCN) initialization.
+
+The reference scales across hosts by running one full stack per edge box —
+there is no inter-host compute fabric (SURVEY.md §2.4: Redis + gRPC only).
+This framework adds one: for a multi-host TPU slice, every host calls
+`initialize()` before any jax op, after which `jax.devices()` spans the
+slice and the same `parallel.make_mesh(...)` code shards across hosts —
+XLA routes collectives over ICI within a slice and DCN between slices.
+Nothing else in the codebase changes: mesh axes don't care where a device
+lives (the scaling-book recipe).
+
+On single-host (or when no coordinator is configured) this is a no-op, so
+the same entrypoint works everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("parallel.distributed")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the jax.distributed cluster; returns True if multi-host.
+
+    Arguments fall back to the standard env contract
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``,
+    matching what TPU pod runtimes inject); with none present this is a
+    single-host no-op.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    if not coordinator_address and (num_processes is None or num_processes <= 1):
+        log.info("single-host: jax.distributed not initialized")
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "joined cluster: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True

@@ -1,0 +1,74 @@
+"""Device mesh construction (SURVEY.md §2.4 — the device-side fabric).
+
+The reference's distributed backend is Redis streams + gRPC between *hosts*
+(`/root/reference/server/grpcapi/grpc_api.go:191-197`); it has no device
+collectives at all. Here the device fabric is a `jax.sharding.Mesh` whose
+axes name the parallelism dimensions:
+
+- ``dp``   data parallel (cameras/batch — P7 in SURVEY.md §2.3)
+- ``fsdp`` parameter sharding (zero-style, rides ICI)
+- ``sp``   sequence/context parallel (ring attention over tokens)
+- ``tp``   tensor parallel (heads / mlp width)
+- ``ep``   expert parallel (MoE experts)
+- ``pp``   pipeline parallel (layer stages — parallel/pipeline.py)
+
+Axes of size 1 are always legal, so single-chip and 256-chip builds share
+every code path: XLA inserts psum/all-gather/ppermute over ICI (intra-slice)
+or DCN (multi-host) from the shardings alone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "fsdp", "sp", "tp", "ep", "pp")
+
+
+def make_mesh(
+    dp: int = 1,
+    fsdp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    ep: int = 1,
+    pp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh over explicit per-axis sizes; product must equal device count."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = (dp, fsdp, sp, tp, ep, pp)
+    need = int(np.prod(shape))
+    if need != len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(AXES, shape))} needs {need} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def factor_mesh(
+    n_devices: Optional[int] = None,
+    prefer: Tuple[str, ...] = ("dp", "sp", "tp"),
+) -> Mesh:
+    """Auto-factor ``n_devices`` into a mesh, splitting powers of two across
+    ``prefer`` axes round-robin (8 -> dp=2, sp=2, tp=2; 4 -> dp=2, sp=2;
+    1 -> all-singleton). Non-power-of-two remainders land on the first axis.
+    """
+    devices = jax.devices()
+    n = n_devices if n_devices is not None else len(devices)
+    sizes = {a: 1 for a in AXES}
+    rem = n
+    i = 0
+    while rem % 2 == 0 and rem > 1:
+        sizes[prefer[i % len(prefer)]] *= 2
+        rem //= 2
+        i += 1
+    sizes[prefer[0]] *= rem
+    return make_mesh(**sizes, devices=devices[:n])
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(devices=jax.devices()[:1])

@@ -1,0 +1,56 @@
+"""Logical-axis → mesh-axis sharding rules.
+
+Model code names its weight axes logically (`models/transformer.py` uses
+"embed"/"qkv"/"mlp" via `nn.with_logical_partitioning`); this module owns
+the single mapping from those names onto mesh axes, so changing the
+parallelism layout never touches a model file — the scaling-book recipe:
+pick a mesh, annotate shardings, let XLA insert the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Default rules: tensor-parallel over head/mlp width, fsdp over embed,
+# experts over ep. Entries absent -> replicated.
+DEFAULT_RULES = (
+    ("embed", "fsdp"),
+    ("qkv", "tp"),
+    ("mlp", "tp"),
+    ("heads", "tp"),
+    ("expert", "ep"),
+    ("batch", "dp"),
+    ("seq", "sp"),
+)
+
+
+def param_shardings(mesh: Mesh, params: Any, rules=DEFAULT_RULES):
+    """Tree of NamedShardings for a (possibly nn.Partitioned-boxed) param
+    tree. Unannotated leaves are fully replicated."""
+    specs = nn.get_partition_spec(params)
+    return nn.logical_to_mesh_sharding(specs, mesh, rules)
+
+
+def batch_sharding(mesh: Mesh, ndim: int, batch_axes=("dp",)) -> NamedSharding:
+    """Shard the leading (batch) dim over ``batch_axes``, replicate the rest."""
+    return NamedSharding(mesh, P(batch_axes, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def unbox(params: Any) -> Any:
+    """Strip nn.Partitioned boxes (for code that wants raw arrays)."""
+    return nn.meta.unbox(params)
+
+
+def place_params(mesh: Mesh, params: Any, rules=DEFAULT_RULES):
+    """Unbox a Partitioned param tree and device-put it onto the mesh per
+    the rules (host -> sharded device buffers)."""
+    shardings = param_shardings(mesh, params, rules)
+    return jax.device_put(unbox(params), shardings)

@@ -1,0 +1,128 @@
+"""Sharded training step (fine-tune / pretrain path).
+
+The reference has nothing to train (SURVEY.md §5.4 — "no model
+checkpoints (no models)"); this module exists because our framework puts
+models on the TPU, and an edge fleet that runs models wants to fine-tune
+them. One train step, jitted over the mesh: data parallel over ``dp``,
+params/optimizer sharded per `sharding.DEFAULT_RULES` (fsdp/tp/ep), and —
+through the encoder's `attn_fn` hook — ring attention over ``sp``.
+Collectives are never written out; they fall out of the shardings.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import sharding as shd
+from .ring_attention import make_ring_attn_fn
+from .ulysses import make_ulysses_attn_fn
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    # Frozen non-param collections (e.g. BatchNorm stats for convnet
+    # fine-tuning with frozen statistics). Not updated by the step.
+    aux: Any = None
+
+
+@dataclass
+class Trainer:
+    """Owns the model, optimizer, mesh, and the compiled train step."""
+
+    model: nn.Module
+    mesh: Mesh
+    tx: optax.GradientTransformation
+    train_step: Callable[[TrainState, jnp.ndarray, jnp.ndarray], tuple]
+
+    def init_state(self, rng: jax.Array, example: jnp.ndarray) -> TrainState:
+        variables = jax.jit(functools.partial(self.model.init, train=False))(
+            rng, example
+        )
+        params = shd.place_params(self.mesh, variables["params"])
+        aux = {k: jax.device_put(shd.unbox(v), shd.replicated(self.mesh))
+               for k, v in variables.items() if k != "params"} or None
+        opt_state = jax.jit(self.tx.init)(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state, aux=aux)
+
+    def shard_batch(self, x: jnp.ndarray) -> jnp.ndarray:
+        return jax.device_put(x, shd.batch_sharding(self.mesh, x.ndim))
+
+
+# Weight on sown auxiliary objectives (e.g. the switch-MoE load-balance
+# loss) — the Switch Transformer default.
+AUX_LOSS_WEIGHT = 0.01
+
+
+def cross_entropy_loss(model: nn.Module, params, aux, batch, labels) -> jnp.ndarray:
+    # BatchNorm models fine-tune with frozen statistics (train=True would
+    # try to mutate the immutable batch_stats collection); stat-less models
+    # (ViT family) get train=True so dropout stays active.
+    train = not (aux and "batch_stats" in aux)
+    # mutable=["losses"] collects nn.sow'd auxiliaries (no-op for models
+    # that sow nothing) so e.g. routed-MoE balance pressure reaches grads.
+    logits, sown = model.apply(
+        {"params": params, **(aux or {})}, batch, train=train,
+        mutable=["losses"],
+    )
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    aux_terms = jax.tree_util.tree_leaves(sown.get("losses", {}))
+    if aux_terms:
+        loss = loss + AUX_LOSS_WEIGHT * sum(jnp.sum(a) for a in aux_terms)
+    return loss
+
+
+def make_trainer(
+    model: nn.Module,
+    mesh: Mesh,
+    learning_rate: float = 1e-4,
+    weight_decay: float = 0.05,
+    loss_fn: Optional[Callable] = None,
+) -> Trainer:
+    """Build a Trainer whose step is jitted over ``mesh``.
+
+    ``loss_fn(model, params, aux, batch, labels) -> scalar`` defaults to
+    softmax cross entropy (classification fine-tune, configs 1/3/4/5);
+    ``aux`` carries frozen non-param collections (BatchNorm stats).
+    """
+    tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    loss_fn = loss_fn or cross_entropy_loss
+
+    def step_fn(state: TrainState, batch, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(model, p, state.aux, batch, labels)
+        )(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(step=state.step + 1, params=params,
+                       opt_state=opt_state, aux=state.aux),
+            loss,
+        )
+
+    train_step = jax.jit(step_fn, donate_argnums=(0,))
+    return Trainer(model=model, mesh=mesh, tx=tx, train_step=train_step)
+
+
+def with_ring_attention(model_cls, cfg, mesh: Mesh, dtype=jnp.bfloat16):
+    """Instantiate an encoder-family model with sequence-parallel attention
+    over the mesh's ``sp`` axis (ViT / VideoMAE both take `attn_fn`)."""
+    return model_cls(cfg, dtype, attn_fn=make_ring_attn_fn(mesh))
+
+
+def with_ulysses_attention(model_cls, cfg, mesh: Mesh, dtype=jnp.bfloat16):
+    """Same hook, all-to-all (Ulysses) sequence parallelism — see
+    `ulysses.py` for the ring-vs-all-to-all trade-off."""
+    return model_cls(cfg, dtype, attn_fn=make_ulysses_attn_fn(mesh))

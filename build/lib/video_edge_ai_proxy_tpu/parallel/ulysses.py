@@ -1,0 +1,96 @@
+"""Ulysses-style all-to-all sequence parallelism over the ``sp`` mesh axis.
+
+The second of the two canonical long-context strategies (the first, ring
+attention, lives in `ring_attention.py`; the reference has neither — it has
+no attention at all, SURVEY.md §5.7). Where ring attention keeps the
+sequence sharded and rotates K/V blocks around the ICI ring, the all-to-all
+form re-shards: each device trades its *sequence* shard for a *head* shard
+(one `lax.all_to_all`), runs plain dense attention over the full sequence
+for its heads, and trades back. Exact full-softmax attention, two
+collectives per call, no blockwise accumulation.
+
+Trade-off vs ring (why both exist):
+- all-to-all moves each token twice regardless of ring size and its local
+  attention is one dense [T, T] block — simpler, and faster when T fits in
+  HBM and the head count divides the ``sp`` size;
+- ring never needs heads to divide the axis, its resident K/V is T/sp of
+  the sequence (longer contexts), and its transfers overlap with compute.
+`make_ulysses_attn_fn` therefore falls back to ring attention whenever the
+*per-device* head count — after ``head_axis`` (tp) sharding, i.e.
+``H / tp`` — does not divide the ``sp`` axis size.
+
+Same `attn_fn` contract as `make_ring_attn_fn`: global [B, T, H, D] in/out,
+drop-in for the encoder hook (`models/transformer.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from .ring_attention import (
+    _NEG, make_seq_parallel_attn_fn, ring_attention_local,
+)
+
+
+def ulysses_attention_local(
+    q, k, v, axis_name: str = "sp", true_t: Optional[int] = None
+):
+    """Attention over a sequence sharded on ``axis_name``; call under
+    shard_map. q/k/v: local shards [B, T_local, H, D] with H divisible by
+    the axis size.
+
+    ``true_t``: global unpadded token count; key positions >= true_t (the
+    right-pad that makes T divide the axis size) are masked out of the
+    softmax. Unlike the ring form, every device sees the whole (gathered)
+    sequence, so the mask is a plain global-position compare.
+    """
+    # seq-shard -> head-shard: split heads n ways, gather the sequence.
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if true_t is not None:
+        key_valid = jnp.arange(q.shape[1]) < true_t
+        logits = jnp.where(key_valid[None, None, None, :], logits, _NEG)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v)
+
+    # head-shard -> seq-shard: the inverse exchange.
+    out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                         tiled=True)
+    return out.astype(q.dtype)
+
+
+def make_ulysses_attn_fn(
+    mesh: Mesh,
+    batch_axis: Optional[str] = "dp",
+    seq_axis: str = "sp",
+    head_axis: Optional[str] = "tp",
+):
+    """Build an all-to-all sequence-parallel `attn_fn`: global [B, T, H, D]
+    in/out, sequence over ``seq_axis``, heads over ``head_axis`` (both
+    compose: with tp head-sharding the all-to-all further scatters each
+    device's H/tp heads across ``sp``).
+
+    Shares `make_seq_parallel_attn_fn`'s padding/fallback wrapper with the
+    ring form; the only variant-specific decision is the local body — when
+    the per-device head count does not divide the ``seq_axis`` size the
+    heads cannot be scattered, and that call runs ring attention instead
+    (identical contract and shardings, invisible to the model).
+    """
+    n_sp = mesh.shape[seq_axis]
+    return make_seq_parallel_attn_fn(
+        mesh,
+        lambda h_local: (
+            ulysses_attention_local if h_local % n_sp == 0
+            else ring_attention_local
+        ),
+        batch_axis=batch_axis, seq_axis=seq_axis, head_axis=head_axis,
+    )

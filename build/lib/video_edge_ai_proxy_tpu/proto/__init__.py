@@ -1,0 +1,4 @@
+from . import video_streaming_pb2 as pb  # noqa: F401
+from . import video_streaming_pb2_grpc as pb_grpc  # noqa: F401
+
+__all__ = ["pb", "pb_grpc"]

@@ -1,0 +1,16 @@
+from .models import ProcessState, RTMPStreamStatus, Settings, StreamProcess
+from .process_manager import ProcessError, ProcessManager
+from .settings import SettingsManager
+from .storage import NotFound, Storage
+
+__all__ = [
+    "ProcessError",
+    "ProcessManager",
+    "ProcessState",
+    "RTMPStreamStatus",
+    "Settings",
+    "SettingsManager",
+    "NotFound",
+    "Storage",
+    "StreamProcess",
+]

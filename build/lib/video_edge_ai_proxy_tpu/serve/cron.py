@@ -1,0 +1,89 @@
+"""Scheduled maintenance jobs.
+
+Reference (``server/cron_jobs.go:38-83``): when the disk buffer is enabled, a
+cron walks the archive folder on ``on_disk_schedule`` and deletes segments
+older than ``on_disk_clean_older_than``. Durations use the reference's Go-style
+strings ("5m", "1h30m", "@every 5m")."""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+
+from ..utils.logging import get_logger
+
+log = get_logger("serve.cron")
+
+_DUR_RE = re.compile(r"(\d+(?:\.\d+)?)(ms|h|m|s)")  # ms before m: greedy alt
+_UNIT_S = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001}
+
+
+def parse_duration(spec: str) -> float:
+    """Parse a Go-style duration ('5m', '1h30m', '90s') or '@every <dur>'
+    schedule into seconds."""
+    spec = spec.strip()
+    if spec.startswith("@every"):
+        spec = spec[len("@every"):].strip()
+    matches = _DUR_RE.findall(spec)
+    if not matches or _DUR_RE.sub("", spec).strip():
+        raise ValueError(f"cannot parse duration {spec!r}")
+    return sum(float(n) * _UNIT_S[u] for n, u in matches)
+
+
+def cleanup_archive(folder: str, older_than_s: float, *, now: float | None = None,
+                    suffixes: tuple[str, ...] = (".mp4", ".npz")) -> int:
+    """Delete archived segments older than the cutoff; returns count removed
+    (reference ``startOnDiskCleanup``, ``cron_jobs.go:49-74``)."""
+    now = now if now is not None else time.time()
+    removed = 0
+    for root, _dirs, files in os.walk(folder):
+        for name in files:
+            if not name.endswith(suffixes):
+                continue
+            path = os.path.join(root, name)
+            try:
+                if now - os.path.getmtime(path) > older_than_s:
+                    os.unlink(path)
+                    removed += 1
+            except OSError:
+                continue
+    if removed:
+        log.info("archive cleanup removed %d segments from %s", removed, folder)
+    return removed
+
+
+class CronJobs:
+    """Background scheduler thread (reference ``StartCronJobs``,
+    ``cron_jobs.go:21-47``)."""
+
+    def __init__(self, buffer_cfg):
+        self._cfg = buffer_cfg
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if not self._cfg.on_disk:
+            return
+        interval = parse_duration(self._cfg.on_disk_schedule)
+        older = parse_duration(self._cfg.on_disk_clean_older_than)
+
+        def run() -> None:
+            while not self._stop.wait(interval):
+                try:
+                    cleanup_archive(self._cfg.on_disk_folder, older)
+                except Exception as exc:
+                    log.error("archive cleanup failed: %s", exc)
+
+        self._thread = threading.Thread(target=run, name="cron-cleanup", daemon=True)
+        self._thread.start()
+        log.info(
+            "cron: cleaning %s every %ss (older than %ss)",
+            self._cfg.on_disk_folder, interval, older,
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

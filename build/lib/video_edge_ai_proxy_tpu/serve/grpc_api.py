@@ -1,0 +1,244 @@
+"""gRPC ``Image`` service — the data/ML plane (:50001).
+
+Wire + behavior parity with the reference handler
+(``server/grpcapi/grpc_api.go``), with the SURVEY.md §3.2 quirks resolved the
+way the survey prescribes:
+
+- ``VideoLatestImage`` (bidi): per request, persist the keyframe-only flag and
+  the last-query timestamp to the control plane (``grpc_api.go:159-175``), read
+  the newest frame past the connection's cursor with a bounded retry loop
+  (``:187-229``: <=3 attempts, short sleeps, latest-frame-wins), send it.
+  Cursors are **per-connection** (fixing the shared ``deviceMap`` race,
+  ``grpc_api.go:42,182``). The stream deadline (reference hard-codes 15 s,
+  ``:135``) is configurable.
+- ``ListStreams``: streams one health record per registered camera
+  (``grpc_api.go:100-131``), sourced from the worker heartbeat + supervisor
+  state instead of Docker inspect.
+- ``Annotate``: edge-key required, ±7-day timestamp window, ack-on-enqueue
+  into the uplink queue (``grpc_annotation_api.go:16-56``).
+- ``Proxy`` / ``Storage``: toggle RTMP pass-through / cloud storage
+  (``grpc_proxy_api.go``, ``grpc_storage_api.go``).
+- ``Inference`` (new): server-streams TPU inference results.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Iterator, Optional
+
+import grpc
+
+from ..bus import FrameBus
+from ..proto import pb
+from ..uplink.queue import AnnotationQueue
+from ..utils.logging import get_logger
+from ..utils.parsing import parse_rtmp_key
+from .process_manager import ProcessError, ProcessManager
+from .settings import SettingsManager
+
+log = get_logger("serve.grpc")
+
+FRAME_WAIT_RETRIES = 3          # reference grpc_api.go:187 (retry <= 3)
+FRAME_WAIT_SLEEP_S = 0.016     # reference 16 ms sleep between tries (:228)
+FRAME_BLOCK_S = 1.0            # reference XREAD Block=1s (:191)
+ANNOTATION_TS_WINDOW_MS = 7 * 24 * 3600 * 1000  # ±7 days (:26-33)
+
+
+class ImageServicer:
+    def __init__(
+        self,
+        bus: FrameBus,
+        process_manager: ProcessManager,
+        settings: SettingsManager,
+        annotations: AnnotationQueue,
+        engine=None,                      # Optional[InferenceEngine]
+        stream_deadline_s: float = 15.0,  # reference hard 15 s (:135)
+        api_endpoint: str = "",
+    ):
+        self._bus = bus
+        self._pm = process_manager
+        self._settings = settings
+        self._annotations = annotations
+        self._engine = engine
+        self._deadline = stream_deadline_s
+        self._api_endpoint = api_endpoint
+
+    # -- VideoLatestImage: the hot path --
+
+    def VideoLatestImage(
+        self, request_iterator: Iterator[pb.VideoFrameRequest], context
+    ) -> Iterator[pb.VideoFrame]:
+        started = time.monotonic()
+        cursors: dict[str, int] = {}  # per-connection (fixes ref shared cursor)
+        for req in request_iterator:
+            if (
+                self._deadline > 0
+                and time.monotonic() - started > self._deadline
+            ):
+                # Clients run reconnect loops, as with the reference's 15 s
+                # stream deadline (examples/opencv_display.py:43).
+                context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED, "stream deadline reached"
+                )
+            device_id = req.device_id
+            self._bus.set_keyframe_only(device_id, req.key_frame_only)
+            self._bus.touch_query(device_id)
+            frame = self._wait_latest(device_id, cursors.get(device_id, 0))
+            if frame is None:
+                continue  # reference sends nothing on a miss and serves the
+                # next request (grpc_api.go:223-229)
+            cursors[device_id] = frame.seq
+            yield _frame_to_proto(device_id, frame)
+
+    def _wait_latest(self, device_id: str, cursor: int):
+        for attempt in range(FRAME_WAIT_RETRIES):
+            deadline = time.monotonic() + FRAME_BLOCK_S
+            while time.monotonic() < deadline:
+                frame = self._bus.read_latest(device_id, min_seq=cursor)
+                if frame is not None:
+                    return frame
+                time.sleep(0.002)
+            if attempt < FRAME_WAIT_RETRIES - 1:
+                time.sleep(FRAME_WAIT_SLEEP_S)
+        return None
+
+    # -- ListStreams --
+
+    def ListStreams(self, request, context) -> Iterator[pb.ListStream]:
+        now_ms = int(time.time() * 1000)
+        for record in self._pm.list():
+            state = record.state
+            status_raw = self._bus.kv_get("stream_status_" + record.name)
+            hb = json.loads(status_raw) if status_raw else {}
+            # A heartbeat older than 5 s is stale — a crashed worker must not
+            # report healthy off its last written status.
+            fresh = now_ms - hb.get("ts_ms", 0) < 5000
+            health = "healthy" if (fresh and hb.get("fps", 0) > 0) else (
+                "starting" if state and state.running else "unhealthy"
+            )
+            yield pb.ListStream(
+                name=record.name,
+                status=record.status,
+                failing_streak=state.failing_streak if state else 0,
+                health_status=health,
+                dead=state.dead if state else False,
+                exit_code=state.exit_code if state else 0,
+                pid=state.pid if state else 0,
+                running=state.running if state else False,
+                paused=False,
+                restarting=state.restarting if state else False,
+                oomkilled=state.oom_killed if state else False,
+                error=state.error if state else "",
+            )
+
+    # -- Annotate --
+
+    def Annotate(self, request: pb.AnnotateRequest, context) -> pb.AnnotateResponse:
+        edge_key, _ = self._settings.edge_credentials()
+        if not edge_key:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "edge key/secret not configured (settings)",
+            )
+        now_ms = int(time.time() * 1000)
+        if abs(request.start_timestamp - now_ms) > ANNOTATION_TS_WINDOW_MS:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "start_timestamp outside +-7 day window",
+            )
+        # Ack-on-enqueue (reference grpc_annotation_api.go:40-56).
+        self._annotations.publish(request.SerializeToString())
+        return pb.AnnotateResponse(
+            device_name=request.device_name,
+            remote_stream_id=request.remote_stream_id,
+            type=request.type,
+            start_timestamp=request.start_timestamp,
+        )
+
+    # -- Proxy / Storage toggles --
+
+    def Proxy(self, request: pb.ProxyRequest, context) -> pb.ProxyResponse:
+        # Validate before mutating control-plane state: a typo'd device_id
+        # must not leave orphaned toggle keys in the shared KV.
+        try:
+            record = self._pm.info(request.device_id)
+        except ProcessError:
+            context.abort(grpc.StatusCode.NOT_FOUND, "unknown device")
+            raise
+        self._bus.set_proxy_rtmp(request.device_id, request.passthrough)
+        self._bus.touch_query(request.device_id)
+        if record.rtmp_stream_status is not None:
+            record.rtmp_stream_status.streaming = request.passthrough
+            self._pm.update_record(record)
+        return pb.ProxyResponse(
+            device_id=request.device_id, passthrough=request.passthrough
+        )
+
+    def Storage(self, request: pb.StorageRequest, context) -> pb.StorageResponse:
+        try:
+            record = self._pm.info(request.device_id)
+        except ProcessError:
+            context.abort(grpc.StatusCode.NOT_FOUND, "unknown device")
+            raise
+        if not record.rtmp_endpoint:
+            # Reference requires an RTMP endpoint to derive the stream key
+            # (grpc_storage_api.go:27-34).
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "device has no RTMP endpoint",
+            )
+        stream_key = parse_rtmp_key(record.rtmp_endpoint)
+        from ..uplink.cloud import CloudClient  # lazy; network optional
+
+        client = CloudClient(self._settings, api_endpoint=self._api_endpoint)
+        try:
+            client.set_storage(stream_key, request.start)
+        except Exception as exc:
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"cloud call failed: {exc}")
+        self._bus.hset(
+            "last_access_time_" + request.device_id, "store",
+            "true" if request.start else "false",
+        )
+        if record.rtmp_stream_status is not None:
+            record.rtmp_stream_status.storing = request.start
+            self._pm.update_record(record)
+        return pb.StorageResponse(device_id=request.device_id, start=request.start)
+
+    # -- Inference (new) --
+
+    def Inference(self, request: pb.InferenceRequest, context) -> Iterator[pb.InferenceResult]:
+        if self._engine is None:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED, "TPU engine not running"
+            )
+        yield from self._engine.subscribe(
+            device_ids=list(request.device_ids), context=context
+        )
+
+
+def _frame_to_proto(device_id: str, frame) -> pb.VideoFrame:
+    meta = frame.meta
+    shape = pb.ShapeProto(
+        dim=[
+            pb.ShapeProto.Dim(size=meta.height, name="height"),
+            pb.ShapeProto.Dim(size=meta.width, name="width"),
+            pb.ShapeProto.Dim(size=meta.channels, name="channels"),
+        ]
+    )
+    return pb.VideoFrame(
+        width=meta.width,
+        height=meta.height,
+        data=frame.data.tobytes(),
+        timestamp=meta.timestamp_ms,
+        is_keyframe=meta.is_keyframe,
+        pts=meta.pts,
+        dts=meta.dts,
+        frame_type=meta.frame_type,
+        is_corrupt=meta.is_corrupt,
+        time_base=meta.time_base,
+        shape=shape,
+        device_id=device_id,
+        packet=meta.packet,
+        keyframe=meta.keyframe_cnt,
+    )

@@ -1,0 +1,525 @@
+"""Camera lifecycle manager.
+
+The reference equates "camera" with "Docker container" and drives dockerd over
+its unix socket (``server/services/rtsp_process_manager.go:50-188``). Here a
+camera is an OS subprocess running ``ingest.worker`` — Docker is an ops choice,
+not core (SURVEY.md §7) — with the same lifecycle semantics:
+
+- ``start``: spawn worker with the reference's env contract
+  (``rtsp_process_manager.go:96-104``), seed proxy/storage keys on the bus when
+  an RTMP endpoint is present (``:121-135``), persist the registry record
+  (``:137-148``).
+- restart policy "always": a supervisor thread re-spawns exited workers with
+  a failing-streak counter (Docker RestartPolicy parity,
+  ``rtsp_process_manager.go:76``; streak surfaces in ListStreams,
+  ``grpc_api.go:102-117``).
+- ``stop``: terminate + deregister + drop the bus ring (``:153-188``).
+- ``info``: merge the persisted record with live state and the last N stdout
+  lines (``:283-335`` pulls the last 100 container log lines).
+- registry resume: on boot, persisted cameras are re-spawned (the reference
+  re-attaches to still-running containers; workers are not containerized here
+  so resume = restart, same observable registry behavior,
+  ``rtsp_process_manager.go:191-233``).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..bus import FrameBus
+from ..bus.interface import KEY_KEYFRAME_ONLY_PREFIX, KEY_LAST_ACCESS_PREFIX
+from ..ingest.worker import KEY_STATUS_PREFIX
+from ..utils.logging import get_logger
+from ..utils.parsing import default_device_id
+from .models import PREFIX_RTSP_PROCESS, ProcessState, RTMPStreamStatus, StreamProcess
+from .storage import Storage
+
+log = get_logger("serve.process_manager")
+
+LOG_TAIL_LINES = 100   # reference pulls last 100 container log lines (:296)
+SUPERVISE_INTERVAL_S = 1.0
+RESTART_BACKOFF_S = 1.0
+
+# preexec_fn runs between fork and exec: nothing there may take locks, so the
+# libc handle (and through it, prctl) must be resolved once at import time in
+# the parent — a dlopen in the forked child can deadlock on an allocator or
+# import lock held by another server thread at fork time.
+if sys.platform == "linux":
+    import ctypes
+
+    _LIBC_PRCTL = ctypes.CDLL("libc.so.6", use_errno=True).prctl
+else:  # pragma: no cover
+    _LIBC_PRCTL = None
+
+_PR_SET_PDEATHSIG = 1
+_SIGTERM = 15
+
+
+def _pdeathsig() -> None:
+    """Child dies with the server (the reference gets this from dockerd
+    owning the container lifecycle; a subprocess runner needs the kernel's
+    parent-death signal)."""
+    if _LIBC_PRCTL is not None:
+        _LIBC_PRCTL(_PR_SET_PDEATHSIG, _SIGTERM)
+
+
+# Per-worker resource limits — the reference caps each camera container
+# (CPUShares 1024 equal weight, json-file logs 3x3 MB,
+# ``rtsp_process_manager.go:71-78``). Subprocess equivalents: an address-
+# space rlimit so one leaking worker cannot eat the host's decode budget,
+# and a nice level so N busy decoders stay preemptible by the server/engine
+# (niceness is the scheduler-weight analogue of equal CPUShares). The log
+# cap is the in-memory tail ring (_Tail, LOG_TAIL_LINES).
+WORKER_MEM_LIMIT_MB = 2048
+WORKER_NICE = 5
+
+
+# Imported at module load, NOT inside _worker_preexec: preexec_fn runs in
+# the forked child of a multithreaded server, where the import machinery's
+# locks may be held by a thread that no longer exists — touching it there
+# can deadlock the child before exec.
+try:
+    import resource as _resource
+except ImportError:  # non-POSIX; preexec is linux-gated at the call site
+    _resource = None
+
+
+def _worker_preexec(mem_limit_mb: int = WORKER_MEM_LIMIT_MB,
+                    nice: int = WORKER_NICE) -> None:
+    """Runs between fork and exec (no locks, no imports, no allocation)."""
+    _pdeathsig()
+    if mem_limit_mb > 0 and _resource is not None:
+        lim = mem_limit_mb << 20
+        _resource.setrlimit(_resource.RLIMIT_AS, (lim, lim))
+    if nice:
+        os.nice(nice)
+
+
+class ProcessError(RuntimeError):
+    pass
+
+
+class _Tail:
+    """Capture a worker's stdout into a bounded deque (reference: Docker
+    json-file logs capped at 3x3 MB, ``rtsp_process_manager.go:71-74``)."""
+
+    def __init__(self, proc: subprocess.Popen, maxlen: int = 2000):
+        self.lines: collections.deque[str] = collections.deque(maxlen=maxlen)
+        self.total = 0  # lines ever pumped (monotone; live-follow cursor)
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._pump, args=(proc,), daemon=True
+        )
+        self._thread.start()
+
+    def _pump(self, proc: subprocess.Popen) -> None:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            with self._lock:
+                self.lines.append(line.rstrip("\n"))
+                self.total += 1
+
+    def since(self, cursor: int) -> tuple[int, list[str]]:
+        """(total, lines appended after ``cursor``). A cursor from before a
+        worker restart (> total) or older than the ring resyncs to
+        whatever the ring still holds."""
+        with self._lock:
+            total = self.total
+            if cursor > total:
+                cursor = total - len(self.lines)  # restarted: resend ring
+            first_kept = total - len(self.lines)
+            skip = max(0, cursor - first_kept)
+            new = list(self.lines)[skip:]
+        return total, new
+
+    def snapshot(self, n: int) -> tuple[int, list[str]]:
+        """(total, last n lines) — one consistent view; the pump thread
+        mutates the deque, so iterating it unlocked can raise."""
+        with self._lock:
+            return self.total, list(self.lines)[-n:]
+
+
+class _Entry:
+    def __init__(self) -> None:
+        self.proc: Optional[subprocess.Popen] = None
+        self.tail: Optional[_Tail] = None
+        self.failing_streak = 0
+        self.restarting = False
+        self.desired = True  # restart-policy always while desired
+        self.last_exit = 0
+        self.last_spawn = time.monotonic()
+        self.inference_model = ""  # per-stream engine model override
+        self.restart_due = 0.0  # backoff deadline; 0 = not pending
+
+
+class ProcessManager:
+    def __init__(
+        self,
+        storage: Storage,
+        bus: FrameBus,
+        shm_dir: str = "/dev/shm/vep_tpu",
+        disk_buffer_path: str = "",
+        python: str = sys.executable,
+        bus_backend: str = "shm",
+        redis_addr: str = "127.0.0.1:6379",
+        mem_limit_mb: int = WORKER_MEM_LIMIT_MB,
+        nice: int = WORKER_NICE,
+    ):
+        self._storage = storage
+        self._bus = bus
+        self._shm_dir = shm_dir
+        self._bus_backend = bus_backend
+        self._redis_addr = redis_addr
+        self._disk_buffer_path = disk_buffer_path
+        self._python = python
+        self._mem_limit_mb = mem_limit_mb
+        self._nice = nice
+        self._entries: dict[str, _Entry] = {}
+        self._stopping: set[str] = set()  # mid-stop ids (see stop())
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="process-supervisor", daemon=True
+        )
+        self._supervisor.start()
+
+    # -- lifecycle --
+
+    def start(self, record: StreamProcess) -> StreamProcess:
+        if not record.rtsp_endpoint:
+            raise ProcessError("rtsp_endpoint required")
+        device_id = record.name or default_device_id(record.rtsp_endpoint)
+        record.name = device_id
+        with self._lock:
+            if device_id in self._entries:
+                raise ProcessError(f"process {device_id!r} already exists")
+            entry = _Entry()
+            entry.inference_model = record.inference_model
+            self._entries[device_id] = entry
+        now = StreamProcess.now_ms()
+        record.created = record.created or now
+        record.modified = now
+        record.status = "running"
+        record.rtmp_stream_status = record.rtmp_stream_status or RTMPStreamStatus(
+            streaming=True, storing=False
+        )
+        if record.rtmp_endpoint:
+            # Seed proxy keys so the worker sees consistent toggle state from
+            # packet one (reference rtsp_process_manager.go:121-135).
+            self._bus.set_proxy_rtmp(device_id, True)
+            self._bus.touch_query(device_id)
+        try:
+            self._spawn(record, entry)
+        except Exception:
+            with self._lock:
+                self._entries.pop(device_id, None)
+            raise
+        self._persist(record)
+        log.info("started camera process %s (%s)", device_id, record.rtsp_endpoint)
+        return record
+
+    def _spawn(self, record: StreamProcess, entry: _Entry) -> None:
+        env = dict(os.environ)
+        # Ensure the worker can import this package regardless of cwd.
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_parent
+        )
+        # Reference env contract (rtsp_process_manager.go:96-104).
+        env.update(
+            rtsp_endpoint=record.rtsp_endpoint,
+            device_id=record.name,
+            rtmp_endpoint=record.rtmp_endpoint or "",
+            in_memory_buffer="1",
+            disk_buffer_path=self._disk_buffer_path,
+            vep_shm_dir=self._shm_dir,
+            # Workers are separate processes: an in-proc "memory" bus can't
+            # cross the boundary, so they get the shm fast path instead.
+            vep_bus_backend=(
+                "shm" if self._bus_backend == "memory" else self._bus_backend
+            ),
+            vep_redis_addr=self._redis_addr,
+            PYTHONUNBUFFERED="1",
+        )
+        proc = subprocess.Popen(
+            [self._python, "-m", "video_edge_ai_proxy_tpu.ingest.worker"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            preexec_fn=(
+                (lambda: _worker_preexec(self._mem_limit_mb, self._nice))
+                if sys.platform == "linux" else None
+            ),
+        )
+        entry.proc = proc
+        entry.last_spawn = time.monotonic()
+        entry.tail = _Tail(proc)
+        record.container_id = f"{proc.pid}@{os.uname().nodename}"
+
+    def inference_model_of(self, device_id: str) -> str:
+        """Per-stream engine model override (StreamProcess.inference_model);
+        "" means the engine default. Lock-free dict read — called by the
+        engine collector every tick."""
+        entry = self._entries.get(device_id)
+        return entry.inference_model if entry is not None else ""
+
+    def stop(self, device_id: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(device_id, None)
+            # Marked before the (up to ~15 s) terminate/wait below: list()
+            # still sees the storage record during that window, and a
+            # deliberate stop must read as "exited", not as a dead worker
+            # nobody supervises — /healthz gates readiness on the latter.
+            self._stopping.add(device_id)
+        try:
+            if entry is None:
+                # Still clean the registry if a stale record exists
+                # (reference Stop deletes datastore entry even when the container
+                # is already gone, rtsp_process_manager.go:153-188).
+                if self._storage.get_or_none(PREFIX_RTSP_PROCESS, device_id) is None:
+                    raise ProcessError(f"process {device_id!r} not found")
+            else:
+                entry.desired = False
+                if entry.proc and entry.proc.poll() is None:
+                    entry.proc.terminate()
+                    try:
+                        entry.proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        entry.proc.kill()
+                        entry.proc.wait(timeout=5)
+            self._storage.delete(PREFIX_RTSP_PROCESS, device_id)
+            self._bus.drop_stream(device_id)
+            self._bus.kv_del(KEY_STATUS_PREFIX + device_id)
+            self._bus.hdel_all(KEY_LAST_ACCESS_PREFIX + device_id)
+            self._bus.kv_del(KEY_KEYFRAME_ONLY_PREFIX + device_id)
+        finally:
+            with self._lock:
+                self._stopping.discard(device_id)
+        log.info("stopped camera process %s", device_id)
+
+    def stop_all(self) -> None:
+        for device_id in self.device_ids():
+            try:
+                self.stop(device_id)
+            except ProcessError:
+                pass
+
+    # -- queries --
+
+    def device_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def info(self, device_id: str) -> StreamProcess:
+        raw = self._storage.get_or_none(PREFIX_RTSP_PROCESS, device_id)
+        if raw is None:
+            raise ProcessError(f"process {device_id!r} not found")
+        record = StreamProcess.from_json(raw)
+        with self._lock:
+            entry = self._entries.get(device_id)
+            stopping = device_id in self._stopping
+        record.state = self._live_state(entry)
+        if entry is None and stopping:
+            # Mid-stop: supervision was detached on purpose; not the
+            # nobody-will-ever-restart-this outage `dead` means.
+            record.state.dead = False
+            record.state.status = "exited"
+        record.status = record.state.status
+        record.limits = {
+            "mem_limit_mb": self._mem_limit_mb,
+            "nice": self._nice,
+            "log_tail_lines": LOG_TAIL_LINES,
+        }
+        if entry and entry.tail:
+            total, lines = entry.tail.snapshot(LOG_TAIL_LINES)
+            record.logs = {
+                "stdout": lines,
+                # Live-follow cursor: pass back as ?since= on the logs
+                # endpoint to receive only lines appended after this tail.
+                "total": total,
+            }
+        return record
+
+    def logs_since(self, device_id: str, cursor: int) -> dict:
+        """Incremental log tail for live following (the reference streams
+        container stdout into the portal's xterm view,
+        ``process-details.component.ts:58-73``; a subprocess runner serves
+        the same need with an offset cursor over the tail ring)."""
+        with self._lock:
+            entry = self._entries.get(device_id)
+        if entry is None or entry.tail is None:
+            if self._storage.get_or_none(PREFIX_RTSP_PROCESS, device_id) is None:
+                raise ProcessError(f"process {device_id!r} not found")
+            return {"total": 0, "lines": []}
+        total, lines = entry.tail.since(cursor)
+        return {"total": total, "lines": lines}
+
+    def list(self) -> list[StreamProcess]:
+        out = []
+        for device_id in sorted(self._storage.list(PREFIX_RTSP_PROCESS)):
+            try:
+                out.append(self.info(device_id))
+            except ProcessError:
+                continue
+        return out
+
+    def update_record(self, record: StreamProcess) -> None:
+        """Reference ``UpdateProcessInfo`` (rtsp_process_manager.go:338-356)."""
+        record.modified = StreamProcess.now_ms()
+        self._persist(record)
+
+    def _live_state(self, entry: Optional[_Entry]) -> ProcessState:
+        if entry is None or entry.proc is None:
+            return ProcessState(status="exited", running=False, dead=True)
+        code = entry.proc.poll()
+        if code is None:
+            return ProcessState(
+                status="restarting" if entry.restarting else "running",
+                running=True,
+                pid=entry.proc.pid,
+                restarting=entry.restarting,
+                failing_streak=entry.failing_streak,
+                # Sticky across the restart (the reference surfaces Docker's
+                # OOMKilled the same way): the PREVIOUS run's SIGKILL exit
+                # stays visible so ListStreams health shows why the streak
+                # is climbing, not just that it is.
+                oom_killed=(entry.last_exit == -signal.SIGKILL),
+            )
+        return ProcessState(
+            status="restarting" if entry.desired else "exited",
+            running=False,
+            pid=entry.proc.pid,
+            exit_code=code,
+            restarting=entry.desired,
+            failing_streak=entry.failing_streak,
+            # SIGKILL exit is the kernel OOM killer's signature for a
+            # subprocess runner (the reference reads Docker's OOMKilled flag,
+            # ``grpc_api.go:102-117``; without a cgroup supervisor, -9 is
+            # the best-available heuristic and can also mean a manual
+            # kill -9 — surfaced identically in ListStreams either way).
+            oom_killed=(code == -signal.SIGKILL),
+        )
+
+    # -- persistence / resume --
+
+    def _persist(self, record: StreamProcess) -> None:
+        # state/logs are runtime-only views attached by info(); persisting
+        # them would rewrite the log tail into the registry on every toggle
+        # and resurrect a previous boot's state as if current.
+        clean = StreamProcess.from_json(record.to_json())
+        clean.state = None
+        clean.logs = None
+        self._storage.put(PREFIX_RTSP_PROCESS, clean.name, clean.to_json())
+
+    def resume(self) -> int:
+        """Re-spawn all persisted cameras (boot-time registry resume,
+        reference rtsp_process_manager.go:191-233)."""
+        count = 0
+        for device_id, raw in self._storage.list(PREFIX_RTSP_PROCESS).items():
+            with self._lock:
+                if device_id in self._entries:
+                    continue
+                entry = _Entry()
+                self._entries[device_id] = entry
+            record = StreamProcess.from_json(raw)
+            entry.inference_model = record.inference_model
+            try:
+                self._spawn(record, entry)
+                self._persist(record)
+                count += 1
+            except Exception as exc:
+                log.error("failed to resume %s: %s", device_id, exc)
+                with self._lock:
+                    self._entries.pop(device_id, None)
+        return count
+
+    # -- supervision (RestartPolicy: always) --
+
+    # A worker alive this long after (re)spawn is considered stable and its
+    # failing streak resets (Docker's restart policy resets the streak once
+    # the container runs successfully).
+    STABLE_AFTER_S = 30.0
+
+    def _supervise(self) -> None:
+        while not self._stop.wait(SUPERVISE_INTERVAL_S):
+            now = time.monotonic()
+            with self._lock:
+                snapshot = list(self._entries.items())
+            for device_id, entry in snapshot:
+                proc = entry.proc
+                if proc is None or not entry.desired:
+                    continue
+                code = proc.poll()
+                if code is None:
+                    if (
+                        entry.failing_streak
+                        and not entry.restarting
+                        and now - entry.last_spawn > self.STABLE_AFTER_S
+                    ):
+                        entry.failing_streak = 0
+                        # Stable again: clear the last-exit cause so
+                        # oom_killed stops reporting a long-gone event
+                        # (Docker clears OOMKilled on a healthy restart too).
+                        entry.last_exit = 0
+                    continue
+                if not entry.restarting:
+                    entry.failing_streak += 1
+                    entry.restarting = True
+                    entry.last_exit = code
+                    # Backoff as a deadline, not a sleep: one flapping camera
+                    # must not delay supervision of the others.
+                    entry.restart_due = now + min(
+                        RESTART_BACKOFF_S * entry.failing_streak, 10.0
+                    )
+                    log.warning(
+                        "worker %s exited code=%s streak=%d; restart in %.1fs",
+                        device_id, code, entry.failing_streak,
+                        entry.restart_due - now,
+                    )
+                if now < entry.restart_due:
+                    continue
+                raw = self._storage.get_or_none(PREFIX_RTSP_PROCESS, device_id)
+                if raw is None:
+                    entry.restarting = False
+                    continue  # stopped concurrently
+                record = StreamProcess.from_json(raw)
+                try:
+                    self._spawn(record, entry)
+                    self._persist(record)
+                except Exception as exc:
+                    log.error("restart of %s failed: %s", device_id, exc)
+                entry.restarting = False
+
+    def close(self) -> None:
+        self._stop.set()
+        self._supervisor.join(timeout=15)
+        self.shutdown_workers()
+
+    def shutdown_workers(self) -> None:
+        """Terminate workers without deregistering (server shutdown keeps the
+        registry so ``resume()`` restores cameras on next boot)."""
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for entry in entries:
+            entry.desired = False
+            if entry.proc and entry.proc.poll() is None:
+                entry.proc.terminate()
+        for entry in entries:
+            if entry.proc and entry.proc.poll() is None:
+                try:
+                    entry.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    entry.proc.kill()

@@ -173,68 +173,6 @@ def build_app(
             }
         return web.json_response(out)
 
-    async def metrics(_request: web.Request) -> web.Response:
-        """Prometheus exposition of the same counters /api/v1/stats serves
-        as JSON (SURVEY.md §5.5: the reference has no metrics endpoint at
-        all; a fleet scrapes this one). Text format 0.0.4 — no client
-        library needed for gauges/counters."""
-        # Families buffered so each metric's samples render contiguously
-        # (text-format 0.0.4 requires one block per family), with label
-        # values escaped — a camera named 'cam"1' must corrupt nothing.
-        families: dict[str, tuple[str, str, list[str]]] = {}
-
-        def esc(v: str) -> str:
-            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
-                    .replace("\n", "\\n"))
-
-        def emit(name, value, help_text, kind="gauge", device_id=None):
-            fam = families.setdefault(name, (help_text, kind, []))
-            labels = f'{{device_id="{esc(device_id)}"}}' if device_id else ""
-            fam[2].append(f"{name}{labels} {value}")
-
-        procs = await asyncio.to_thread(pm.list)
-        emit("vep_workers_total", len(procs), "Registered camera workers")
-        emit("vep_workers_running",
-             sum(1 for p in procs if p.state and p.state.running),
-             "Camera workers currently running")
-        for p in procs:
-            if p.state:
-                emit("vep_worker_failing_streak", p.state.failing_streak,
-                     "Consecutive failures per worker", device_id=p.name)
-        if engine is not None:
-            emit("vep_engine_ticks_total", engine.ticks,
-                 "Engine ticks completed", kind="counter")
-            emit("vep_engine_batches_total", engine.batches,
-                 "Device batches dispatched", kind="counter")
-            for did, st in engine.stats().items():
-                emit("vep_stream_frames_total", st.frames,
-                     "Inference results per stream", kind="counter",
-                     device_id=did)
-                emit("vep_stream_latency_ms", round(st.ema_latency_ms, 3),
-                     "EMA end-to-end latency per stream (ms)", device_id=did)
-        if annotations is not None:
-            emit("vep_annotation_queue_depth", annotations.depth(),
-                 "Annotation uplink queue depth")
-            emit("vep_annotations_published_total", annotations.published,
-                 "Annotations enqueued", kind="counter")
-            emit("vep_annotations_acked_total", annotations.acked,
-                 "Annotation batches acked by the cloud", kind="counter")
-            emit("vep_annotations_dropped_total", annotations.dropped,
-                 "Annotations dropped at the unacked limit", kind="counter")
-            emit("vep_annotation_rejected_batches_total",
-                 annotations.rejected_batches,
-                 "Annotation batches rejected by the cloud (re-queued)",
-                 kind="counter")
-        lines: list[str] = []
-        for name, (help_text, kind, samples) in families.items():
-            lines.append(f"# HELP {name} {help_text}")
-            lines.append(f"# TYPE {name} {kind}")
-            lines.extend(samples)
-        return web.Response(
-            text="\n".join(lines) + "\n",
-            content_type="text/plain", charset="utf-8",
-        )
-
     async def profile_start(request: web.Request) -> web.Response:
         if engine is None:
             return _error(400, "engine not running")
@@ -315,7 +253,6 @@ def build_app(
     app.router.add_post("/api/v1/settings", settings_overwrite)
     app.router.add_get("/api/v1/stats", stats)
     app.router.add_get("/healthz", healthz)
-    app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/v1/rtspscan", rtspscan)
     app.router.add_post("/api/v1/profile/start", profile_start)
     app.router.add_post("/api/v1/profile/stop", profile_stop)

@@ -1,0 +1,186 @@
+"""Server entrypoint: wires storage, bus, managers, REST, gRPC, cron, uplink
+and (optionally) the TPU inference engine.
+
+Boot order parity with the reference (``server/main.go``): config -> embedded
+store (``:167-182``) -> bus (``:185-207``; our shm bus needs no retry loop — it
+cannot be 'down') -> services (``:108-113``) -> cron (``:118``) -> REST
+(``:120-126``) -> gRPC on :50001 (``:142-154``) -> signal-driven shutdown
+(``:156-164``). Plus registry resume (cameras restart on boot) and the new
+engine plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..bus import open_bus
+from ..proto import pb_grpc
+from ..uplink import AnnotationQueue, make_batch_handler
+from ..utils.config import Config, load_config
+from ..utils.logging import get_logger
+from .cron import CronJobs
+from .grpc_api import ImageServicer
+from .process_manager import ProcessManager
+from .rest_api import RestServer
+from .settings import SettingsManager
+from .storage import Storage
+
+log = get_logger("serve.server")
+
+
+class Server:
+    def __init__(
+        self,
+        cfg: Optional[Config] = None,
+        *,
+        data_dir: str = "/data/chrysalis",
+        enable_engine: bool = False,
+        grpc_port: Optional[int] = None,
+        rest_port: Optional[int] = None,
+        bus_backend: Optional[str] = None,
+    ):
+        self.cfg = cfg or load_config()
+        self.data_dir = data_dir
+        self.storage = Storage(os.path.join(data_dir, "registry.db"))
+        self.bus = open_bus(
+            bus_backend or self.cfg.bus.backend, self.cfg.bus.shm_dir,
+            self.cfg.bus.redis_addr,
+        )
+        self.settings = SettingsManager(self.storage)
+        self.process_manager = ProcessManager(
+            self.storage,
+            self.bus,
+            shm_dir=self.cfg.bus.shm_dir,
+            disk_buffer_path=(
+                self.cfg.buffer.on_disk_folder if self.cfg.buffer.on_disk else ""
+            ),
+            bus_backend=bus_backend or self.cfg.bus.backend,
+            redis_addr=self.cfg.bus.redis_addr,
+        )
+        self.annotations = AnnotationQueue(
+            handler=make_batch_handler(
+                self.settings, self.cfg.annotation.endpoint
+            ),
+            max_batch_size=self.cfg.annotation.max_batch_size,
+            poll_duration_ms=self.cfg.annotation.poll_duration_ms,
+            unacked_limit=self.cfg.annotation.unacked_limit,
+        )
+        self.engine = None
+        if enable_engine:
+            try:
+                from ..engine import InferenceEngine
+            except ImportError as exc:
+                raise RuntimeError(
+                    "TPU inference engine requested but the engine package "
+                    "is unavailable"
+                ) from exc
+            engine_cfg = self.cfg.engine
+            if engine_cfg.compile_cache_dir == "auto":
+                # "auto" resolves into the data dir (persists across
+                # restarts like the registry) WITHOUT mutating the
+                # caller's Config; empty stays off, per the config doc.
+                import dataclasses
+
+                engine_cfg = dataclasses.replace(
+                    engine_cfg,
+                    compile_cache_dir=os.path.join(data_dir, "compile_cache"),
+                )
+            self.engine = InferenceEngine(
+                self.bus, engine_cfg, annotations=self.annotations,
+                model_resolver=self.process_manager.inference_model_of,
+            )
+        self.cron = CronJobs(self.cfg.buffer)
+        self._grpc_port = grpc_port if grpc_port is not None else self.cfg.grpc_port
+        self._rest_port = rest_port if rest_port is not None else self.cfg.port
+        self._grpc_server: Optional[grpc.Server] = None
+        self._rest: Optional[RestServer] = None
+        self._stopped = threading.Event()
+        self.bound_grpc_port = self._grpc_port
+
+    def start(self) -> None:
+        resumed = self.process_manager.resume()
+        if resumed:
+            log.info("resumed %d cameras from registry", resumed)
+        self.cron.start()
+        self.annotations.start()
+        if self.engine is not None:
+            self.engine.start()
+
+        self._rest = RestServer(
+            self.process_manager, self.settings, port=self._rest_port,
+            engine=self.engine, annotations=self.annotations,
+        )
+        self._rest.start()
+
+        servicer = ImageServicer(
+            self.bus,
+            self.process_manager,
+            self.settings,
+            self.annotations,
+            engine=self.engine,
+            api_endpoint=self.cfg.api.endpoint,
+        )
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=64),
+            options=[
+                ("grpc.max_send_message_length", 64 << 20),
+                ("grpc.max_receive_message_length", 64 << 20),
+            ],
+        )
+        pb_grpc.add_ImageServicer_to_server(servicer, server)
+        self.bound_grpc_port = server.add_insecure_port(f"0.0.0.0:{self._grpc_port}")
+        server.start()
+        self._grpc_server = server
+        log.info(
+            "gRPC Image service on :%d, REST on :%d",
+            self.bound_grpc_port, self._rest.bound_port,
+        )
+
+    def wait(self) -> None:
+        self._stopped.wait()
+
+    def stop(self) -> None:
+        log.info("shutting down")
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=2).wait()
+        if self._rest is not None:
+            self._rest.stop()
+        if self.engine is not None:
+            self.engine.stop()
+        self.annotations.stop()
+        self.cron.stop()
+        # Keep the registry: cameras resume on next boot (reference behavior —
+        # BadgerDB registry survives restart, rtsp_process_manager.go:191-233).
+        self.process_manager.close()
+        self.bus.close()
+        self.storage.close()
+        self._stopped.set()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    p = argparse.ArgumentParser(description="video-edge-ai-proxy-tpu server")
+    p.add_argument("--conf", default=None, help="path to conf.yaml")
+    p.add_argument("--data_dir", default="/data/chrysalis")
+    p.add_argument("--engine", action="store_true", help="run the TPU inference engine")
+    args = p.parse_args(argv)
+    cfg = load_config(args.conf)
+    server = Server(cfg, data_dir=args.data_dir, enable_engine=args.engine)
+    server.start()
+
+    def _sig(_s, _f):
+        threading.Thread(target=server.stop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    server.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+from .cloud import CloudClient, ForbiddenError, annotation_to_cloud, make_batch_handler
+from .queue import AnnotationQueue
+
+__all__ = [
+    "AnnotationQueue",
+    "CloudClient",
+    "ForbiddenError",
+    "annotation_to_cloud",
+    "make_batch_handler",
+]

@@ -1,0 +1,6 @@
+from .config import Config, load_config
+from .logging import get_logger
+from .parsing import parse_rtmp_key
+from .signing import sign_request
+
+__all__ = ["Config", "load_config", "get_logger", "parse_rtmp_key", "sign_request"]

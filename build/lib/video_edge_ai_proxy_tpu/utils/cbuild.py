@@ -1,0 +1,55 @@
+"""Compile-on-demand builder for the native C ABI libraries.
+
+The image ships no pybind11 and the shims need no Python C API — they expose
+plain C ABIs consumed via ctypes — so a build is one g++ invocation, cached
+by source hash under the user cache dir. Shared by the bus ring/KV library
+(``bus/native/vepbus.cpp``) and the libav demux/mux shim
+(``ingest/native/vepav.cpp``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Sequence
+
+_LOCK = threading.Lock()
+
+
+def cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+    return os.path.join(base, "vep_tpu")
+
+
+def build_library(src: str, name: str, ldflags: Sequence[str] = ()) -> str:
+    """Return the path to the compiled shared object for ``src``, building
+    if needed. The cache key covers the source hash AND the link flags, so
+    changing either rebuilds. Raises RuntimeError with compiler output on
+    failure."""
+    with open(src, "rb") as fh:
+        h = hashlib.sha256(fh.read())
+    for flag in ldflags:
+        h.update(flag.encode())
+    digest = h.hexdigest()[:16]
+    out_dir = cache_dir()
+    out = os.path.join(out_dir, f"lib{name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    with _LOCK:
+        if os.path.exists(out):
+            return out
+        os.makedirs(out_dir, exist_ok=True)
+        tmp = out + f".tmp.{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            "-Wall", "-Wextra", src, "-o", tmp, *ldflags,
+        ]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{name} native build failed:\n{proc.stdout}\n{proc.stderr}"
+            )
+        os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out

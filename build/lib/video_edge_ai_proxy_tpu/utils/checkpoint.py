@@ -1,0 +1,65 @@
+"""Model/optimizer checkpointing.
+
+The reference has no model checkpoints because it has no models (SURVEY.md
+§5.4); its only resume state is the camera registry. Our engine and trainer
+add params/optimizer state. Two formats:
+
+- msgpack (flax.serialization): single-file, dependency-light, used for
+  engine inference params (small, read-once at warmup).
+- orbax: directory-format checkpoint manager for sharded train state —
+  restores each array onto its mesh shard placement, which matters once
+  fsdp/tp shard params across chips.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Optional
+
+from flax import serialization
+
+
+def save_msgpack(path: str, tree: Any) -> None:
+    """Atomic single-file save (write temp + rename, so a crash mid-write
+    never leaves a torn checkpoint — same durability stance as the
+    reference's BadgerDB registry)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    data = serialization.to_bytes(tree)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_msgpack(path: str, template: Any) -> Any:
+    """Restore into the structure of ``template`` (shape/dtype validated by
+    flax deserialization)."""
+    with open(path, "rb") as fh:
+        return serialization.from_bytes(template, fh.read())
+
+
+def save_train_state(ckpt_dir: str, state: Any, step: Optional[int] = None) -> str:
+    """Orbax save of a (possibly sharded) TrainState; returns the path."""
+    import orbax.checkpoint as ocp
+
+    step = step if step is not None else int(state.step)
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_train_state(path: str, template: Any) -> Any:
+    """Orbax restore; ``template`` supplies structure + shardings (pass an
+    abstract state built on the target mesh to restore sharded)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path), template)

@@ -1,0 +1,169 @@
+"""Framework configuration.
+
+Schema parity with the reference's YAML config (``server/globals/config.go:28-64``
+and documented defaults in ``server/main.go:50-88``): the reference has
+``redis``/``annotation``/``api``/``buffer`` sub-configs; we keep the same
+capability surface but rename ``redis`` -> ``bus`` (the frame bus here is a
+native shared-memory ring, not Redis) and add an ``engine`` sub-config for the
+TPU inference plane, which has no counterpart in the reference (it ships frames
+to external CPU clients instead).
+
+Precedence matches the reference (``server/main.go:50-88``): config file if
+present, else compiled-in defaults; selected fields are force-overridden (the
+reference pins the REST port to 8080 at ``server/main.go:82``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+DEFAULT_CONFIG_PATH = "/data/chrysalis/conf.yaml"
+
+
+@dataclass
+class BusConfig:
+    """Frame-bus connection (reference ``RedisSubconfig``, ``config.go:28-35``)."""
+
+    backend: str = "shm"  # "shm" (native ring) | "redis" (reference-wire
+    #                        interop) | "memory" (in-proc, tests)
+    # Directory holding the shared-memory segments (one per camera + control KV).
+    shm_dir: str = "/dev/shm/vep_tpu"
+    # Redis server for backend "redis" (reference ``RedisSubconfig``
+    # connection string, ``config.go:28-35``).
+    redis_addr: str = "127.0.0.1:6379"
+    # Ring capacity per camera in frames; reference default is 1 in-memory frame
+    # (``server/main.go:74``, latest-frame-wins semantics).
+    ring_slots: int = 4
+
+
+@dataclass
+class AnnotationConfig:
+    """Annotation uplink batching (reference ``AnnotationSubconfig``,
+    ``config.go:37-46``; defaults from ``server/main.go:59-64``)."""
+
+    endpoint: str = "https://event.chryscloud.com/api/v1/annotate"
+    unacked_limit: int = 1000
+    poll_duration_ms: int = 300
+    max_batch_size: int = 299
+
+
+@dataclass
+class ApiConfig:
+    """Cloud REST endpoint (reference ``ApiSubconfig``, ``config.go:48-52``)."""
+
+    endpoint: str = "https://api.chryscloud.com"
+
+
+@dataclass
+class BufferConfig:
+    """Frame buffering (reference ``BufferSubconfig``, ``config.go:54-64``)."""
+
+    in_memory: int = 1
+    on_disk: bool = False
+    on_disk_folder: str = "/data/chrysalis/archive"
+    on_disk_clean_older_than: str = "5m"
+    on_disk_schedule: str = "@every 5m"
+
+
+@dataclass
+class EngineConfig:
+    """TPU inference plane (new; no reference counterpart — see SURVEY.md §7)."""
+
+    model: str = "yolov8n"
+    # Bucketed batch sizes to avoid XLA recompilation storms when streams
+    # come and go (SURVEY.md §7 hard part 1).
+    # 64 included: XLA's schedule at bs64 is ~3x better per frame than bs16
+    # on v5e (measured), so large camera fleets get the good bucket.
+    batch_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+    # Collector tick deadline: stack whatever arrived, pad to bucket, go.
+    tick_ms: int = 10
+    # Seconds of client inactivity after which a stream drops out of the
+    # device batch (mirrors the reference's 10 s decode gate,
+    # ``python/rtsp_to_rtmp.py:144-145``).
+    active_window_s: float = 10.0
+    dtype: str = "bfloat16"
+    # Mesh shape for multi-chip serving; empty = single chip. The string
+    # "auto" serves data-parallel over every visible device (dp-heavy
+    # factoring — a fleet operator needs no hand-written shape).
+    mesh: "dict[str, int] | str" = field(default_factory=dict)
+    # msgpack params checkpoint; empty = random init (no pretrained weights
+    # are bundled). Loaded at warmup so restart = load + compile cache.
+    checkpoint_path: str = ""
+    # Persistent XLA compile cache (SURVEY.md §5.4: "warmup = load +
+    # compile-cache"): big serving programs take tens of seconds to
+    # minutes to compile; with a cache dir a restarted server skips
+    # recompiling every (geometry, bucket) program it has seen. "" = off
+    # (jax default); "auto" = the server resolves <data_dir>/compile_cache.
+    compile_cache_dir: str = ""
+    # Geometries to compile at boot instead of on first frame: list of
+    # [height, width, bucket]. Big programs (e.g. ViT at bucket 32) can take
+    # minutes to compile; prewarming moves that cost out of the hot path.
+    prewarm: list = field(default_factory=list)
+    # /healthz flags the engine loop wedged when no tick completed for this
+    # long. Must exceed the longest legitimate in-tick XLA compile (first
+    # frame of a new geometry compiles inside the tick) or a k8s liveness
+    # probe would restart the pod mid-warmup in a loop.
+    health_stale_after_s: float = 300.0
+    # "int8" = weight-only post-training quantization of serving params
+    # (models/quantize.py): int8 device/HBM residency (checkpoints stay
+    # full precision on disk), bf16 compute,
+    # dequantize fused in-graph. "" = full precision.
+    quantize: str = ""
+    # Fill Detection.track_id / AnnotateRequest.object_tracking_id with a
+    # per-stream SORT-style tracker (engine/tracker.py). Host-side numpy on
+    # NMS output — negligible next to a device batch.
+    track: bool = True
+
+
+@dataclass
+class Config:
+    version: str = "0.1.0"
+    title: str = "video-edge-ai-proxy-tpu"
+    description: str = "TPU-native video edge AI proxy"
+    mode: str = "release"
+    port: int = 8080
+    grpc_port: int = 50001
+    bus: BusConfig = field(default_factory=BusConfig)
+    annotation: AnnotationConfig = field(default_factory=AnnotationConfig)
+    api: ApiConfig = field(default_factory=ApiConfig)
+    buffer: BufferConfig = field(default_factory=BufferConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+
+
+def _merge(dc: Any, data: dict[str, Any]) -> Any:
+    """Overlay a dict onto a dataclass, recursing into nested dataclasses."""
+    kwargs: dict[str, Any] = {}
+    for f in dataclasses.fields(dc):
+        if f.name not in data:
+            continue
+        cur = getattr(dc, f.name)
+        val = data[f.name]
+        if dataclasses.is_dataclass(cur) and isinstance(val, dict):
+            kwargs[f.name] = _merge(cur, val)
+        elif isinstance(cur, tuple) and isinstance(val, list):
+            kwargs[f.name] = tuple(val)
+        else:
+            kwargs[f.name] = val
+    return dataclasses.replace(dc, **kwargs)
+
+
+def load_config(path: Optional[str] = None) -> Config:
+    """Load config: explicit path > $VEP_TPU_CONF > default path > defaults.
+
+    Like the reference (``server/main.go:50-88``), a missing file is not an
+    error — compiled-in defaults are used, and the REST port is pinned.
+    """
+    cfg = Config()
+    candidate = path or os.environ.get("VEP_TPU_CONF") or DEFAULT_CONFIG_PATH
+    if candidate and os.path.isfile(candidate):
+        with open(candidate, "r", encoding="utf-8") as fh:
+            data = yaml.safe_load(fh) or {}
+        if not isinstance(data, dict):
+            raise ValueError(f"config root must be a mapping: {candidate}")
+        cfg = _merge(cfg, data)
+    return cfg

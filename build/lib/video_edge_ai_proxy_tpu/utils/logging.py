@@ -1,0 +1,36 @@
+"""Structured logging.
+
+The reference initializes a global zap logger (``server/globals/config.go:66-72``)
+used throughout as ``g.Log.*``; worker containers print unbuffered to stdout
+(``server/services/rtsp_process_manager.go:104``). We provide the same: one
+process-wide structured logger, plain stdout lines so a supervising process
+manager can capture them (our ProcessManager tails worker stdout the way the
+reference tails container logs, ``rtsp_process_manager.go:283-335``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s"
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root = logging.getLogger("vep_tpu")
+    root.addHandler(handler)
+    root.setLevel(os.environ.get("VEP_TPU_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure()
+    return logging.getLogger(f"vep_tpu.{name}")

@@ -204,6 +204,29 @@ class TestRestAux:
         finally:
             server.pm.list = orig
 
+    def test_metrics_prometheus_exposition(self, server):
+        """/metrics serves the observability counters in Prometheus text
+        format (SURVEY §5.5 — the reference ships no metrics endpoint)."""
+        server.engine.start()
+        status, body = self._get(server, "/metrics")
+        assert status == 200
+        text = body.decode()
+        assert "# TYPE vep_workers_total gauge" in text
+        assert "vep_workers_total 0" in text
+        assert "# TYPE vep_engine_ticks_total counter" in text
+        assert "vep_annotation_queue_depth 0" in text
+        assert "vep_annotation_rejected_batches_total 0" in text
+        # One HELP/TYPE block per metric name, even with many label sets.
+        assert text.count("# TYPE vep_workers_total ") == 1
+        # Families must be contiguous (text-format 0.0.4): every sample
+        # line sits directly under its family's TYPE header block.
+        fam = None
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                fam = line.split()[2]
+            elif line and not line.startswith("#"):
+                assert fam is not None and line.startswith(fam), line
+
     def test_portal_served_at_root(self, server):
         status, body = self._get(server, "/")
         assert status == 200
