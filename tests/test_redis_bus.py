@@ -147,6 +147,29 @@ class TestReferenceWireContract:
         assert raw.command("XLEN", "camy") <= 2
 
 
+class TestAuthAndDb:
+    """Reference RedisSubconfig parity (config.go:28-35): password and
+    database select run on every (re)connect."""
+
+    def test_auth_required_and_honored(self):
+        with MiniRedis(password="hunter2") as addr:
+            # No credentials: first command is rejected.
+            bare = RespClient.from_addr(addr)
+            with pytest.raises(Exception, match="NOAUTH"):
+                bare.command("PING")
+            bare.close()
+            # Wrong password: handshake fails loudly at connect.
+            with pytest.raises(Exception, match="WRONGPASS"):
+                RedisFrameBus(addr, password="wrong")
+            # Right password (+ db select): the full bus works.
+            bus = RedisFrameBus(addr, password="hunter2", db=3)
+            img = np.zeros((3, 3, 3), np.uint8)
+            bus.create_stream("cam", img.nbytes)
+            bus.publish("cam", img, FrameMeta(timestamp_ms=1))
+            assert bus.read_latest("cam").meta.timestamp_ms == 1
+            bus.close()
+
+
 class TestEngineOverRedis:
     def test_inference_plane_rides_redis_fabric(self, server):
         """The TPU engine's collector consumes frames straight off the

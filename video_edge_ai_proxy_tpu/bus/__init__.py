@@ -13,7 +13,8 @@ from .memory_bus import MemoryFrameBus
 
 
 def open_bus(backend: str = "shm", shm_dir: str = "/dev/shm/vep_tpu",
-             redis_addr: str = "127.0.0.1:6379") -> FrameBus:
+             redis_addr: str = "127.0.0.1:6379", redis_password: str = "",
+             redis_db: int = 0) -> FrameBus:
     """Factory: ``shm`` (native shared-memory, same-host fast path),
     ``redis`` (wire-compatible with the reference's Redis fabric — interop
     with reference workers/clients, SURVEY.md §7.2), or ``memory``
@@ -25,7 +26,8 @@ def open_bus(backend: str = "shm", shm_dir: str = "/dev/shm/vep_tpu",
     if backend == "redis":
         from .redis_bus import RedisFrameBus
 
-        return RedisFrameBus(redis_addr)
+        return RedisFrameBus(redis_addr, password=redis_password,
+                             db=redis_db)
     if backend == "memory":
         return MemoryFrameBus()
     raise ValueError(f"unknown bus backend {backend!r}")

@@ -24,7 +24,9 @@ StreamEntry = Tuple[Tuple[int, int], List[bytes]]  # ((ms, n), flat fields)
 class MiniRedis:
     """``with MiniRedis() as addr: RespClient.from_addr(addr)``."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 password: str = ""):
+        self._password = password.encode() if password else b""
         self._strings: Dict[bytes, bytes] = {}
         self._hashes: Dict[bytes, Dict[bytes, bytes]] = {}
         self._streams: Dict[bytes, List[StreamEntry]] = {}
@@ -91,6 +93,7 @@ class MiniRedis:
             out, buf = buf[:n], buf[n:]
             return out
 
+        authed = not self._password
         try:
             while not self._stop.is_set():
                 line = read_line()
@@ -108,6 +111,21 @@ class MiniRedis:
                     if data is None or read_exact(2) is None:
                         return
                     parts.append(data)
+                cmd = parts[0].upper() if parts else b""
+                # Connection-scoped auth, like Redis requirepass.
+                if cmd == b"AUTH":
+                    if not self._password:
+                        conn.sendall(
+                            b"-ERR Client sent AUTH, but no password is set\r\n")
+                    elif parts[-1] == self._password:
+                        authed = True
+                        conn.sendall(b"+OK\r\n")
+                    else:
+                        conn.sendall(b"-WRONGPASS invalid password\r\n")
+                    continue
+                if not authed:
+                    conn.sendall(b"-NOAUTH Authentication required.\r\n")
+                    continue
                 conn.sendall(self._dispatch(parts))
         except OSError:
             pass
@@ -158,6 +176,13 @@ class MiniRedis:
 
     def _cmd_ping(self, _args):
         return b"+PONG\r\n"
+
+    def _cmd_select(self, args):
+        # Single logical db; accept valid indices for connection-string
+        # parity (AUTH stays in _serve_conn — it touches connection state).
+        if len(args) == 1 and args[0].isdigit() and 0 <= int(args[0]) <= 15:
+            return b"+OK\r\n"
+        return b"-ERR DB index is out of range\r\n"
 
     def _cmd_set(self, args):
         self._strings[args[0]] = args[1]

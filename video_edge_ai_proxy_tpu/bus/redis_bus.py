@@ -51,8 +51,18 @@ def _id_to_seq(entry_id: bytes) -> int:
 
 
 class RedisFrameBus(FrameBus):
-    def __init__(self, addr: str = "127.0.0.1:6379", timeout_s: float = 5.0):
-        self._client = RespClient.from_addr(addr, timeout_s)
+    def __init__(self, addr: str = "127.0.0.1:6379", timeout_s: float = 5.0,
+                 password: str = "", db: int = 0):
+        """``password``/``db`` mirror the reference's RedisSubconfig
+        (``config.go:28-35``: connection/database/password) — AUTH and
+        SELECT run on every (re)connect so resyncs keep credentials."""
+        handshake = []
+        if password:
+            handshake.append(("AUTH", password))
+        if db:
+            handshake.append(("SELECT", str(db)))
+        self._client = RespClient.from_addr(addr, timeout_s,
+                                            handshake=tuple(handshake))
         self._maxlen: dict[str, int] = {}  # producer-side ring depth
 
     # -- frame plane --

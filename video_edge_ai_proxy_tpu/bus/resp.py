@@ -33,8 +33,12 @@ class RespClient:
     link died) — benign under latest-wins frame semantics."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
-                 timeout_s: float = 5.0):
+                 timeout_s: float = 5.0, handshake: tuple = ()):
+        """``handshake``: commands (tuples) run on every (re)connect before
+        anything else — AUTH / SELECT, so a mid-run resync keeps its
+        credentials and database."""
         self._host, self._port, self._timeout = host, port, timeout_s
+        self._handshake = tuple(handshake)
         self._sock: Optional[socket.socket] = None
         self._buf = b""
         self._lock = threading.Lock()
@@ -46,13 +50,25 @@ class RespClient:
         )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b""
+        try:
+            for parts in self._handshake:
+                self._sock.sendall(self._encode(parts))
+                self._read_reply()  # RespError: bad AUTH must fail loudly
+        except BaseException:
+            # Never keep a half-initialized (unauthenticated / wrong-db)
+            # socket: later commands would reuse it instead of
+            # re-handshaking, and a failed constructor would leak the fd.
+            self.close()
+            raise
 
     @classmethod
-    def from_addr(cls, addr: str, timeout_s: float = 5.0) -> "RespClient":
+    def from_addr(cls, addr: str, timeout_s: float = 5.0,
+                  handshake: tuple = ()) -> "RespClient":
         host, _, port = addr.rpartition(":")
         if not host:  # "host" with no port, or ":6379"
             host, port = (port, "") if not port.isdigit() else ("", port)
-        return cls(host or "127.0.0.1", int(port or 6379), timeout_s)
+        return cls(host or "127.0.0.1", int(port or 6379), timeout_s,
+                   handshake=handshake)
 
     # -- wire --
 
@@ -97,16 +113,20 @@ class RespClient:
             return [self._read_reply() for _ in range(n)]
         raise RespError(f"unexpected reply type {line[:1]!r}")
 
-    def command(self, *parts: Union[str, bytes, int]) -> Reply:
+    @staticmethod
+    def _encode(parts) -> bytes:
         enc: List[bytes] = []
         for p in parts:
             if isinstance(p, bytes):
                 enc.append(p)
             else:
                 enc.append(str(p).encode())
-        msg = b"*%d\r\n" % len(enc) + b"".join(
+        return b"*%d\r\n" % len(enc) + b"".join(
             b"$%d\r\n%s\r\n" % (len(p), p) for p in enc
         )
+
+    def command(self, *parts: Union[str, bytes, int]) -> Reply:
+        msg = self._encode(parts)
         with self._lock:
             for attempt in (0, 1):
                 try:

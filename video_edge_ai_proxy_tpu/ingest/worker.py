@@ -64,6 +64,8 @@ class WorkerConfig:
     shm_dir: str = "/dev/shm/vep_tpu"
     bus_backend: str = "shm"
     redis_addr: str = "127.0.0.1:6379"
+    redis_password: str = ""
+    redis_db: int = 0
     max_frames: int = 0  # 0 = endless; tests set a bound
 
     @classmethod
@@ -81,6 +83,8 @@ class WorkerConfig:
             shm_dir=env.get("vep_shm_dir", "/dev/shm/vep_tpu"),
             bus_backend=env.get("vep_bus_backend", "shm"),
             redis_addr=env.get("vep_redis_addr", "127.0.0.1:6379"),
+            redis_password=env.get("vep_redis_password", ""),
+            redis_db=int(env.get("vep_redis_db", "0") or 0),
             max_frames=int(env.get("vep_max_frames", "0") or 0),
         )
 
@@ -94,7 +98,10 @@ class IngestWorker:
     ):
         self.cfg = cfg
         self._owns_bus = bus is None
-        self.bus = bus or open_bus(cfg.bus_backend, cfg.shm_dir, cfg.redis_addr)
+        self.bus = bus or open_bus(
+            cfg.bus_backend, cfg.shm_dir, cfg.redis_addr,
+            cfg.redis_password, cfg.redis_db,
+        )
         try:
             self.source = source or open_source(cfg.rtsp_endpoint)
         except Exception:
@@ -429,6 +436,8 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--shm_dir", default=env_cfg.shm_dir)
     p.add_argument("--bus_backend", default=env_cfg.bus_backend)
     p.add_argument("--redis_addr", default=env_cfg.redis_addr)
+    p.add_argument("--redis_password", default=env_cfg.redis_password)
+    p.add_argument("--redis_db", type=int, default=env_cfg.redis_db)
     p.add_argument("--max_frames", type=int, default=env_cfg.max_frames)
     args = p.parse_args(argv)
     if not args.rtsp or not args.device_id:
@@ -442,6 +451,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         shm_dir=args.shm_dir,
         bus_backend=args.bus_backend,
         redis_addr=args.redis_addr,
+        redis_password=args.redis_password,
+        redis_db=args.redis_db,
         max_frames=args.max_frames,
     )
     worker = IngestWorker(cfg)

@@ -169,6 +169,8 @@ class ProcessManager:
         python: str = sys.executable,
         bus_backend: str = "shm",
         redis_addr: str = "127.0.0.1:6379",
+        redis_password: str = "",
+        redis_db: int = 0,
         mem_limit_mb: int = WORKER_MEM_LIMIT_MB,
         nice: int = WORKER_NICE,
     ):
@@ -177,6 +179,8 @@ class ProcessManager:
         self._shm_dir = shm_dir
         self._bus_backend = bus_backend
         self._redis_addr = redis_addr
+        self._redis_password = redis_password
+        self._redis_db = redis_db
         self._disk_buffer_path = disk_buffer_path
         self._python = python
         self._mem_limit_mb = mem_limit_mb
@@ -250,6 +254,8 @@ class ProcessManager:
                 "shm" if self._bus_backend == "memory" else self._bus_backend
             ),
             vep_redis_addr=self._redis_addr,
+            vep_redis_password=self._redis_password,
+            vep_redis_db=str(self._redis_db),
             PYTHONUNBUFFERED="1",
         )
         proc = subprocess.Popen(

@@ -51,7 +51,8 @@ class Server:
         self.storage = Storage(os.path.join(data_dir, "registry.db"))
         self.bus = open_bus(
             bus_backend or self.cfg.bus.backend, self.cfg.bus.shm_dir,
-            self.cfg.bus.redis_addr,
+            self.cfg.bus.redis_addr, self.cfg.bus.redis_password,
+            self.cfg.bus.redis_db,
         )
         self.settings = SettingsManager(self.storage)
         self.process_manager = ProcessManager(
@@ -63,6 +64,8 @@ class Server:
             ),
             bus_backend=bus_backend or self.cfg.bus.backend,
             redis_addr=self.cfg.bus.redis_addr,
+            redis_password=self.cfg.bus.redis_password,
+            redis_db=self.cfg.bus.redis_db,
         )
         self.annotations = AnnotationQueue(
             handler=make_batch_handler(

@@ -34,8 +34,10 @@ class BusConfig:
     # Directory holding the shared-memory segments (one per camera + control KV).
     shm_dir: str = "/dev/shm/vep_tpu"
     # Redis server for backend "redis" (reference ``RedisSubconfig``
-    # connection string, ``config.go:28-35``).
+    # connection/database/password, ``config.go:28-35``).
     redis_addr: str = "127.0.0.1:6379"
+    redis_password: str = ""
+    redis_db: int = 0
     # Ring capacity per camera in frames; reference default is 1 in-memory frame
     # (``server/main.go:74``, latest-frame-wins semantics).
     ring_slots: int = 4
