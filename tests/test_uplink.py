@@ -75,3 +75,66 @@ class TestAnnotationMapping:
     def test_optional_fields_absent(self):
         out = annotation_to_cloud(pb.AnnotateRequest(device_name="c"))
         assert "bounding_box" not in out and "location" not in out
+
+
+class TestSignedUplinkWire:
+    def test_batch_handler_posts_signed_json(self):
+        """The uplink's actual wire call (reference annotation_consumer.go:90
+        + edge_service.go:39-49): a batch drains into ONE signed POST whose
+        JSON body is the cloud-event mapping, verified against the shared
+        secret by a local capture server."""
+        import http.server
+        import json
+        import threading
+
+        from video_edge_ai_proxy_tpu.uplink.cloud import make_batch_handler
+        from video_edge_ai_proxy_tpu.utils.signing import verify_signature
+
+        captured = {}
+
+        class Capture(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                captured.update(
+                    path=self.path, body=self.rfile.read(n),
+                    headers={k: v for k, v in self.headers.items()},
+                )
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *_a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), Capture)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            class FakeSettings:
+                def edge_credentials(self):
+                    return "ekey", "esecret"
+
+            handler = make_batch_handler(
+                FakeSettings(),
+                f"http://127.0.0.1:{httpd.server_port}/api/v1/annotate",
+            )
+            batch = [
+                pb.AnnotateRequest(
+                    device_name=f"cam{i}", type="moving", start_timestamp=i,
+                ).SerializeToString()
+                for i in range(3)
+            ]
+            assert handler(batch) is True
+            assert captured["path"] == "/api/v1/annotate"
+            events = json.loads(captured["body"])
+            assert [e["device_name"] for e in events] == ["cam0", "cam1", "cam2"]
+            low = {k.lower(): v for k, v in captured["headers"].items()}
+            canon = {
+                "X-ChrysEdge-Auth": low.get("x-chrysedge-auth", ""),
+                "X-Chrys-Date": low.get("x-chrys-date", ""),
+                "Content-MD5": low.get("content-md5", ""),
+            }
+            assert verify_signature(captured["body"], canon, "esecret")
+            assert canon["X-ChrysEdge-Auth"].startswith("ekey:")
+        finally:
+            httpd.shutdown()
+            httpd.server_close()

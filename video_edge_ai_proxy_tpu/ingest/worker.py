@@ -436,7 +436,9 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--shm_dir", default=env_cfg.shm_dir)
     p.add_argument("--bus_backend", default=env_cfg.bus_backend)
     p.add_argument("--redis_addr", default=env_cfg.redis_addr)
-    p.add_argument("--redis_password", default=env_cfg.redis_password)
+    # No --redis_password flag: argv is world-readable via /proc; the
+    # credential travels ONLY through the env contract (vep_redis_password),
+    # like the reference's env-var spawn interface.
     p.add_argument("--redis_db", type=int, default=env_cfg.redis_db)
     p.add_argument("--max_frames", type=int, default=env_cfg.max_frames)
     args = p.parse_args(argv)
@@ -451,7 +453,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         shm_dir=args.shm_dir,
         bus_backend=args.bus_backend,
         redis_addr=args.redis_addr,
-        redis_password=args.redis_password,
+        redis_password=env_cfg.redis_password,  # env-only (see above)
         redis_db=args.redis_db,
         max_frames=args.max_frames,
     )
