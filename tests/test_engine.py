@@ -378,6 +378,43 @@ class TestEngine:
         )
         assert np.asarray(out["top_probs"]).shape[0] == groups[0].bucket
 
+    def test_mesh_with_per_stream_models(self, bus):
+        """Fleet configuration: dp-sharded mesh serving AND per-stream
+        model overrides together — the extra model's params must be
+        replicated onto the mesh and its batches dp-shardable, same as
+        the default model's."""
+        import jax
+
+        assignments = {"cam_det": "tiny_yolov8", "cam_cls": ""}
+        cfg = EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(2, 4), tick_ms=5,
+            mesh={"dp": 2},
+        )
+        eng = InferenceEngine(
+            bus, cfg, model_resolver=lambda d: assignments.get(d, ""),
+        )
+        eng.warmup()
+        for did in assignments:
+            bus.create_stream(did, 64 * 64 * 3)
+            _publish(bus, did, w=64, h=64)
+        groups = eng._collector.collect()
+        by_model = {g.model: g for g in groups}
+        assert set(by_model) == {"tiny_yolov8", "tiny_mobilenet_v2"}
+        for model, group in by_model.items():
+            assert group.bucket % 2 == 0          # dp-divisible padding
+            _, _, variables = eng._models[model] if model in eng._models \
+                else eng._ensure_model(model)
+            placed = eng._place(group.frames)
+            assert len(placed.sharding.device_set) == 2
+            out = eng._step(group.src_hw, group.bucket, model)(
+                variables, placed
+            )
+            assert next(iter(out.values())).shape[0] == group.bucket
+            # Extra model's params live on the mesh (replicated), not on
+            # one device.
+            leaf = jax.tree_util.tree_leaves(variables)[0]
+            assert len(leaf.sharding.device_set) == 2
+
     def test_per_stream_model_selection(self, bus):
         """Streams with different inference_model records run different
         models in the same engine, batched separately."""
