@@ -137,3 +137,42 @@ class TestQuantizedEngine:
                 eng.warmup()
         finally:
             bus.close()
+
+
+class TestQuantizedMeshServing:
+    def test_int8_params_replicate_onto_mesh(self):
+        """cfg.quantize='int8' + cfg.mesh together (fleet configuration):
+        the QuantizedTree must replicate onto the mesh and the
+        dequantize-in-graph step must run dp-sharded."""
+        import jax
+
+        bus = MemoryFrameBus()
+        try:
+            bus.create_stream("cam1", 64 * 64 * 3)
+            cfg = EngineConfig(
+                model="tiny_yolov8", batch_buckets=(2, 4), tick_ms=5,
+                quantize="int8", mesh={"dp": 2},
+            )
+            eng = InferenceEngine(bus, cfg)
+            eng.warmup()
+            from video_edge_ai_proxy_tpu.models.quantize import QuantizedTree
+
+            assert isinstance(eng._variables, QuantizedTree)
+            leaf = jax.tree_util.tree_leaves(eng._variables)[0]
+            assert len(leaf.sharding.device_set) == 2  # on the mesh
+            from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+
+            bus.publish(
+                "cam1", np.full((64, 64, 3), 128, np.uint8),
+                FrameMeta(width=64, height=64, channels=3,
+                          timestamp_ms=1, is_keyframe=True),
+            )
+            groups = eng._collector.collect()
+            placed = eng._place(groups[0].frames)
+            assert len(placed.sharding.device_set) == 2
+            out = eng._step(groups[0].src_hw, groups[0].bucket)(
+                eng._variables, placed
+            )
+            assert next(iter(out.values())).shape[0] == groups[0].bucket
+        finally:
+            bus.close()
