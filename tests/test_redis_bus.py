@@ -77,6 +77,25 @@ class TestFrameBusSemantics:
         bus.drop_stream("a")
         assert bus.streams() == ["b"]
 
+    def test_streams_ignores_foreign_stream_keys(self, bus, raw):
+        """Mixed-fleet db hygiene (round-2 advisor): a co-tenant app's
+        stream key in the SAME db must not be reported as a camera, while
+        a reference worker's stream (XADD VideoFrame, no control keys yet)
+        and our own just-created EMPTY stream both must be."""
+        bus.create_stream("empty_cam", 27)          # ours, no frames yet
+        # Foreign: some other app's event stream in the shared db.
+        raw.command("XADD", "celery_tasks", "*", "job", "encode",
+                    "state", "done")
+        # Reference worker: VideoFrame proto under `data`, nothing else.
+        img = np.zeros((4, 4, 3), np.uint8)
+        vf = pb.VideoFrame(data=img.tobytes(), width=4, height=4)
+        for i, d in enumerate(img.shape):
+            vf.shape.dim.append(pb.ShapeProto.Dim(size=d, name=str(i)))
+        raw.command("XADD", "refcam", "*", "data", vf.SerializeToString())
+        assert bus.streams() == ["empty_cam", "refcam"]
+        # Reject verdicts are cached: repeat listing stays clean.
+        assert "celery_tasks" not in bus.streams()
+
     def test_kv_and_hash(self, bus):
         bus.kv_set("k", "v")
         assert bus.kv_get("k") == "v"

@@ -216,6 +216,11 @@ class MiniRedis:
     def _cmd_scan(self, args):
         # One-shot scan: returns cursor 0 with everything (valid per the
         # SCAN contract — the server may return all keys in one page).
+        # A non-zero input cursor can therefore never be produced by a
+        # well-behaved client of THIS server; fail loudly instead of
+        # silently restarting the scan (round-2 advisor).
+        if args[0] != b"0":
+            return b"-ERR invalid cursor (miniredis scans are one-shot)\r\n"
         match, want_type = "*", None
         i = 1
         while i < len(args):
@@ -243,6 +248,13 @@ class MiniRedis:
                 added += 1
             h[f] = v
         return b":%d\r\n" % added
+
+    def _cmd_hsetnx(self, args):
+        h = self._hashes.setdefault(args[0], {})
+        if args[1] in h:
+            return b":0\r\n"
+        h[args[1]] = args[2]
+        return b":1\r\n"
 
     def _cmd_hget(self, args):
         return self._bulk(self._hashes.get(args[0], {}).get(args[1]))
@@ -333,12 +345,40 @@ class MiniRedis:
             b"last-generated-id", b"%d-%d" % last,
         ])
 
+    @staticmethod
+    def _range_bound(raw: bytes, is_start: bool):
+        """One XRANGE/XREVRANGE id bound -> inclusive (ms, n) tuple.
+        Supports the sentinels and explicit "ms[-n]" ids (missing seq
+        defaults to 0 for a start bound, +inf for an end bound — real
+        Redis semantics). Exclusive "(" bounds are not implemented and
+        fail loudly rather than silently returning wrong data."""
+        if raw == b"-":
+            return (0, 0)
+        if raw == b"+":
+            return (1 << 63, 1 << 63)
+        if raw.startswith(b"("):
+            raise ValueError("exclusive range bounds unsupported")
+        ms, sep, n = raw.partition(b"-")
+        if sep:
+            return (int(ms), int(n))
+        return (int(ms), 0 if is_start else 1 << 63)
+
+    def _xrange_entries(self, key, lo_raw, hi_raw):
+        lo = self._range_bound(lo_raw, True)
+        hi = self._range_bound(hi_raw, False)
+        return [e for e in self._streams.get(key, []) if lo <= e[0] <= hi]
+
     def _cmd_xrevrange(self, args):
-        key = args[0]
+        # NOTE argument order: XREVRANGE key END START.
         count = None
         if len(args) >= 5 and args[3].upper() == b"COUNT":
             count = int(args[4])
-        entries = list(reversed(self._streams.get(key, [])))
+        try:
+            entries = list(reversed(
+                self._xrange_entries(args[0], args[2], args[1])
+            ))
+        except ValueError as exc:
+            return b"-ERR %s\r\n" % str(exc).encode()
         if count is not None:
             entries = entries[:count]
         return self._arr([
@@ -346,11 +386,13 @@ class MiniRedis:
         ])
 
     def _cmd_xrange(self, args):
-        key = args[0]
         count = None
         if len(args) >= 5 and args[3].upper() == b"COUNT":
             count = int(args[4])
-        entries = self._streams.get(key, [])
+        try:
+            entries = self._xrange_entries(args[0], args[1], args[2])
+        except ValueError as exc:
+            return b"-ERR %s\r\n" % str(exc).encode()
         if count is not None:
             entries = entries[:count]
         return self._arr([

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..utils.logging import get_logger
 from .interface import (
+    FIELD_LAST_QUERY,
     KEY_KEYFRAME_ONLY_PREFIX,
     KEY_LAST_ACCESS_PREFIX,
     Frame,
@@ -64,6 +65,11 @@ class RedisFrameBus(FrameBus):
         self._client = RespClient.from_addr(addr, timeout_s,
                                             handshake=tuple(handshake))
         self._maxlen: dict[str, int] = {}  # producer-side ring depth
+        # streams() verdict cache: key -> (is_frame_stream, probed_at).
+        # Accepts are permanent (drop_stream evicts); rejects re-probe
+        # after _REPROBE_S so a foreign-looking key that later becomes a
+        # real camera is picked up without per-poll payload fetches.
+        self._stream_verdict: dict[str, tuple[bool, float]] = {}
 
     # -- frame plane --
 
@@ -72,6 +78,14 @@ class RedisFrameBus(FrameBus):
         # no Redis equivalent (streams size dynamically).
         self._maxlen[device_id] = max(1, slots)
         self._client.command("DEL", device_id)
+        # Seed the reference-shaped control hash (grpc_api.go:159-175
+        # writes the same key on Query) so streams() can tell OUR empty
+        # stream apart from a co-tenant app's stream key without probing
+        # payloads. HSETNX: never clobber a live last_query.
+        self._client.command(
+            "HSETNX", KEY_LAST_ACCESS_PREFIX + device_id, FIELD_LAST_QUERY,
+            "0",
+        )
         # The FrameBus contract lists a created stream before its first
         # frame (streams()). XGROUP CREATE MKSTREAM materializes an EMPTY
         # stream key atomically — unlike an XADD+XDEL placeholder, no
@@ -138,11 +152,75 @@ class RedisFrameBus(FrameBus):
             return None
         return Frame(seq=seq, **_unmarshal(payload))
 
+    _REPROBE_S = 10.0  # rejected-key re-probe interval
+
     def streams(self) -> list[str]:
-        return self._scan_keys("stream")
+        """Stream-typed keys that are actually camera frame streams.
+
+        The db is shared in the mixed-fleet deployment this backend exists
+        for, so a bare ``SCAN TYPE stream`` would report co-tenant apps'
+        stream keys as cameras and the engine would unmarshal their
+        entries as VideoFrame protos (round-2 advisor). A key qualifies
+        when
+        - reference-shaped control keys exist for it
+          (``last_access_time_<id>`` / ``is_key_frame_only_<id>`` —
+          ``create_stream`` seeds the former, the reference server writes
+          it on Query, grpc_api.go:159-175), or
+        - its newest entry carries the reference frame contract: a
+          ``data`` field parsing as a VideoFrame with pixel payload
+          (covers a reference worker XADD-ing before any query).
+        Accepts are cached (evicted by drop_stream); rejects re-probe
+        every ``_REPROBE_S`` so no per-poll payload traffic goes to
+        foreign keys."""
+        import time
+
+        now = time.monotonic()
+        out = []
+        scanned = self._scan_keys("stream")
+        for key in scanned:
+            verdict = self._stream_verdict.get(key)
+            if verdict is None or (
+                not verdict[0] and now - verdict[1] > self._REPROBE_S
+            ):
+                verdict = (self._is_frame_stream(key), now)
+                self._stream_verdict[key] = verdict
+            if verdict[0]:
+                out.append(key)
+        # Prune verdicts for keys gone from the db (co-tenant apps churn
+        # ephemeral stream names; without this the cache grows for the
+        # life of the process).
+        if len(self._stream_verdict) > len(scanned):
+            keep = set(scanned)
+            self._stream_verdict = {
+                k: v for k, v in self._stream_verdict.items() if k in keep
+            }
+        return out
+
+    def _is_frame_stream(self, key: str) -> bool:
+        if self._client.command(
+            "EXISTS", KEY_LAST_ACCESS_PREFIX + key,
+            KEY_KEYFRAME_ONLY_PREFIX + key,
+        ):
+            return True
+        reply = self._client.command("XREVRANGE", key, "+", "-", "COUNT", "1")
+        if not reply:
+            return False  # empty + no control keys: not one of ours
+        _, fields = reply[0]
+        payload = dict(zip(fields[::2], fields[1::2])).get(b"data")
+        if payload is None:
+            return False
+        from ..proto import pb
+
+        try:
+            vf = pb.VideoFrame()
+            vf.ParseFromString(payload)
+        except Exception:
+            return False
+        return bool(vf.data) and bool(vf.shape.dim)
 
     def drop_stream(self, device_id: str) -> None:
         self._client.command("DEL", device_id)
+        self._stream_verdict.pop(device_id, None)
 
     # -- control plane: plain KV --
     #

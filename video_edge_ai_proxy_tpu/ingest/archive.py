@@ -70,10 +70,13 @@ class PacketGopSegment:
         total = sum(max(p.duration, 0) for p in self.packets)
         if total > 0:
             return int(total * scale)
-        if len(self.packets) >= 2:
-            span = self.packets[-1].dts - self.packets[0].dts
+        # Span over packets that carry a real dts (None = AV_NOPTS —
+        # arithmetic on the raw sentinel would wrap int64).
+        valid = [p.dts for p in self.packets if p.dts is not None]
+        if len(valid) >= 2:
+            span = valid[-1] - valid[0]
             # Span misses the last frame's display time; pro-rate it.
-            span += span // max(len(self.packets) - 1, 1)
+            span += span // max(len(valid) - 1, 1)
             return int(span * scale)
         return 0
 
@@ -147,7 +150,15 @@ class SegmentArchiver:
         0 (reference ``python/archive.py:81-84``). No transcode."""
         from .av import StreamCopyMuxer
 
-        base = seg.packets[0].dts
+        # GOP head may carry no dts (AV_NOPTS -> None): rebase from the
+        # first packet carrying any timestamp (dts, else pts — equal at
+        # a GOP head); if none do, write unrebased and let libav derive.
+        base = next(
+            (p.dts if p.dts is not None else p.pts
+             for p in seg.packets
+             if p.dts is not None or p.pts is not None),
+            0,
+        )
         mux = StreamCopyMuxer(path, seg.info)
         with mux:
             for pkt in seg.packets:

@@ -192,12 +192,27 @@ class StreamInfo:
         return c
 
 
+# libav's "no timestamp" sentinel (INT64_MIN). Mapped to None at this
+# boundary: arithmetic on the raw sentinel (rebasing, spans) silently
+# wraps int64 into garbage timestamps, and RTSP sources DO emit it on
+# early packets. Mux.write maps None back so libav's own rescale
+# handles it.
+AV_NOPTS_VALUE = -(2 ** 63)
+
+
+def _ts(v: int) -> Optional[int]:
+    v = int(v)
+    return None if v == AV_NOPTS_VALUE else v
+
+
 @dataclass
 class Packet:
-    """One demuxed compressed packet (timestamps in stream time_base)."""
+    """One demuxed compressed packet (timestamps in stream time_base).
+    ``pts``/``dts`` are None when the source supplied no timestamp
+    (libav AV_NOPTS_VALUE)."""
 
-    pts: int
-    dts: int
+    pts: Optional[int]
+    dts: Optional[int]
     duration: int
     is_keyframe: bool
     is_corrupt: bool
@@ -234,7 +249,7 @@ class PacketDemuxer:
         w = max(self.info.width, 16)
         h = max(self.info.height, 16)
         self._frame_buf = np.empty(w * h * 3, np.uint8)
-        self.last_frame_pts: int = 0
+        self.last_frame_pts: Optional[int] = 0
         self.last_frame_type: str = ""
 
     def read(self, want_data: bool = False) -> Optional[Packet]:
@@ -254,7 +269,7 @@ class PacketDemuxer:
             n = self._lib.va_pkt_data(self._h, _u8(buf), buf.nbytes)
             data = bytes(buf[:n]) if n > 0 else b""
         return Packet(
-            pts=int(m.pts), dts=int(m.dts), duration=int(m.duration),
+            pts=_ts(m.pts), dts=_ts(m.dts), duration=int(m.duration),
             is_keyframe=bool(m.is_keyframe), is_corrupt=bool(m.is_corrupt),
             data=data,
         )
@@ -272,7 +287,7 @@ class PacketDemuxer:
 
     def _finish_frame(self, n: int) -> np.ndarray:
         fm = self._fmeta
-        self.last_frame_pts = int(fm.pts)
+        self.last_frame_pts = _ts(fm.pts)
         self.last_frame_type = self._PICT.get(int(fm.pict_type), "")
         h, w = int(fm.height), int(fm.width)
         return self._frame_buf[:n].reshape(h, w, 3).copy()
@@ -353,11 +368,15 @@ class StreamCopyMuxer:
 
     def write(self, pkt: Packet, ts_offset: int = 0) -> None:
         """Write one packet; ``ts_offset`` rebases pts/dts (the archive
-        rebases each segment to 0 like the reference, archive.py:81-84)."""
+        rebases each segment to 0 like the reference, archive.py:81-84).
+        A None pts/dts goes through as AV_NOPTS_VALUE unrebased —
+        av_packet_rescale_ts preserves the sentinel and the muxer derives
+        what it can."""
         data = np.frombuffer(pkt.data, np.uint8)
         rc = self._lib.vm_write(
             self._h, _u8(data), data.size,
-            pkt.pts - ts_offset, pkt.dts - ts_offset,
+            AV_NOPTS_VALUE if pkt.pts is None else pkt.pts - ts_offset,
+            AV_NOPTS_VALUE if pkt.dts is None else pkt.dts - ts_offset,
             max(pkt.duration, 0), int(pkt.is_keyframe),
         )
         if rc < 0:
@@ -421,7 +440,7 @@ class Encoder:
                 raise IOError(f"encode error: {_strerror(n)}")
             m = self._meta
             out.append(Packet(
-                pts=int(m.pts), dts=int(m.dts), duration=int(m.duration),
+                pts=_ts(m.pts), dts=_ts(m.dts), duration=int(m.duration),
                 is_keyframe=bool(m.is_keyframe), is_corrupt=False,
                 data=bytes(self._buf[:n]),
             ))

@@ -53,7 +53,8 @@ class PacketPassthroughWriter:
         self._gop_bytes = 0
         self._max_buffer_bytes = max_buffer_bytes
         self._mux = None
-        self._base_ts: Optional[int] = None  # first relayed dts -> 0
+        self._base_ts: Optional[int] = None  # first valid relayed dts -> 0
+        self._started = False                # keyframe seen on this sink
         self._failed = False
         self._failed_at = 0.0
         self.requested = False
@@ -159,20 +160,31 @@ class PacketPassthroughWriter:
             self._fail(str(exc))
             return False
         self._base_ts = None
+        self._started = False
         return True
 
     def _write(self, pkt) -> None:
         if self._mux is None:
             return
-        if self._base_ts is None:
+        if not self._started:
             if not pkt.is_keyframe:
                 # Fresh sink with nothing flushed yet (oversized-GOP drop,
                 # or a reconnect resume): the remote stream must begin at a
                 # keyframe to be decodable — hold until the next GOP head.
                 return
-            self._base_ts = pkt.dts
+            self._started = True
+        if self._base_ts is None:
+            # RTSP sources emit AV_NOPTS (None here) on early packets;
+            # rebase from the first packet carrying any real timestamp
+            # (dts, else pts — equal at a GOP head) so a head with pts
+            # but no dts doesn't go out huge-and-unrebased followed by
+            # rebased ~0 packets (non-monotonic ts kills the sink).
+            # Both-None packets pass through for libav to derive.
+            ts = pkt.dts if pkt.dts is not None else pkt.pts
+            if ts is not None:
+                self._base_ts = ts
         try:
-            self._mux.write(pkt, ts_offset=self._base_ts)
+            self._mux.write(pkt, ts_offset=self._base_ts or 0)
             self.written += 1
         except IOError as exc:
             self._fail(str(exc))

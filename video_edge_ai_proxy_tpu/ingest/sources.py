@@ -33,8 +33,10 @@ class PacketInfo:
 
     packet: int          # 0-based packet counter
     is_keyframe: bool
-    pts: int
-    dts: int
+    # None = source supplied no timestamp (libav AV_NOPTS, mapped at the
+    # av.py boundary); consumers must not do arithmetic on None.
+    pts: Optional[int]
+    dts: Optional[int]
     timestamp_ms: int    # wall-clock at demux (reference uses wallclock PTS)
     time_base: float
     # Demuxer-flagged corruption, shipped through VideoFrame.is_corrupt

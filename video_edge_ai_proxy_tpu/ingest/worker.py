@@ -347,13 +347,18 @@ class IngestWorker:
                     # publish the FRAME's presentation time (reference fills
                     # VideoFrame from the frame, read_image.py:99-117).
                     frame_pts = getattr(self.source, "last_frame_pts", None)
+                    if frame_pts is None:
+                        frame_pts = pkt.pts
                     meta = FrameMeta(
                         width=frame.shape[1],
                         height=frame.shape[0],
                         channels=frame.shape[2] if frame.ndim == 3 else 1,
                         timestamp_ms=now_ms,
-                        pts=frame_pts if frame_pts is not None else pkt.pts,
-                        dts=pkt.dts,
+                        # VideoFrame proto pts/dts are int64; a source
+                        # that supplied none (AV_NOPTS -> None) ships 0,
+                        # matching libav's own "unknown" downgrade.
+                        pts=frame_pts if frame_pts is not None else 0,
+                        dts=pkt.dts if pkt.dts is not None else 0,
                         packet=pkt.packet,
                         keyframe_cnt=self._keyframes,
                         is_keyframe=pkt.is_keyframe,

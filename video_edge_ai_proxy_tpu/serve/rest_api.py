@@ -261,19 +261,21 @@ def build_app(
         return web.Response(status=200)
 
     async def healthz(_request: web.Request) -> web.Response:
-        """Liveness/readiness: 200 when every enabled plane is healthy,
-        503 otherwise (k8s-style). Covers the server itself, the worker
-        fleet (running/total), and — when the inference plane is on — the
-        engine's TPU-side health (SURVEY.md §5.3: device liveness, tick
-        liveness, compile-cache warmth).
+        """Liveness/readiness: 200 when the *server* is healthy, 503 only
+        on server/engine-level failure (k8s-style). The reference keeps
+        server health independent of per-camera container state
+        (restart-always supervision); mirroring that, one unreachable
+        camera — routine in a fleet, and its failing streak never resets
+        while the RTSP endpoint is down — must NOT pull the API/portal
+        (the very tools needed to fix the camera) out of rotation.
 
-        Worker gating: registered workers are *desired running*
-        (restart-always parity), so the fleet degrades the status when a
-        registered worker is down AND either crash-looping (streak > 1 —
-        a single exit puts every routine restart's backoff window at
-        streak 1, which is supervision, not degradation) or dead with no
-        supervised process at all (resume failed: nothing will ever
-        restart it — the worst outage class)."""
+        Fleet state is still fully reported in the body
+        (``workers.crash_looping``, ``workers.fleet``) and in `/metrics`
+        + `ListStreams`; the HTTP status degrades only when
+          * the engine plane is enabled and unhealthy (device/tick), or
+          * the ENTIRE registered fleet is down and failing (running == 0
+            with every worker crash-looping/dead) — systemic supervisor
+            failure, not a camera outage."""
         procs = await asyncio.to_thread(pm.list)
         running = sum(1 for p in procs if p.state and p.state.running)
         crash_looping = sum(
@@ -287,10 +289,14 @@ def build_app(
                 "running": running,
                 "total": len(procs),
                 "crash_looping": crash_looping,
+                "fleet": "degraded" if crash_looping else "ok",
             },
             "engine": None,
         }
-        healthy = crash_looping == 0
+        fleet_collapsed = (
+            len(procs) > 0 and running == 0 and crash_looping == len(procs)
+        )
+        healthy = not fleet_collapsed
         if engine is not None:
             h = await asyncio.to_thread(engine.health)
             body["engine"] = h
