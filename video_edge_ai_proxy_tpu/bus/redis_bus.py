@@ -219,7 +219,16 @@ class RedisFrameBus(FrameBus):
         return bool(vf.data) and bool(vf.shape.dim)
 
     def drop_stream(self, device_id: str) -> None:
-        self._client.command("DEL", device_id)
+        # Also remove the control keys create_stream seeded: an orphaned
+        # last_access_time_<id> hash in the shared db would make a future
+        # same-named FOREIGN stream key pass _is_frame_stream. The process
+        # manager deletes the same keys on its own stop path — this keeps
+        # bus-level users (engine-only deployments, tests) equally clean.
+        self._client.command(
+            "DEL", device_id,
+            KEY_LAST_ACCESS_PREFIX + device_id,
+            KEY_KEYFRAME_ONLY_PREFIX + device_id,
+        )
         self._stream_verdict.pop(device_id, None)
 
     # -- control plane: plain KV --

@@ -45,6 +45,21 @@ log = get_logger("engine.runner")
 TOP_K_CLASSES = 5
 
 
+def _rebox(template, values):
+    """Re-attach flax AxisMetadata boxes (logical sharding names) from
+    ``template`` onto the raw arrays in ``values`` — the inverse of
+    ``parallel.sharding.unbox`` for checkpoint restore."""
+    import flax.linen as nn
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda box, val: box.replace_boxed(val)
+        if isinstance(box, nn.meta.AxisMetadata) else val,
+        template, values,
+        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
+    )
+
+
 def build_serving_step(model, spec):
     """The per-tick device program for one model kind: uint8 frames in,
     postprocessed results out. SINGLE source of truth — the engine compiles
@@ -202,11 +217,20 @@ class InferenceEngine:
         )
         ckpt = self._cfg.checkpoint_path
         if ckpt:
+            from ..parallel.sharding import unbox
             from ..utils.checkpoint import load_msgpack
 
             if os.path.exists(ckpt):
+                # Checkpoints are UNBOXED raw trees (the canonical format
+                # tools/import_weights.py writes and save_checkpoint
+                # mirrors); restore against an unboxed template, then
+                # re-box so ViT-family logical sharding names survive for
+                # mesh serving.
+                raw = load_msgpack(
+                    ckpt, jax.tree.map(np.asarray, unbox(self._variables))
+                )
                 self._variables = jax.device_put(
-                    load_msgpack(ckpt, jax.tree.map(np.asarray, self._variables))
+                    _rebox(self._variables, raw)
                 )
                 log.info("loaded engine params from %s", ckpt)
             else:
@@ -385,7 +409,11 @@ class InferenceEngine:
                 "params); keep a copy of the source checkpoint"
             )
             variables = dequantize_tree(variables)
-        save_msgpack(path, jax.tree.map(np.asarray, variables))
+        # Unboxed raw trees on disk — one canonical format shared with
+        # tools/import_weights.py (see the load path in warmup).
+        from ..parallel.sharding import unbox
+
+        save_msgpack(path, jax.tree.map(np.asarray, unbox(variables)))
         return path
 
     def start(self) -> None:

@@ -45,15 +45,26 @@ class ConvBN(nn.Module):
     stride: int = 1
     groups: int = 1
     act: str = "silu"
+    # BN epsilon is part of the checkpoint contract: ultralytics YOLO
+    # trains with 1e-3 (our default), torchvision convnets with 1e-5
+    # (ResNet passes it) — a mismatch skews every channel whose running
+    # variance is small, so imported weights would drift layer by layer.
+    epsilon: float = 1e-3
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        k = self.kernel
         x = nn.Conv(
             self.features,
-            kernel_size=(self.kernel, self.kernel),
+            kernel_size=(k, k),
             strides=(self.stride, self.stride),
-            padding="SAME",
+            # Explicit symmetric k//2 padding, NOT "SAME": identical for
+            # stride 1, but at stride 2 on even inputs XLA's SAME pads
+            # (0, 1) while every torch-trained checkpoint saw (1, 1) —
+            # same output shape, different pixels sampled, so imported
+            # weights would see shifted borders at all 5 down-samplings.
+            padding=((k // 2, k // 2), (k // 2, k // 2)),
             feature_group_count=self.groups,
             use_bias=False,
             dtype=self.dtype,
@@ -62,7 +73,7 @@ class ConvBN(nn.Module):
         x = nn.BatchNorm(
             use_running_average=not train,
             momentum=0.97,
-            epsilon=1e-3,
+            epsilon=self.epsilon,
             dtype=jnp.float32,
             name="bn",
         )(x.astype(jnp.float32))

@@ -1,0 +1,309 @@
+"""Torch-layout checkpoint importer: canonical state dicts → flax trees.
+
+The reference feeds frames to client-owned models that arrive pre-trained
+(`/root/reference/examples/opencv_display.py:19` — the client brings real
+weights; the proxy never trains). The TPU inference plane must match that
+capability: an operator with a published checkpoint converts it offline
+(no network) and serves it. This module maps the three canonical
+community layouts onto our flax module trees:
+
+- ``yolov8n``/``yolov8s``/``tiny_yolov8`` ← ultralytics ``model.state_dict()``
+  names (``model.0.conv.weight`` … ``model.22.cv3.2.2.bias``),
+- ``resnet50``/``tiny_resnet`` ← torchvision names (``conv1.weight``,
+  ``layer3.5.bn2.running_var``, ``fc.weight``),
+- ``vit_b16``/``tiny_vit`` ← timm ViT names (``blocks.7.attn.qkv.weight``,
+  ``patch_embed.proj.weight``, ``head.bias``).
+
+Transforms applied (the whole reason a renamer isn't enough):
+- conv kernels OIHW → HWIO,
+- linear weights [out, in] → [in, out],
+- BatchNorm weight/bias/running_mean/running_var →
+  scale/bias + batch_stats mean/var.
+
+Accounting is strict: every target leaf must be assigned exactly once and
+every source tensor consumed (modulo an explicit ignore list, e.g.
+ultralytics' fixed DFL arange conv and ``num_batches_tracked``), so a
+layout drift fails loudly instead of serving half-imported weights.
+
+Numerical parity prerequisites live in the models themselves: explicit
+k//2 conv padding and per-family BN epsilon (``common.py::ConvBN``) —
+``tests/test_import_weights.py`` proves output equality against torch
+golden modules built in the source layouts.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["convert", "load_state_dict", "SUPPORTED"]
+
+# source-key suffix -> (our leaf name, collection)
+_BN_LEAF = {
+    "weight": ("scale", "params"),
+    "bias": ("bias", "params"),
+    "running_mean": ("mean", "batch_stats"),
+    "running_var": ("var", "batch_stats"),
+}
+
+
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read a state dict from .npz / .safetensors / torch .pt|.pth into
+    plain float32 numpy (imports are offline; fp32 is the interchange)."""
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: np.asarray(z[k], np.float32) for k in z.files}
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return {k: np.asarray(v, np.float32)
+                for k, v in load_file(path).items()}
+    # torch pickle (weights_only: never execute code from a checkpoint)
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    if not isinstance(obj, dict):
+        raise ValueError(f"unsupported checkpoint object in {path!r}")
+    # common wrappers: {'model': sd} / {'state_dict': sd}
+    for wrapper in ("state_dict", "model"):
+        if wrapper in obj and isinstance(obj[wrapper], dict):
+            obj = obj[wrapper]
+    return {
+        k: np.asarray(v.detach().float().numpy() if hasattr(v, "detach")
+                      else v, np.float32)
+        for k, v in obj.items()
+        if hasattr(v, "shape")
+    }
+
+
+def _strip_model_prefix(state: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """ultralytics nests the module list under 1-2 ``model.`` levels
+    depending on how the dict was exported; normalize to bare indices."""
+    while state and all(k.startswith("model.") for k in state):
+        state = {k[len("model."):]: v for k, v in state.items()}
+    return state
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    """torch OIHW -> flax HWIO."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def _dense_kernel(w: np.ndarray) -> np.ndarray:
+    """torch [out, in] -> flax [in, out]."""
+    return np.transpose(w)
+
+
+# ---------------------------------------------------------------- yolo --
+
+# our backbone/neck module name -> ultralytics module-list index
+# (ultralytics/cfg/models/v8/yolov8.yaml order; 10/11/13/14/17/20 are
+# parameter-free Upsample/Concat entries)
+_YOLO_IDX = {
+    "stem": 0, "down2": 1, "c2f_2": 2, "down3": 3, "c2f_3": 4,
+    "down4": 5, "c2f_4": 6, "down5": 7, "c2f_5": 8, "sppf": 9,
+    "neck_up4": 12, "neck_up3": 15, "neck_down4": 16, "neck_out4": 18,
+    "neck_down5": 19, "neck_out5": 21,
+}
+
+
+def _yolo_key(path: Tuple[str, ...]) -> Tuple[str, Optional[Callable]]:
+    """flax path (collection stripped) -> (ultralytics key, transform)."""
+    mod, rest = path[0], path[1:]
+    if mod == "detect":
+        # box{l}_* = cv2.{l}.{0,1,2}, cls{l}_* = cv3.{l}.{0,1,2}
+        head, rest = rest[0], rest[1:]
+        branch = "cv2" if head.startswith("box") else "cv3"
+        level = head[3]
+        sub = head.split("_", 1)[1]          # cv1 | cv2 | out
+        slot = {"cv1": "0", "cv2": "1", "out": "2"}[sub]
+        prefix = f"22.{branch}.{level}.{slot}"
+        if sub == "out":                      # plain conv w/ bias
+            leaf = rest[0]
+            if leaf == "kernel":
+                return f"{prefix}.weight", _conv_kernel
+            return f"{prefix}.bias", None
+        return _convbn_leaf(prefix, rest)
+    idx = _YOLO_IDX[mod]
+    if mod.startswith("c2f") or mod.startswith("neck_up") or \
+            mod.startswith("neck_out"):
+        sub = rest[0]
+        if sub.startswith("m"):               # bottleneck m{i}.cv{1,2}
+            return _convbn_leaf(f"{idx}.m.{sub[1:]}.{rest[1]}", rest[2:])
+        return _convbn_leaf(f"{idx}.{sub}", rest[1:])
+    if mod == "sppf":
+        return _convbn_leaf(f"{idx}.{rest[0]}", rest[1:])
+    return _convbn_leaf(str(idx), rest)       # plain ConvBN stage
+
+
+def _convbn_leaf(prefix: str,
+                 rest: Tuple[str, ...]) -> Tuple[str, Optional[Callable]]:
+    """(conv|bn, leaf) below a ConvBN — shared by every family."""
+    sub, leaf = rest[0], rest[1]
+    if sub == "conv":
+        return f"{prefix}.conv.weight", _conv_kernel
+    src = {"scale": "weight", "bias": "bias",
+           "mean": "running_mean", "var": "running_var"}[leaf]
+    return f"{prefix}.bn.{src}", None
+
+
+# -------------------------------------------------------------- resnet --
+
+def _resnet_key(path: Tuple[str, ...]) -> Tuple[str, Optional[Callable]]:
+    mod, rest = path[0], path[1:]
+    if mod == "stem":
+        sub, leaf = rest
+        if sub == "conv":
+            return "conv1.weight", _conv_kernel
+        src = {"scale": "weight", "bias": "bias",
+               "mean": "running_mean", "var": "running_var"}[leaf]
+        return f"bn1.{src}", None
+    if mod == "classifier":
+        if rest[0] == "kernel":
+            return "fc.weight", _dense_kernel
+        return "fc.bias", None
+    # stage{si}_block{bi} -> layer{si+1}.{bi}
+    stage, block = mod.split("_")
+    prefix = f"layer{int(stage[5:]) + 1}.{int(block[5:])}"
+    sub, conv_or_bn, leaf = rest
+    if sub == "downsample":
+        slot = "0" if conv_or_bn == "conv" else "1"
+        if conv_or_bn == "conv":
+            return f"{prefix}.downsample.0.weight", _conv_kernel
+        src = {"scale": "weight", "bias": "bias",
+               "mean": "running_mean", "var": "running_var"}[leaf]
+        return f"{prefix}.downsample.{slot}.{src}", None
+    # conv{j}: conv weight from .conv{j}.weight, bn from .bn{j}.*
+    j = sub[4:]
+    if conv_or_bn == "conv":
+        return f"{prefix}.conv{j}.weight", _conv_kernel
+    src = {"scale": "weight", "bias": "bias",
+           "mean": "running_mean", "var": "running_var"}[leaf]
+    return f"{prefix}.bn{j}.{src}", None
+
+
+# ----------------------------------------------------------------- vit --
+
+def _vit_key(path: Tuple[str, ...]) -> Tuple[str, Optional[Callable]]:
+    mod, rest = path[0], path[1:]
+    if mod == "cls_token":
+        return "cls_token", None
+    if mod == "pos_embed":
+        return "pos_embed", None
+    if mod == "patch_embed":
+        if rest[0] == "kernel":
+            return "patch_embed.proj.weight", _conv_kernel
+        return "patch_embed.proj.bias", None
+    if mod == "classifier":
+        if rest[0] == "kernel":
+            return "head.weight", _dense_kernel
+        return "head.bias", None
+    # encoder/block{i}/... and encoder/ln_final
+    assert mod == "encoder", path
+    sub, rest = rest[0], rest[1:]
+    if sub == "ln_final":
+        return f"norm.{_ln(rest[0])}", None
+    i = int(sub[5:])
+    part, rest = rest[0], rest[1:]
+    if part in ("ln1", "ln2"):
+        norm = "norm1" if part == "ln1" else "norm2"
+        return f"blocks.{i}.{norm}.{_ln(rest[0])}", None
+    if part == "attn":
+        proj = {"qkv": "qkv", "out": "proj"}[rest[0]]
+        if rest[1] == "kernel":
+            return f"blocks.{i}.attn.{proj}.weight", _dense_kernel
+        return f"blocks.{i}.attn.{proj}.bias", None
+    assert part == "mlp", path
+    fc = rest[0]
+    if rest[1] == "kernel":
+        return f"blocks.{i}.mlp.{fc}.weight", _dense_kernel
+    return f"blocks.{i}.mlp.{fc}.bias", None
+
+
+def _ln(leaf: str) -> str:
+    return {"scale": "weight", "bias": "bias"}[leaf]
+
+
+# ------------------------------------------------------------- drivers --
+
+_FAMILIES: Dict[str, Callable] = {
+    "yolov8n": _yolo_key, "yolov8s": _yolo_key, "tiny_yolov8": _yolo_key,
+    "resnet50": _resnet_key, "tiny_resnet": _resnet_key,
+    "vit_b16": _vit_key, "tiny_vit": _vit_key,
+}
+SUPPORTED = sorted(_FAMILIES)
+
+# source keys that have no target leaf and are expected to remain:
+# num_batches_tracked (torch BN bookkeeping) and ultralytics' DFL conv,
+# whose weight is the fixed arange(reg_max) our in-graph decode computes.
+_IGNORABLE = ("num_batches_tracked", "dfl.conv.weight")
+
+
+def convert(model_name: str, state: Dict[str, np.ndarray]):
+    """state dict (canonical torch layout for ``model_name``) -> flax
+    variables ``{"params": ..., "batch_stats": ...}`` ready for
+    ``utils.checkpoint.save_msgpack`` / ``engine.checkpoint_path``.
+
+    Raises ``KeyError``/``ValueError`` listing every unmapped target leaf,
+    shape mismatch, or unconsumed source tensor."""
+    import jax
+    from flax import traverse_util
+
+    from . import registry
+
+    if model_name not in _FAMILIES:
+        raise ValueError(
+            f"no import mapping for {model_name!r}; supported: {SUPPORTED}"
+        )
+    key_fn = _FAMILIES[model_name]
+    if key_fn is _yolo_key:
+        state = _strip_model_prefix(state)
+
+    _, template = registry.get(model_name).init_params(jax.random.PRNGKey(0))
+    # ViT-family params are boxed in LogicallyPartitioned (sharding names);
+    # the importer works on raw arrays — the engine re-boxes when it shards.
+    from ..parallel.sharding import unbox
+
+    flat = traverse_util.flatten_dict(unbox(template))
+
+    out: Dict[Tuple[str, ...], np.ndarray] = {}
+    consumed: set = set()
+    problems: list = []
+    for full_path, target in flat.items():
+        # full_path = (collection, *module path, leaf)
+        src_key, transform = key_fn(tuple(full_path[1:]))
+        if src_key not in state:
+            problems.append(f"missing source tensor {src_key!r} "
+                            f"for {'/'.join(full_path)}")
+            continue
+        val = state[src_key]
+        if transform is not None:
+            val = transform(val)
+        if np.shape(val) != np.shape(target):
+            problems.append(
+                f"shape mismatch for {'/'.join(full_path)}: source "
+                f"{src_key!r} gives {np.shape(val)}, model wants "
+                f"{np.shape(target)}"
+            )
+            continue
+        out[full_path] = np.asarray(val, np.float32)
+        consumed.add(src_key)
+    leftovers = [
+        k for k in state
+        if k not in consumed and not k.endswith(_IGNORABLE)
+    ]
+    if leftovers:
+        problems.append(
+            f"{len(leftovers)} source tensors unconsumed (layout drift?): "
+            + ", ".join(sorted(leftovers)[:8])
+            + ("…" if len(leftovers) > 8 else "")
+        )
+    if problems:
+        raise ValueError(
+            f"import of {model_name!r} failed "
+            f"({len(problems)} problems):\n- " + "\n- ".join(problems)
+        )
+    return traverse_util.unflatten_dict(out)

@@ -17,6 +17,10 @@ import jax.numpy as jnp
 
 from .common import ConvBN, Dtype, adaptive_avg_pool
 
+# torchvision ResNets train with BN eps 1e-5; matching it is required for
+# imported checkpoints to reproduce source outputs (see ConvBN.epsilon).
+_BN_EPS = 1e-5
+
 
 @dataclass(frozen=True)
 class ResNetConfig:
@@ -38,13 +42,16 @@ class Bottleneck(nn.Module):
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         out_ch = self.features * 4
         residual = x
-        h = ConvBN(self.features, kernel=1, act="relu", dtype=self.dtype, name="conv1")(x, train)
-        h = ConvBN(self.features, kernel=3, stride=self.stride, act="relu", dtype=self.dtype, name="conv2")(h, train)
-        h = ConvBN(out_ch, kernel=1, act="identity", dtype=self.dtype, name="conv3")(h, train)
+        h = ConvBN(self.features, kernel=1, act="relu", epsilon=_BN_EPS,
+                   dtype=self.dtype, name="conv1")(x, train)
+        h = ConvBN(self.features, kernel=3, stride=self.stride, act="relu",
+                   epsilon=_BN_EPS, dtype=self.dtype, name="conv2")(h, train)
+        h = ConvBN(out_ch, kernel=1, act="identity", epsilon=_BN_EPS,
+                   dtype=self.dtype, name="conv3")(h, train)
         if residual.shape[-1] != out_ch or self.stride != 1:
             residual = ConvBN(
                 out_ch, kernel=1, stride=self.stride, act="identity",
-                dtype=self.dtype, name="downsample",
+                epsilon=_BN_EPS, dtype=self.dtype, name="downsample",
             )(x, train)
         return nn.relu(h + residual)
 
@@ -59,8 +66,11 @@ class ResNet(nn.Module):
     ) -> jnp.ndarray:
         c = self.cfg
         x = x.astype(self.dtype)
-        x = ConvBN(c.width, kernel=7, stride=2, act="relu", dtype=self.dtype, name="stem")(x, train)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = ConvBN(c.width, kernel=7, stride=2, act="relu", epsilon=_BN_EPS,
+                   dtype=self.dtype, name="stem")(x, train)
+        # Explicit (1, 1) padding = torch's MaxPool2d(3, 2, padding=1);
+        # "SAME" would pad (0, 1) on even inputs (see ConvBN note).
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for si, n_blocks in enumerate(c.stage_sizes):
             feats = c.width * (2 ** si)
             for bi in range(n_blocks):
