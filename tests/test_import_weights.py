@@ -43,11 +43,8 @@ def _randomize(module: tnn.Module, seed: int) -> None:
                 if isinstance(m, tnn.BatchNorm2d):
                     m.running_mean.normal_(0, 0.2, generator=g)
                     m.running_var.uniform_(0.5, 1.5, generator=g)
-            elif isinstance(m, tnn.Parameter):
-                pass
-        for p in module.parameters(recurse=True):
-            if p.dim() <= 3:  # cls_token / pos_embed style
-                continue
+        # NB: bare nn.Parameters (cls_token / pos_embed) are NOT touched
+        # here — tests that use them randomize them explicitly.
 
 
 def _state(module: tnn.Module) -> dict:

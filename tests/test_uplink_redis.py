@@ -1,0 +1,163 @@
+"""Durable Redis-backed annotation queue (VERDICT round-2 missing #2).
+
+Runs over real sockets against the in-proc RESP server. The behavioral
+suite mirrors test_uplink.py's in-memory contract; the durability cases
+are the reason this backend exists: a killed process must not lose
+queued OR mid-delivery annotations (reference rmq parity,
+``server/grpcapi/grpc_api.go:69-75``).
+"""
+
+import pytest
+
+from video_edge_ai_proxy_tpu.bus.miniredis import MiniRedis
+from video_edge_ai_proxy_tpu.bus.resp import RespClient
+from video_edge_ai_proxy_tpu.uplink import RedisAnnotationQueue
+
+READY = "rmq::queue::[annotationqueue]::ready"
+REJECTED = "rmq::queue::[annotationqueue]::rejected"
+
+
+@pytest.fixture()
+def server():
+    srv = MiniRedis()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def raw(server):
+    c = RespClient.from_addr(server.addr)
+    yield c
+    c.close()
+
+
+def _q(server, handler, **kw) -> RedisAnnotationQueue:
+    return RedisAnnotationQueue(handler, addr=server.addr, **kw)
+
+
+class TestBehavioralContract:
+    """Same bar the in-memory queue passes (test_uplink.py)."""
+
+    def test_batching_respects_max(self, server):
+        batches = []
+        q = _q(server, lambda b: batches.append(b) or True, max_batch_size=3)
+        for i in range(7):
+            assert q.publish(bytes([i]))
+        while q.drain_once():
+            pass
+        assert [len(b) for b in batches] == [3, 3, 1]
+        assert q.acked == 7 and q.depth() == 0
+
+    def test_reject_requeues_in_order(self, server):
+        fail = {"on": True}
+        seen = []
+
+        def handler(batch):
+            if fail["on"]:
+                return False
+            seen.extend(batch)
+            return True
+
+        q = _q(server, handler, max_batch_size=10)
+        for i in range(4):
+            q.publish(bytes([i]))
+        assert q.drain_once() == 0
+        assert q.depth() == 4          # rejected, not lost
+        fail["on"] = False
+        q.requeue_rejected()
+        assert q.drain_once() == 4
+        assert seen == [bytes([i]) for i in range(4)]
+
+    def test_unacked_limit_sheds(self, server):
+        q = _q(server, lambda b: True, unacked_limit=5)
+        results = [q.publish(b"x") for _ in range(8)]
+        assert results == [True] * 5 + [False] * 3
+        assert q.dropped == 3
+
+    def test_handler_exception_counts_as_reject(self, server):
+        def boom(batch):
+            raise RuntimeError("down")
+
+        q = _q(server, boom)
+        q.publish(b"x")
+        assert q.drain_once() == 0
+        assert q.depth() == 1
+
+
+class TestDurability:
+    def test_ready_events_survive_process_restart(self, server):
+        q1 = _q(server, lambda b: True)
+        for i in range(5):
+            q1.publish(bytes([i]))
+        del q1  # crash: no stop(), no drain — state lives in Redis
+
+        delivered = []
+        q2 = _q(server, lambda b: delivered.extend(b) or True)
+        assert q2.depth() == 5
+        assert q2.drain_once() == 5
+        assert delivered == [bytes([i]) for i in range(5)]
+
+    def test_unacked_events_sweep_back_on_restart(self, server, raw):
+        """Mid-delivery crash: a dead consumer's unacked list (any
+        connection name — a crashed process can't clean its own) returns
+        to ready at startup, rmq-cleaner style."""
+        q1 = _q(server, lambda b: True)
+        for i in range(5):
+            q1.publish(bytes([i]))
+        dead = "rmq::connection::deadProc::queue::[annotationqueue]::unacked"
+        raw.command("RPOPLPUSH", READY, dead)
+        raw.command("RPOPLPUSH", READY, dead)
+        del q1
+
+        delivered = []
+        q2 = _q(server, lambda b: delivered.extend(b) or True)
+        assert q2.resumed == 2
+        assert q2.depth() == 5
+        assert q2.drain_once() == 5
+        assert sorted(delivered) == [bytes([i]) for i in range(5)]
+        assert int(raw.command("LLEN", dead) or 0) == 0
+
+    def test_rejected_events_survive_restart(self, server):
+        q1 = _q(server, lambda b: False)   # uplink down: all reject
+        for i in range(3):
+            q1.publish(bytes([i]))
+        assert q1.drain_once() == 0
+        del q1
+
+        delivered = []
+        q2 = _q(server, lambda b: delivered.extend(b) or True)
+        assert q2.depth() == 3
+        q2.requeue_rejected()
+        assert q2.drain_once() == 3
+
+    def test_depth_counts_inherited_backlog_against_limit(self, server):
+        q1 = _q(server, lambda b: True)
+        for i in range(4):
+            q1.publish(bytes([i]))
+        del q1
+        q2 = _q(server, lambda b: True, unacked_limit=5)
+        assert q2.publish(b"x")            # 5th fits
+        assert not q2.publish(b"y")        # limit covers inherited events
+
+
+class TestWireParity:
+    def test_rmq_key_scheme_on_the_wire(self, server, raw):
+        """A reference rmq consumer on the same Redis reads these exact
+        keys (adjust/rmq v4 layout, queue 'annotationqueue')."""
+        q = _q(server, lambda b: False)
+        q.publish(b"evt")
+        assert int(raw.command("LLEN", READY)) == 1
+        q.drain_once()                     # reject -> rejected list
+        assert int(raw.command("LLEN", READY)) == 0
+        assert int(raw.command("LLEN", REJECTED)) == 1
+        keys = raw.command("KEYS", "rmq::*")
+        assert sorted(k.decode() for k in keys) == [REJECTED]
+
+    def test_foreign_rmq_producer_is_drained(self, server, raw):
+        """Events LPUSHed by a reference component (rmq publish) flow
+        through our consumer unchanged."""
+        raw.command("LPUSH", READY, b"from-reference")
+        delivered = []
+        q = _q(server, lambda b: delivered.extend(b) or True)
+        assert q.drain_once() == 1
+        assert delivered == [b"from-reference"]

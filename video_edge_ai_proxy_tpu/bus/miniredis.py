@@ -31,6 +31,7 @@ class MiniRedis:
         self._hashes: Dict[bytes, Dict[bytes, bytes]] = {}
         self._streams: Dict[bytes, List[StreamEntry]] = {}
         self._last_stream_id: Dict[bytes, Tuple[int, int]] = {}
+        self._lists: Dict[bytes, List[bytes]] = {}  # head = index 0
         self._lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -172,6 +173,8 @@ class MiniRedis:
             return "hash"
         if key in self._strings:
             return "string"
+        if key in self._lists:
+            return "list"
         return "none"
 
     def _cmd_ping(self, _args):
@@ -196,7 +199,8 @@ class MiniRedis:
     def _cmd_del(self, args):
         n = 0
         for key in args:
-            for table in (self._strings, self._hashes, self._streams):
+            for table in (self._strings, self._hashes, self._streams,
+                          self._lists):
                 if key in table:
                     del table[key]
                     n += 1
@@ -208,7 +212,8 @@ class MiniRedis:
     def _cmd_keys(self, args):
         pat = args[0].decode()
         keys = [
-            k for k in (*self._strings, *self._hashes, *self._streams)
+            k for k in (*self._strings, *self._hashes, *self._streams,
+                        *self._lists)
             if fnmatchcase(k.decode(), pat)
         ]
         return self._arr(sorted(keys))
@@ -231,7 +236,8 @@ class MiniRedis:
                 want_type = args[i + 1].decode()
             i += 2
         keys = [
-            k for k in (*self._strings, *self._hashes, *self._streams)
+            k for k in (*self._strings, *self._hashes, *self._streams,
+                        *self._lists)
             if fnmatchcase(k.decode(), match)
             and (want_type is None or self._type_of(k) == want_type)
         ]
@@ -399,9 +405,90 @@ class MiniRedis:
             [b"%d-%d" % eid, fields] for eid, fields in entries
         ])
 
+    # -- lists (the annotation queue's rmq-shaped plane) --
+
+    def _cmd_lpush(self, args):
+        lst = self._lists.setdefault(args[0], [])
+        for v in args[1:]:
+            lst.insert(0, v)
+        return b":%d\r\n" % len(lst)
+
+    def _cmd_rpush(self, args):
+        lst = self._lists.setdefault(args[0], [])
+        lst.extend(args[1:])
+        return b":%d\r\n" % len(lst)
+
+    def _cmd_llen(self, args):
+        return b":%d\r\n" % len(self._lists.get(args[0], []))
+
+    def _cmd_lrange(self, args):
+        lst = self._lists.get(args[0], [])
+        start, stop = int(args[1]), int(args[2])
+        if start < 0:
+            start += len(lst)
+        if stop < 0:
+            stop += len(lst)
+        return self._arr(lst[max(start, 0): stop + 1])
+
+    def _cmd_lpop(self, args):
+        lst = self._lists.get(args[0])
+        if not lst:
+            return b"$-1\r\n"
+        v = lst.pop(0)
+        if not lst:
+            del self._lists[args[0]]
+        return self._bulk(v)
+
+    def _cmd_rpop(self, args):
+        lst = self._lists.get(args[0])
+        if not lst:
+            return b"$-1\r\n"
+        v = lst.pop()
+        if not lst:
+            del self._lists[args[0]]
+        return self._bulk(v)
+
+    def _cmd_rpoplpush(self, args):
+        src = self._lists.get(args[0])
+        if not src:
+            return b"$-1\r\n"
+        v = src.pop()
+        if not src:
+            del self._lists[args[0]]
+        self._lists.setdefault(args[1], []).insert(0, v)
+        return self._bulk(v)
+
+    def _cmd_lrem(self, args):
+        key, count, value = args[0], int(args[1]), args[2]
+        lst = self._lists.get(key, [])
+        removed = 0
+        if count >= 0:  # head -> tail; 0 = all
+            limit = count or len(lst)
+            out = []
+            for v in lst:
+                if v == value and removed < limit:
+                    removed += 1
+                else:
+                    out.append(v)
+        else:  # tail -> head, |count| occurrences
+            limit = -count
+            out = []
+            for v in reversed(lst):
+                if v == value and removed < limit:
+                    removed += 1
+                else:
+                    out.append(v)
+            out.reverse()
+        if out:
+            self._lists[key] = out
+        else:
+            self._lists.pop(key, None)
+        return b":%d\r\n" % removed
+
     def _cmd_flushall(self, _args):
         self._strings.clear()
         self._hashes.clear()
         self._streams.clear()
         self._last_stream_id.clear()
+        self._lists.clear()
         return b"+OK\r\n"

@@ -67,7 +67,7 @@ class Server:
             redis_password=self.cfg.bus.redis_password,
             redis_db=self.cfg.bus.redis_db,
         )
-        self.annotations = AnnotationQueue(
+        ann_kwargs = dict(
             handler=make_batch_handler(
                 self.settings, self.cfg.annotation.endpoint
             ),
@@ -75,6 +75,20 @@ class Server:
             poll_duration_ms=self.cfg.annotation.poll_duration_ms,
             unacked_limit=self.cfg.annotation.unacked_limit,
         )
+        if (bus_backend or self.cfg.bus.backend) == "redis":
+            # The deployment that HAS a Redis gets the reference's
+            # durability: unacked annotations survive a server restart
+            # (rmq parity, grpc_api.go:69-75; see uplink/redis_queue.py).
+            from ..uplink.redis_queue import RedisAnnotationQueue
+
+            self.annotations = RedisAnnotationQueue(
+                addr=self.cfg.bus.redis_addr,
+                password=self.cfg.bus.redis_password,
+                db=self.cfg.bus.redis_db,
+                **ann_kwargs,
+            )
+        else:
+            self.annotations = AnnotationQueue(**ann_kwargs)
         self.engine = None
         if enable_engine:
             try:

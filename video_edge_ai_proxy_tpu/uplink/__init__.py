@@ -1,8 +1,10 @@
 from .cloud import CloudClient, ForbiddenError, annotation_to_cloud, make_batch_handler
 from .queue import AnnotationQueue
+from .redis_queue import RedisAnnotationQueue
 
 __all__ = [
     "AnnotationQueue",
+    "RedisAnnotationQueue",
     "CloudClient",
     "ForbiddenError",
     "annotation_to_cloud",

@@ -92,9 +92,7 @@ class AnnotationQueue:
             if now - last_requeue >= self._requeue_s:
                 # Return rejected deliveries to the ready queue
                 # (annotation_consumer.go:33-52).
-                with self._lock:
-                    while self._rejected:
-                        self._queue.appendleft(self._rejected.pop())
+                self.requeue_rejected()
                 last_requeue = now
             self.drain_once()
 
