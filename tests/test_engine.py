@@ -86,6 +86,58 @@ class TestCollector:
         col.keep_streams_hot(now_ms=12345)
         assert bus.last_query_ms("cam1") == 12345
 
+    def test_inference_model_none_gates_stream_out(self, bus):
+        """inference_model="none" (SURVEY §2.3 P6): the stream leaves the
+        device batch AND keep_streams_hot stops holding its decode gate
+        open — while sibling streams keep both."""
+        for did in ("cam_on", "cam_off"):
+            bus.create_stream(did, 64 * 64 * 3)
+            _publish(bus, did)
+        col = Collector(
+            bus, buckets=(1, 2),
+            model_of=lambda d: ("none", 0) if d == "cam_off" else None,
+        )
+        assert col.keep_streams_hot(now_ms=777) == ["cam_on"]
+        assert bus.last_query_ms("cam_on") == 777
+        assert bus.last_query_ms("cam_off") is None   # gate left closed
+        groups = col.collect()
+        assert [g.device_ids for g in groups] == [["cam_on"]]
+
+    def test_interest_gating_with_linger(self, bus):
+        """No consumer -> after the active_window_s linger the stream drops
+        out of the batch; interest returning re-admits it immediately."""
+        bus.create_stream("cam1", 64 * 64 * 3)
+        interested = {"on": True}
+        col = Collector(
+            bus, buckets=(1,), active_window_s=0.2,
+            interest_of=lambda d: interested["on"],
+        )
+        _publish(bus, "cam1")
+        assert col.inference_streams() == ["cam1"]
+        assert col.collect()
+        interested["on"] = False
+        # within the linger window the stream still infers (no thrash)
+        assert col.inference_streams() == ["cam1"]
+        time.sleep(0.25)
+        assert col.inference_streams() == []          # linger expired
+        assert col.keep_streams_hot() == []
+        _publish(bus, "cam1")
+        assert col.collect() == []                    # gated: no batches
+        interested["on"] = True
+        assert col.inference_streams() == ["cam1"]    # instant re-admission
+        assert col.collect()
+
+    def test_no_sink_engine_never_infers(self, bus):
+        """An engine with neither uplink nor subscribers computes results
+        nobody reads — it must not infer or hold decode gates open."""
+        bus.create_stream("cam1", 64 * 64 * 3)
+        eng = _engine(bus, "tiny_yolov8", annotations=None,
+                      active_window_s=0.0)
+        _publish(bus, "cam1")
+        assert eng._collector.inference_streams() == []
+        assert eng._collector.collect() == []
+        assert bus.last_query_ms("cam1") is None
+
     def test_pad_rejects_oversize(self):
         group = BatchGroup((8, 8), ["a"] * 3, np.zeros((3, 8, 8, 3), np.uint8),
                            [_meta()] * 3)
@@ -93,8 +145,20 @@ class TestCollector:
             pad_to_bucket(group, (1, 2))
 
 
-def _engine(bus, model, annotations=None, **cfg_kw):
+def _sink():
+    """Standing interest for tests that drive the collector directly
+    (inference is gated on uplink/subscriber interest, SURVEY §2.3 P6)."""
+    return AnnotationQueue(handler=lambda batch: True)
+
+
+def _engine(bus, model, annotations="auto", **cfg_kw):
+    """Engine with a sink: inference is gated on interest (uplink or
+    subscriber — SURVEY §2.3 P6), so tests that poke collect()/steps
+    directly get a throwaway annotation queue as standing interest.
+    Pass annotations=None to exercise the gated (no-sink) behavior."""
     cfg = EngineConfig(model=model, batch_buckets=(1, 2, 4), tick_ms=5, **cfg_kw)
+    if annotations == "auto":
+        annotations = AnnotationQueue(handler=lambda batch: True)
     eng = InferenceEngine(bus, cfg, annotations=annotations)
     eng.warmup()
     return eng
@@ -193,7 +257,10 @@ class TestEngine:
     def test_detect_end_to_end(self, bus):
         bus.create_stream("cam1", 64 * 64 * 3)
         ann = AnnotationQueue(handler=lambda batch: True)
-        eng = _engine(bus, "tiny_yolov8", annotations=ann)
+        # annotation_emit="all": this test pins the per-detection firehose
+        # contract; rate policies have their own tests.
+        eng = _engine(bus, "tiny_yolov8", annotations=ann,
+                      annotation_emit="all")
         eng.start()
         try:
             results = []
@@ -295,7 +362,7 @@ class TestEngine:
             model="tiny_mobilenet_v2", batch_buckets=(1, 2, 4), tick_ms=5,
             mesh={"dp": 4},
         )
-        eng = InferenceEngine(bus, cfg)
+        eng = InferenceEngine(bus, cfg, annotations=_sink())
         eng.warmup()
         # buckets not divisible by dp are dropped
         assert eng._collector._buckets == (4,)
@@ -358,7 +425,7 @@ class TestEngine:
             model="tiny_mobilenet_v2", batch_buckets=(1, 2, 4, 8, 16),
             tick_ms=5, mesh="auto",
         )
-        eng = InferenceEngine(bus, cfg)
+        eng = InferenceEngine(bus, cfg, annotations=_sink())
         eng.warmup()
         n = len(jax.devices())
         assert eng._mesh.shape["dp"] == n  # all devices on the batch axis
@@ -392,6 +459,7 @@ class TestEngine:
         )
         eng = InferenceEngine(
             bus, cfg, model_resolver=lambda d: assignments.get(d, ""),
+            annotations=_sink(),
         )
         eng.warmup()
         for did in assignments:
@@ -423,6 +491,7 @@ class TestEngine:
                            tick_ms=5)
         eng = InferenceEngine(
             bus, cfg, model_resolver=lambda d: assignments.get(d, ""),
+            annotations=_sink(),
         )
         eng.warmup()
         for did in assignments:
@@ -445,7 +514,8 @@ class TestEngine:
     def test_unknown_model_falls_back_to_default(self, bus):
         cfg = EngineConfig(model="tiny_mobilenet_v2", batch_buckets=(1,),
                            tick_ms=5)
-        eng = InferenceEngine(bus, cfg, model_resolver=lambda d: "nope")
+        eng = InferenceEngine(bus, cfg, model_resolver=lambda d: "nope",
+                              annotations=_sink())
         eng.warmup()
         bus.create_stream("cam1", 32 * 32 * 3)
         _publish(bus, "cam1", w=32, h=32)
@@ -477,3 +547,96 @@ class TestEngine:
             assert not any(k[2] == 7 for k in eng._step_cache)
         finally:
             eng.stop()
+
+
+class TestAnnotationPolicy:
+    """Annotation emit policies (VERDICT r2 weak #3): the engine is a
+    firehose the reference never was (its clients chose what to annotate,
+    examples/annotation.py); policies keep steady-state volume under the
+    uplink drain budget."""
+
+    def _eng(self, bus, ann, policy, resolver=None, **cfg_kw):
+        cfg = EngineConfig(model="tiny_yolov8", batch_buckets=(1,),
+                           tick_ms=5, annotation_emit=policy, **cfg_kw)
+        eng = InferenceEngine(bus, cfg, annotations=ann,
+                              annotation_policy_resolver=resolver)
+        eng.warmup()
+        return eng
+
+    @staticmethod
+    def _det(track="", conf=0.9, cid=1):
+        return pb.Detection(
+            box=pb.BoundingBox(left=1, top=1, width=5, height=5),
+            confidence=conf, class_id=cid, class_name="x", track_id=track,
+        )
+
+    def test_on_change_suppresses_steady_state(self, bus):
+        ann = AnnotationQueue(handler=lambda b: True)
+        eng = self._eng(bus, ann, "on_change")
+        meta = _meta()
+        dets = [self._det(track="7")]
+        eng._annotate("cam", meta, dets)           # first sighting: emits
+        assert ann.published == 1
+        for _ in range(10):                        # unchanged scene: silent
+            eng._annotate("cam", meta, dets)
+        assert ann.published == 1
+        assert eng.annotations_suppressed == 10
+        eng._annotate("cam", meta, [self._det(track="8")])  # new object
+        assert ann.published == 2
+        # confidence drift over the delta re-emits
+        eng._annotate("cam", meta, [self._det(track="8", conf=0.5)])
+        assert ann.published == 3
+        # object disappears (records the empty scene), then reappears
+        eng._annotate("cam", meta, [])
+        eng._annotate("cam", meta, [self._det(track="8", conf=0.5)])
+        assert ann.published == 4
+
+    def test_keyframe_policy(self, bus):
+        ann = AnnotationQueue(handler=lambda b: True)
+        eng = self._eng(bus, ann, "keyframe")
+        kf, pf = _meta(), _meta()
+        pf.is_keyframe = False
+        dets = [self._det()]
+        eng._annotate("cam", pf, dets)
+        assert ann.published == 0
+        eng._annotate("cam", kf, dets)
+        assert ann.published == 1
+
+    def test_min_interval_policy(self, bus):
+        ann = AnnotationQueue(handler=lambda b: True)
+        eng = self._eng(bus, ann, "min_interval",
+                        annotation_min_interval_ms=1000)
+        dets = [self._det()]
+        m1, m2, m3 = _meta(ts=1000), _meta(ts=1500), _meta(ts=2200)
+        eng._annotate("cam", m1, dets)
+        eng._annotate("cam", m2, dets)             # 500 ms later: held
+        eng._annotate("cam", m3, dets)             # 1200 ms later: emits
+        assert ann.published == 2
+
+    def test_per_stream_policy_override(self, bus):
+        ann = AnnotationQueue(handler=lambda b: True)
+        eng = self._eng(
+            bus, ann, "on_change",
+            resolver=lambda d: "all" if d == "firehose" else "",
+        )
+        meta, dets = _meta(), [self._det(track="1")]
+        for _ in range(3):
+            eng._annotate("firehose", meta, dets)  # override: every frame
+        for _ in range(3):
+            eng._annotate("quiet", meta, dets)     # default on_change
+        assert ann.published == 3 + 1
+
+    def test_north_star_rate_stays_under_budget(self, bus):
+        """16 streams x 30 fps x 3 steady detections for 10 simulated
+        seconds: default policy publishes a negligible fraction of the
+        firehose and the queue never sheds (near-zero dropped)."""
+        ann = AnnotationQueue(handler=lambda b: True)
+        eng = self._eng(bus, ann, "on_change")
+        dets = [self._det(track=str(k)) for k in range(3)]
+        for frame in range(300):                   # 10 s at 30 fps
+            meta = _meta(ts=1_000 + frame * 33)
+            for s in range(16):
+                eng._annotate(f"cam{s}", meta, dets)
+        assert ann.dropped == 0
+        assert ann.published == 16 * 3             # first sighting only
+        assert eng.annotations_suppressed == (300 - 1) * 16 * 3

@@ -153,7 +153,12 @@ class TestQuantizedMeshServing:
                 model="tiny_yolov8", batch_buckets=(2, 4), tick_ms=5,
                 quantize="int8", mesh={"dp": 2},
             )
-            eng = InferenceEngine(bus, cfg)
+            # Direct collect() below needs standing interest (P6 gating).
+            from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+
+            eng = InferenceEngine(
+                bus, cfg, annotations=AnnotationQueue(handler=lambda b: True)
+            )
             eng.warmup()
             from video_edge_ai_proxy_tpu.models.quantize import QuantizedTree
 

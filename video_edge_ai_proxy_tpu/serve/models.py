@@ -52,7 +52,14 @@ class StreamProcess:
     modified: int = 0
     rtmp_stream_status: Optional[RTMPStreamStatus] = None
     # New (no reference counterpart): per-stream inference toggle + model.
+    # Registry model name, "" = engine default, "none" = inference off for
+    # this stream (it drops out of the device batch and its decode gate
+    # closes — SURVEY §2.3 P6).
     inference_model: str = ""
+    # Per-stream annotation emit policy override:
+    # all | keyframe | on_change | min_interval ("" = engine default,
+    # EngineConfig.annotation_emit).
+    annotation_policy: str = ""
     # Resource limits applied to the worker process (reference caps
     # containers via CPUShares + json-file log limits,
     # ``rtsp_process_manager.go:71-78``); filled by Info, not persisted.
@@ -84,6 +91,7 @@ class StreamProcess:
             modified=data.get("modified", 0),
             rtmp_stream_status=RTMPStreamStatus(**rss) if rss else None,
             inference_model=data.get("inference_model", ""),
+            annotation_policy=data.get("annotation_policy", ""),
             limits=data.get("limits"),
         )
 

@@ -156,6 +156,7 @@ class _Entry:
         self.last_exit = 0
         self.last_spawn = time.monotonic()
         self.inference_model = ""  # per-stream engine model override
+        self.annotation_policy = ""  # per-stream annotation emit override
         self.restart_due = 0.0  # backoff deadline; 0 = not pending
 
 
@@ -206,6 +207,7 @@ class ProcessManager:
                 raise ProcessError(f"process {device_id!r} already exists")
             entry = _Entry()
             entry.inference_model = record.inference_model
+            entry.annotation_policy = record.annotation_policy
             self._entries[device_id] = entry
         now = StreamProcess.now_ms()
         record.created = record.created or now
@@ -280,6 +282,13 @@ class ProcessManager:
         engine collector every tick."""
         entry = self._entries.get(device_id)
         return entry.inference_model if entry is not None else ""
+
+    def annotation_policy_of(self, device_id: str) -> str:
+        """Per-stream annotation emit policy override
+        (StreamProcess.annotation_policy); "" means the engine default.
+        Lock-free dict read — called by the engine per emitted frame."""
+        entry = self._entries.get(device_id)
+        return entry.annotation_policy if entry is not None else ""
 
     def stop(self, device_id: str) -> None:
         with self._lock:
@@ -441,6 +450,7 @@ class ProcessManager:
                 self._entries[device_id] = entry
             record = StreamProcess.from_json(raw)
             entry.inference_model = record.inference_model
+            entry.annotation_policy = record.annotation_policy
             try:
                 self._spawn(record, entry)
                 self._persist(record)

@@ -83,6 +83,11 @@ def build_app(
         if not body.get("rtsp_endpoint"):
             # Message parity: reference api/rtsp_process.go:50-52.
             return _error(400, "RTP endpoint required")
+        policy = body.get("annotation_policy", "")
+        if policy not in ("", "all", "keyframe", "on_change", "min_interval"):
+            # Rejected here, not warned per-frame in the engine: a typo'd
+            # policy would otherwise fall back to the "all" firehose.
+            return _error(400, f"unknown annotation_policy {policy!r}")
         record = StreamProcess(
             name=body.get("name", ""),
             image_tag=body.get("image_tag", ""),
@@ -90,6 +95,7 @@ def build_app(
             rtmp_endpoint=body.get("rtmp_endpoint", ""),
             rtmp_stream_status=RTMPStreamStatus(streaming=True, storing=False),
             inference_model=body.get("inference_model", ""),
+            annotation_policy=policy,
         )
         try:
             await asyncio.to_thread(pm.start, record)
@@ -225,6 +231,12 @@ def build_app(
                  annotations.rejected_batches,
                  "Annotation batches rejected by the cloud (re-queued)",
                  kind="counter")
+            if engine is not None:
+                emit("vep_annotations_suppressed_total",
+                     engine.annotations_suppressed,
+                     "Annotations withheld by the emit policy "
+                     "(engine.annotation_emit) before reaching the queue",
+                     kind="counter")
         lines: list[str] = []
         for name, (help_text, kind, samples) in families.items():
             lines.append(f"# HELP {name} {help_text}")

@@ -112,6 +112,9 @@ class Server:
             self.engine = InferenceEngine(
                 self.bus, engine_cfg, annotations=self.annotations,
                 model_resolver=self.process_manager.inference_model_of,
+                annotation_policy_resolver=(
+                    self.process_manager.annotation_policy_of
+                ),
             )
         self.cron = CronJobs(self.cfg.buffer)
         self._grpc_port = grpc_port if grpc_port is not None else self.cfg.grpc_port

@@ -111,6 +111,20 @@ class EngineConfig:
     # frame of a new geometry compiles inside the tick) or a k8s liveness
     # probe would restart the pod mid-warmup in a loop.
     health_stale_after_s: float = 300.0
+    # Annotation emit policy. At north-star rates (16 streams x 30 fps x
+    # a few detections) one AnnotateRequest per detection per frame
+    # outruns the uplink drain budget (299 per 300 ms, reference
+    # main.go:59-64) and sheds on the floor; the reference never hits
+    # this because CLIENTS choose what to annotate (examples/
+    # annotation.py). Policies: "all" (reference-client firehose),
+    # "keyframe" (GOP heads only), "on_change" (default: emit when the
+    # tracked object set changes or a confidence moves more than
+    # annotation_confidence_delta), "min_interval" (at most one frame's
+    # annotations per annotation_min_interval_ms). Per-stream override:
+    # StreamProcess.annotation_policy.
+    annotation_emit: str = "on_change"
+    annotation_min_interval_ms: int = 1000
+    annotation_confidence_delta: float = 0.15
     # "int8" = weight-only post-training quantization of serving params
     # (models/quantize.py): int8 device/HBM residency (checkpoints stay
     # full precision on disk), bf16 compute,
