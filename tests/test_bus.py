@@ -65,6 +65,28 @@ class TestFrameBus:
         _, cons = buses
         assert cons.read_latest("ghost") is None
 
+    def test_blocking_read_default_poll(self, buses):
+        """FrameBus.read_latest_blocking default (poll) impl: returns a
+        frame published mid-wait, and None on a quiet timeout."""
+        import threading
+        import time as _t
+
+        prod, cons = buses
+        prod.create_stream("cam1", 1024)
+        img = np.zeros((4, 4, 3), dtype=np.uint8)
+        t = threading.Timer(
+            0.1, lambda: prod.publish("cam1", img, FrameMeta(timestamp_ms=5))
+        )
+        t.start()
+        frame = cons.read_latest_blocking("cam1", timeout_s=2.0)
+        t.join()
+        assert frame is not None and frame.meta.timestamp_ms == 5
+        t0 = _t.monotonic()
+        assert cons.read_latest_blocking(
+            "cam1", min_seq=frame.seq, timeout_s=0.15
+        ) is None
+        assert _t.monotonic() - t0 < 1.0
+
     def test_streams_and_drop(self, buses):
         prod, cons = buses
         prod.create_stream("a", 64)

@@ -231,11 +231,11 @@ class TestEngine:
         orig_collect = eng._collector.collect
         calls = {"n": 0}
 
-        def flaky():
+        def flaky(*args, **kwargs):
             calls["n"] += 1
             if calls["n"] <= 3:
                 raise RuntimeError("injected tick failure")
-            return orig_collect()
+            return orig_collect(*args, **kwargs)
 
         eng._collector.collect = flaky
         eng.start()

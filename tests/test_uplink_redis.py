@@ -17,9 +17,14 @@ READY = "rmq::queue::[annotationqueue]::ready"
 REJECTED = "rmq::queue::[annotationqueue]::rejected"
 
 
-@pytest.fixture()
-def server():
-    srv = MiniRedis()
+from conftest import make_redis_server, redis_server_params  # noqa: E402
+
+
+@pytest.fixture(params=redis_server_params())
+def server(request):
+    """MiniRedis always; a real redis-server too when on PATH (the
+    skip-gated conformance leg — see conftest.py)."""
+    srv = make_redis_server(request.param)
     yield srv
     srv.close()
 

@@ -85,6 +85,29 @@ class FrameBus(ABC):
     def read_latest(self, device_id: str, min_seq: int = 0) -> Optional[Frame]:
         """Newest frame with seq > min_seq, or None. Non-blocking."""
 
+    def read_latest_blocking(
+        self, device_id: str, min_seq: int = 0, timeout_s: float = 1.0
+    ) -> Optional[Frame]:
+        """Newest frame with seq > min_seq, waiting up to ``timeout_s``
+        for one to arrive; None on timeout.
+
+        Default implementation polls ``read_latest`` every 2 ms — fine
+        for in-process backends (shm/memory: a poll is a couple of loads).
+        Network backends should override with a server-side wait: on the
+        Redis bus every poll is 1-2 round trips, so a 1 s miss window
+        costs ~500 RTTs against a production server where the reference
+        pays ONE ``XREAD BLOCK`` (grpc_api.go:191-197)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            frame = self.read_latest(device_id, min_seq=min_seq)
+            if frame is not None:
+                return frame
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.002)
+
     @abstractmethod
     def streams(self) -> list[str]:
         """Device ids with a live ring."""

@@ -23,6 +23,7 @@ behind an interface, plus a shared-memory ring").
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
@@ -62,8 +63,19 @@ class RedisFrameBus(FrameBus):
             handshake.append(("AUTH", password))
         if db:
             handshake.append(("SELECT", str(db)))
+        self._addr, self._conn_timeout = addr, timeout_s
+        self._handshake = tuple(handshake)
         self._client = RespClient.from_addr(addr, timeout_s,
-                                            handshake=tuple(handshake))
+                                            handshake=self._handshake)
+        # Blocking XREADs park a socket for up to ~1 s; running them on
+        # the SHARED client would head-of-line block every other Redis
+        # operation in the process (engine tick, heartbeats, other gRPC
+        # handlers) behind its lock. Each waiting thread gets its own
+        # lazily-created connection instead — bounded by the gRPC thread
+        # pool size, closed with the bus.
+        self._block_local = threading.local()
+        self._block_clients: list = []
+        self._block_clients_lock = threading.Lock()
         self._maxlen: dict[str, int] = {}  # producer-side ring depth
         # streams() verdict cache: key -> (is_frame_stream, probed_at).
         # Accepts are permanent (drop_stream evicts); rejects re-probe
@@ -151,6 +163,62 @@ class RedisFrameBus(FrameBus):
         if payload is None:
             return None
         return Frame(seq=seq, **_unmarshal(payload))
+
+    def read_latest_blocking(
+        self, device_id: str, min_seq: int = 0, timeout_s: float = 1.0
+    ) -> Optional[Frame]:
+        """Server-side wait via ``XREAD BLOCK`` — ONE round trip per miss
+        window where the default poll costs hundreds (reference
+        grpc_api.go:191-197 waits the same way, Block=1s).
+
+        XREAD is used purely as a *wake-up*: it returns entries OLDEST-
+        first after the cursor, and real Redis's lazy ``MAXLEN ~`` trim
+        can leave a deep backlog — serving its reply would hand a
+        GetFrame client a seconds-old frame. COUNT 1 bounds the wake-up
+        to one body; the actual fetch is ``read_latest``'s newest-wins
+        tip read. Each block is
+        clamped under the socket timeout (a quiet stream must return a
+        clean nil, not a socket error) and re-issued until ``timeout_s``
+        is consumed."""
+        import time
+
+        last_id = "%d-%d" % (
+            min_seq >> _SEQ_SHIFT, min_seq & ((1 << _SEQ_SHIFT) - 1),
+        )
+        client = self._blocking_client()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining < 0.002:
+                return None
+            block_s = min(remaining, max(0.1, client.timeout_s - 1.0))
+            # NEVER let the ms value floor to 0: BLOCK 0 means "block
+            # forever" in Redis, turning a drained timeout budget into an
+            # indefinite server-side hang.
+            block_ms = max(1, int(block_s * 1000))
+            reply = client.command(
+                "XREAD", "COUNT", "1", "BLOCK", str(block_ms),
+                "STREAMS", device_id, last_id,
+            )
+            if reply:
+                # Something newer than min_seq exists; serve the tip.
+                frame = self.read_latest(device_id, min_seq=min_seq)
+                if frame is not None:
+                    return frame
+
+    def _blocking_client(self) -> RespClient:
+        """This thread's dedicated connection for blocking XREADs (see
+        __init__ — parking the shared client would head-of-line block
+        the whole process)."""
+        client = getattr(self._block_local, "client", None)
+        if client is None:
+            client = RespClient.from_addr(
+                self._addr, self._conn_timeout, handshake=self._handshake
+            )
+            self._block_local.client = client
+            with self._block_clients_lock:
+                self._block_clients.append(client)
+        return client
 
     _REPROBE_S = 10.0  # rejected-key re-probe interval
 
@@ -321,6 +389,13 @@ class RedisFrameBus(FrameBus):
 
     def close(self) -> None:
         self._client.close()
+        with self._block_clients_lock:
+            for c in self._block_clients:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            self._block_clients.clear()
 
 
 def _unmarshal(payload: bytes) -> dict:

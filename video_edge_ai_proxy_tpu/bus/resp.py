@@ -37,7 +37,8 @@ class RespClient:
         """``handshake``: commands (tuples) run on every (re)connect before
         anything else — AUTH / SELECT, so a mid-run resync keeps its
         credentials and database."""
-        self._host, self._port, self._timeout = host, port, timeout_s
+        self._host, self._port = host, port
+        self.timeout_s = timeout_s  # public: callers clamp blocking cmds
         self._handshake = tuple(handshake)
         self._sock: Optional[socket.socket] = None
         self._buf = b""
@@ -46,7 +47,7 @@ class RespClient:
 
     def _connect(self) -> None:
         self._sock = socket.create_connection(
-            (self._host, self._port), timeout=self._timeout
+            (self._host, self._port), timeout=self.timeout_s
         )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b""

@@ -118,27 +118,44 @@ class Collector:
         last = self._last_interest.get(device_id)
         return last is None or now - last >= self._active_window_s
 
+    def partition(self) -> tuple:
+        """ONE bus enumeration -> (present, inferred): every listed
+        stream, and the subset the engine will infer this tick. The
+        engine's tick calls this once and threads the lists through
+        keep_streams_hot / collect / its GC — on the Redis backend each
+        enumeration is a SCAN and each gating check runs the model
+        resolver, so repeating them per call triples control-plane
+        traffic to a shared production server."""
+        present = self.active_streams()
+        return present, [d for d in present if not self._gated(d)]
+
     def inference_streams(self) -> List[str]:
         """Streams the engine will actually infer this tick."""
-        return [d for d in self.active_streams() if not self._gated(d)]
+        return self.partition()[1]
 
-    def keep_streams_hot(self, now_ms: Optional[int] = None) -> List[str]:
+    def keep_streams_hot(
+        self, now_ms: Optional[int] = None,
+        device_ids: Optional[Sequence[str]] = None,
+    ) -> List[str]:
         """The engine is a frame consumer like any gRPC client: touching
         ``last_query`` keeps the ingest workers' lazy-decode gate open
         (reference semantics, ``python/rtsp_to_rtmp.py:144-145``) — but
         ONLY for streams it will actually infer. Touching a gated stream
         would hold every idle camera's decode valve open from inside the
         engine, defeating the lazy-decode CPU saving (round-2 verdict
-        missing #4). Returns the ids it touched so the caller's tick can
-        reuse the enumeration instead of re-listing the bus."""
-        ids = self.inference_streams()
+        missing #4). ``device_ids``: a precomputed inferred set (from
+        ``partition``); None re-enumerates."""
+        ids = list(device_ids) if device_ids is not None \
+            else self.inference_streams()
         for device_id in ids:
             self._bus.touch_query(device_id, now_ms)
         return ids
 
-    def _take_new_frames(self):
+    def _take_new_frames(self, device_ids: Optional[Sequence[str]]):
+        if device_ids is None:
+            device_ids = self.inference_streams()
         out = []
-        for device_id in self.inference_streams():
+        for device_id in device_ids:
             frame = self._bus.read_latest(
                 device_id, min_seq=self._cursors.get(device_id, 0)
             )
@@ -148,10 +165,14 @@ class Collector:
             out.append((device_id, frame))
         return out
 
-    def collect(self) -> List[BatchGroup]:
+    def collect(
+        self, device_ids: Optional[Sequence[str]] = None
+    ) -> List[BatchGroup]:
         """One tick: newest unseen frame per stream -> (model, shape)-
-        grouped, bucket-padded batches (clips for video models)."""
-        fresh = self._take_new_frames()
+        grouped, bucket-padded batches (clips for video models).
+        ``device_ids``: precomputed inferred set (from ``partition``);
+        None re-enumerates."""
+        fresh = self._take_new_frames(device_ids)
         by_key: Dict[tuple, list] = {}
 
         for device_id, frame in fresh:

@@ -637,8 +637,10 @@ class InferenceEngine:
             # log-and-keep-going stance as the reference's worker loops,
             # rtsp_to_rtmp.py:186-187).
             try:
-                active_ids = self._collector.keep_streams_hot()
-                groups = self._collector.collect()
+                # One bus enumeration per tick, threaded everywhere.
+                present, inferred = self._collector.partition()
+                self._collector.keep_streams_hot(device_ids=inferred)
+                groups = self._collector.collect(device_ids=inferred)
                 submitted: List[_Inflight] = []
                 for group in groups:
                     step = self._step(group.src_hw, group.bucket, group.model)
@@ -668,7 +670,7 @@ class InferenceEngine:
                     # to "none") must keep its tracker, or re-enabling
                     # would restart track-id numbering and reuse ids
                     # already uplinked for other objects.
-                    present = set(self._collector.active_streams())
+                    present = set(present)
                     for d in set(self._trackers) | set(self._ann_state):
                         if d in present:
                             self._tracker_absent.pop(d, None)

@@ -53,6 +53,12 @@ class VideoSource(ABC):
     # True when grab() is demux-only AND packet_bytes()/stream_info expose
     # the compressed payload for stream-copy archive/relay (PacketSource).
     supports_packets: bool = False
+    # Which media path this is — surfaced through the worker heartbeat to
+    # ListStreams/Info/portal so a fleet can see which cameras have REAL
+    # packet semantics: "packet" (libav demux), "opencv" (fallback —
+    # keyframes/pts are GOP-cadence fabrications, sources.py:175-190),
+    # "synthetic" (test pattern).
+    kind: str = ""
 
     @abstractmethod
     def open(self) -> None:
@@ -81,6 +87,8 @@ class SyntheticSource(VideoSource):
     URL: ``test://pattern?w=1280&h=720&fps=30&gop=30&frames=0[&pace=1]``
     ``frames=0`` = endless; ``pace=0`` runs flat-out (benchmarks).
     """
+
+    kind = "synthetic"
 
     def __init__(self, url: str):
         q = {k: v[-1] for k, v in parse_qs(urlparse(url).query).items()}
@@ -149,6 +157,8 @@ class OpenCVSource(VideoSource):
     ``VideoCapture.grab()``/``.retrieve()``; keyframes are synthesized on a
     GOP cadence because VideoCapture does not expose picture type."""
 
+    kind = "opencv"
+
     def __init__(self, url: str, gop_hint: int = 30):
         self.url = url
         self.gop = gop_hint
@@ -207,6 +217,7 @@ class PacketSource(VideoSource):
     stream-copy archive/RTMP relay."""
 
     supports_packets = True
+    kind = "packet"
 
     def __init__(self, url: str, timeout_s: float = 5.0,
                  av_options: str = ""):

@@ -93,12 +93,14 @@ class ImageServicer:
 
     def _wait_latest(self, device_id: str, cursor: int):
         for attempt in range(FRAME_WAIT_RETRIES):
-            deadline = time.monotonic() + FRAME_BLOCK_S
-            while time.monotonic() < deadline:
-                frame = self._bus.read_latest(device_id, min_seq=cursor)
-                if frame is not None:
-                    return frame
-                time.sleep(0.002)
+            # Backend-appropriate wait: shm/memory poll in-process; the
+            # Redis backend blocks server-side (XREAD BLOCK — one round
+            # trip per miss window, reference grpc_api.go:191-197).
+            frame = self._bus.read_latest_blocking(
+                device_id, min_seq=cursor, timeout_s=FRAME_BLOCK_S
+            )
+            if frame is not None:
+                return frame
             if attempt < FRAME_WAIT_RETRIES - 1:
                 time.sleep(FRAME_WAIT_SLEEP_S)
         return None
@@ -107,14 +109,16 @@ class ImageServicer:
 
     def ListStreams(self, request, context) -> Iterator[pb.ListStream]:
         now_ms = int(time.time() * 1000)
+        from ..ingest.worker import KEY_STATUS_PREFIX, parse_fresh_status
+
         for record in self._pm.list():
             state = record.state
-            status_raw = self._bus.kv_get("stream_status_" + record.name)
-            hb = json.loads(status_raw) if status_raw else {}
-            # A heartbeat older than 5 s is stale — a crashed worker must not
-            # report healthy off its last written status.
-            fresh = now_ms - hb.get("ts_ms", 0) < 5000
-            health = "healthy" if (fresh and hb.get("fps", 0) > 0) else (
+            # Stale heartbeats parse to {} (single freshness bar shared
+            # with Info — ingest/worker.py::parse_fresh_status).
+            hb = parse_fresh_status(
+                self._bus.kv_get(KEY_STATUS_PREFIX + record.name), now_ms
+            )
+            health = "healthy" if hb.get("fps", 0) > 0 else (
                 "starting" if state and state.running else "unhealthy"
             )
             yield pb.ListStream(
@@ -130,6 +134,7 @@ class ImageServicer:
                 restarting=state.restarting if state else False,
                 oomkilled=state.oom_killed if state else False,
                 error=state.error if state else "",
+                source=hb.get("source", ""),
             )
 
     # -- Annotate --

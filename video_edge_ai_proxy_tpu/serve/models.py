@@ -64,6 +64,10 @@ class StreamProcess:
     # containers via CPUShares + json-file log limits,
     # ``rtsp_process_manager.go:71-78``); filled by Info, not persisted.
     limits: Optional[dict] = None
+    # Media path the worker heartbeat reports: packet | opencv (degraded
+    # fallback with fabricated keyframes/pts) | synthetic; filled by
+    # Info from the live heartbeat, not persisted.
+    source: str = ""
 
     def to_json(self) -> bytes:
         def drop_none(obj: Any) -> Any:

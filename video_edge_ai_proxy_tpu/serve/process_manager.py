@@ -35,7 +35,7 @@ from typing import Optional
 
 from ..bus import FrameBus
 from ..bus.interface import KEY_KEYFRAME_ONLY_PREFIX, KEY_LAST_ACCESS_PREFIX
-from ..ingest.worker import KEY_STATUS_PREFIX
+from ..ingest.worker import KEY_STATUS_PREFIX, parse_fresh_status
 from ..utils.logging import get_logger
 from ..utils.parsing import default_device_id
 from .models import PREFIX_RTSP_PROCESS, ProcessState, RTMPStreamStatus, StreamProcess
@@ -357,6 +357,15 @@ class ProcessManager:
             "nice": self._nice,
             "log_tail_lines": LOG_TAIL_LINES,
         }
+        # Live heartbeat extras: which media path the worker is actually
+        # on (packet vs the degraded opencv fallback vs synthetic) —
+        # stale heartbeats report nothing (shared freshness bar,
+        # ingest/worker.py::parse_fresh_status).
+        hb = parse_fresh_status(
+            self._bus.kv_get(KEY_STATUS_PREFIX + device_id),
+            int(time.time() * 1000),
+        )
+        record.source = hb.get("source", "")
         if entry and entry.tail:
             total, lines = entry.tail.snapshot(LOG_TAIL_LINES)
             record.logs = {
