@@ -37,7 +37,19 @@ def main() -> None:
     ap.add_argument("--no-engine", dest="engine", action="store_false")
     ap.add_argument("--backend", default="shm", choices=("shm", "redis"))
     ap.add_argument("--redis_addr", default="")
+    ap.add_argument("--model", default="yolov8n",
+                    help="engine model (tiny_yolov8 for CPU-backend smokes)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (sitecustomize imports jax "
+                         "before env vars can act — see CLAUDE.md)")
+    ap.add_argument("--size", default="1280x720",
+                    help="camera geometry WxH (tiny models want small frames)")
     args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     import grpc
 
@@ -53,7 +65,8 @@ def main() -> None:
     if args.redis_addr:
         cfg.bus.redis_addr = args.redis_addr
     cfg.annotation.endpoint = "http://127.0.0.1:1/annotate"  # no egress
-    cfg.engine.model = "yolov8n"
+    cfg.engine.model = args.model
+    w, _, h = args.size.partition("x")
     srv = Server(cfg, data_dir=tmp, grpc_port=0, rest_port=0,
                  enable_engine=args.engine)
     srv.start()
@@ -62,7 +75,7 @@ def main() -> None:
     for name in cams:
         srv.process_manager.start(StreamProcess(
             name=name,
-            rtsp_endpoint="test://pattern?w=1280&h=720&fps=30&gop=30",
+            rtsp_endpoint=f"test://pattern?w={w}&h={h}&fps=30&gop=30",
         ))
 
     stop = threading.Event()
@@ -150,11 +163,19 @@ def main() -> None:
     stop.set()
     for t in threads:
         t.join(timeout=10)
-    # post-chaos: every camera must be running again
-    running = sum(
-        1 for c in cams
-        if srv.process_manager.info(c).state.running
-    )
+    # post-chaos: every camera must come back. A kill in the final seconds
+    # is still inside the supervisor's detect+backoff+respawn pipeline
+    # (up to ~3 s), so give healing a bounded grace instead of sampling a
+    # healthy supervisor mid-restart.
+    heal_deadline = time.monotonic() + 8.0
+    while True:
+        running = sum(
+            1 for c in cams
+            if srv.process_manager.info(c).state.running
+        )
+        if running == len(cams) or time.monotonic() >= heal_deadline:
+            break
+        time.sleep(0.5)
     engine_stats = srv.engine.stats() if srv.engine else {}
     srv.stop()
     # Soak runs repeat; each must reclaim its tmpfs rings and registry dir.

@@ -62,6 +62,11 @@ def parse_fresh_status(raw, now_ms: int) -> dict:
         hb = _json.loads(raw)
     except ValueError:
         return {}
+    # Valid JSON that isn't an object ('null', a number, a list — corrupt
+    # write or a co-tenant key in a shared Redis db) must degrade to {},
+    # not AttributeError every consumer.
+    if not isinstance(hb, dict):
+        return {}
     return hb if now_ms - hb.get("ts_ms", 0) < STATUS_FRESH_MS else {}
 
 

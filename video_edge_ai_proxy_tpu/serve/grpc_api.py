@@ -23,7 +23,6 @@ way the survey prescribes:
 
 from __future__ import annotations
 
-import json
 import time
 from typing import Iterator, Optional
 
@@ -109,15 +108,12 @@ class ImageServicer:
 
     def ListStreams(self, request, context) -> Iterator[pb.ListStream]:
         now_ms = int(time.time() * 1000)
-        from ..ingest.worker import KEY_STATUS_PREFIX, parse_fresh_status
-
         for record in self._pm.list():
             state = record.state
-            # Stale heartbeats parse to {} (single freshness bar shared
-            # with Info — ingest/worker.py::parse_fresh_status).
-            hb = parse_fresh_status(
-                self._bus.kv_get(KEY_STATUS_PREFIX + record.name), now_ms
-            )
+            # Parsed-fresh heartbeat comes WITH the record (Info fills it,
+            # single freshness bar in ingest/worker.py::parse_fresh_status)
+            # — no second bus fetch per camera per poll.
+            hb = record.heartbeat or {}
             health = "healthy" if hb.get("fps", 0) > 0 else (
                 "starting" if state and state.running else "unhealthy"
             )

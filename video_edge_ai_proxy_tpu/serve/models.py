@@ -68,6 +68,10 @@ class StreamProcess:
     # fallback with fabricated keyframes/pts) | synthetic; filled by
     # Info from the live heartbeat, not persisted.
     source: str = ""
+    # Full parsed fresh heartbeat (Info fills it; {} = stale/absent) so
+    # consumers (ListStreams health) don't re-fetch the bus key per
+    # record. Transient: from_json ignores it, so it never persists.
+    heartbeat: Optional[dict] = None
 
     def to_json(self) -> bytes:
         def drop_none(obj: Any) -> Any:

@@ -366,6 +366,7 @@ class ProcessManager:
             int(time.time() * 1000),
         )
         record.source = hb.get("source", "")
+        record.heartbeat = hb
         if entry and entry.tail:
             total, lines = entry.tail.snapshot(LOG_TAIL_LINES)
             record.logs = {
