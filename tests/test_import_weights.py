@@ -454,6 +454,65 @@ def test_import_cli_and_eval_entrypoint(tmp_path):
     assert summary["mAP"] == pytest.approx(1.0, abs=1e-6)
 
 
+def test_cpad_stem_imports_3channel_checkpoints():
+    """yolov8n serves with stem_pad_c=8 (the +3.2% lane-fill lever,
+    BASELINE.md); a canonical 3-channel ultralytics checkpoint must
+    import by zero-padding the stem kernel, and the padded model must
+    reproduce the unpadded model's outputs exactly."""
+    import dataclasses
+
+    from flax import traverse_util
+
+    from video_edge_ai_proxy_tpu.models.yolov8 import (
+        YOLOv8, tiny_yolov8_config,
+    )
+
+    # Model-level equivalence: zero-padded kernel == baseline outputs.
+    cfg0 = tiny_yolov8_config()
+    m0 = YOLOv8(cfg0, dtype=jnp.float32)
+    v0 = m0.init(jax.random.PRNGKey(0), np.zeros((1, 64, 64, 3), np.float32))
+    mp = YOLOv8(dataclasses.replace(cfg0, stem_pad_c=8), dtype=jnp.float32)
+    flat = traverse_util.flatten_dict(v0)
+    k = ("params", "stem", "conv", "kernel")
+    w = np.asarray(flat[k])
+    flat[k] = np.pad(w, ((0, 0), (0, 0), (0, 5), (0, 0)))
+    vp = traverse_util.unflatten_dict(flat)
+    x = np.random.default_rng(0).uniform(0, 1, (2, 64, 64, 3)).astype(np.float32)
+    for (a, b), (c, d) in zip(
+        m0.apply(v0, x, decode=False), mp.apply(vp, x, decode=False)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(b), np.asarray(d), atol=1e-5)
+
+    # Importer-level: a 3-channel source stem lands zero-padded in the
+    # full-size (padded) yolov8n tree.
+    from video_edge_ai_proxy_tpu.parallel.sharding import unbox
+
+    _, tmpl = registry.get("yolov8n").init_params(jax.random.PRNGKey(0))
+    flat_t = traverse_util.flatten_dict(unbox(tmpl))
+    assert flat_t[("params", "stem", "conv", "kernel")].shape[2] == 8
+    state = {}
+    for path, leaf in flat_t.items():
+        key, tr = iw._yolo_key(tuple(path[1:]))
+        arr = np.asarray(leaf, np.float32)
+        if tr is iw._conv_kernel:
+            arr = np.transpose(arr, (3, 2, 0, 1))
+        elif tr is iw._dense_kernel:
+            arr = np.transpose(arr)
+        state[key] = arr
+    # Slice the stem back to the canonical 3 input channels (what a real
+    # ultralytics state dict ships).
+    state["0.conv.weight"] = state["0.conv.weight"][:, :3]
+    out = iw.convert("yolov8n", state)
+    got = traverse_util.flatten_dict(out)[("params", "stem", "conv", "kernel")]
+    assert got.shape[2] == 8
+    np.testing.assert_array_equal(got[:, :, 3:, :], 0.0)
+    np.testing.assert_array_equal(
+        got[:, :, :3, :],
+        np.transpose(state["0.conv.weight"], (2, 3, 1, 0)),
+    )
+
+
 def test_engine_serves_imported_checkpoint(tmp_path):
     """import -> save_msgpack -> engine checkpoint_path: the serving plane
     actually loads converted weights (the documented recipe end to end)."""

@@ -52,6 +52,31 @@ def build_variant(name: str):
 
     model_name = "yolov8n_s2d" if name.startswith("s2d") else "yolov8n"
     spec = registry.get(model_name)
+    if name.startswith("cpad") or name in ("baseline", "int8"):
+        # Explicit stem_pad_c per variant: yolov8n's DEFAULT is now
+        # cpad8 (adopted round 3), so "baseline"/"int8" must pin pad=0
+        # to stay the unpadded control the recorded history compares
+        # against — registry defaults would silently re-base them.
+        import dataclasses
+
+        from video_edge_ai_proxy_tpu.models.yolov8 import (
+            YOLOv8, yolov8n_config,
+        )
+
+        pad = int(name[4:]) if name.startswith("cpad") else 0
+        model = YOLOv8(dataclasses.replace(yolov8n_config(), stem_pad_c=pad))
+        variables = jax.jit(model.init)(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, spec.input_size, spec.input_size, 3), jnp.bfloat16),
+        )
+        if name == "int8":
+            from video_edge_ai_proxy_tpu.models.quantize import (
+                dequantize_tree as deq, quantize_tree as q,
+            )
+
+            base = build_serving_step(model, spec)
+            return (lambda qv, u8, _b=base: _b(deq(qv), u8)), q(variables)
+        return build_serving_step(model, spec), variables
     model, variables = spec.init_params(jax.random.PRNGKey(0))
     raw = build_serving_step(model, spec)
     if name.endswith("int8"):
@@ -113,7 +138,8 @@ def main() -> None:
     )
 
     results = []
-    for name in ("baseline", "int8", "s2d", "s2d_int8"):
+    for name in ("baseline", "int8", "s2d", "s2d_int8",
+                 "cpad8", "cpad16", "cpad32"):
         r = bench_variant(name, base_dev, iters, backend)
         results.append(r)
         print(json.dumps(r), flush=True)

@@ -66,7 +66,10 @@ def main() -> None:
         cfg.bus.redis_addr = args.redis_addr
     cfg.annotation.endpoint = "http://127.0.0.1:1/annotate"  # no egress
     cfg.engine.model = args.model
-    w, _, h = args.size.partition("x")
+    try:
+        w, h = (int(v) for v in args.size.lower().split("x"))
+    except ValueError:
+        ap.error(f"--size must be WxH, got {args.size!r}")
     srv = Server(cfg, data_dir=tmp, grpc_port=0, rest_port=0,
                  enable_engine=args.engine)
     srv.start()

@@ -282,6 +282,21 @@ def convert(model_name: str, state: Dict[str, np.ndarray]):
         val = state[src_key]
         if transform is not None:
             val = transform(val)
+        tgt = np.shape(target)
+        if (full_path[-3:] == ("stem", "conv", "kernel")
+                and len(tgt) == 4 and np.shape(val)[:2] == tgt[:2]
+                and np.shape(val)[3] == tgt[3]
+                and np.shape(val)[2] < tgt[2]):
+            # Channel-padded stem (YOLOv8Config.stem_pad_c): the model
+            # zero-pads its INPUT planes beyond the source's 3 channels,
+            # so zero weights there reproduce source outputs exactly —
+            # the checkpoint-transferable lane-fill lever (BASELINE.md).
+            # Only the stem qualifies: a mid-network channel pad would
+            # see real activations and zero weights would be WRONG.
+            val = np.pad(
+                val,
+                ((0, 0), (0, 0), (0, tgt[2] - np.shape(val)[2]), (0, 0)),
+            )
         if np.shape(val) != np.shape(target):
             problems.append(
                 f"shape mismatch for {'/'.join(full_path)}: source "

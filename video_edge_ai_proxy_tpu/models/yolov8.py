@@ -40,6 +40,13 @@ class YOLOv8Config:
     # the 128-lane registers at 3 channels). Same output geometry as the
     # stride-2 stem; DIFFERENT architecture — checkpoints do not transfer.
     s2d_stem: bool = False
+    # Channel-padded stem (the one lane-fill lever that DOES transfer
+    # checkpoints): zero-pad the input from 3 to this many channels before
+    # the stem conv, whose kernel grows [3,3,3,C]->[3,3,pad,C]. The extra
+    # input planes are zeros, so ANY weights in the extra kernel channels
+    # produce identical outputs — an imported checkpoint just zero-pads
+    # its stem kernel (models/import_weights.py). 0 = off.
+    stem_pad_c: int = 0
 
     def ch(self, c: int) -> int:
         return make_divisible(min(c, self.max_channels) * self.width_mult)
@@ -49,11 +56,17 @@ class YOLOv8Config:
 
 
 def yolov8n_config(num_classes: int = 80) -> YOLOv8Config:
-    return YOLOv8Config(num_classes=num_classes)
+    # stem_pad_c=8: measured +3.2% end-to-end at the north-star shape
+    # (two uncontended runs, 12.35/12.36 vs 12.74 ms — BASELINE.md levers
+    # table), reproducible, and checkpoint-transferable (the importer
+    # zero-pads the stem kernel, unlike s2d which lost 0.85x AND broke
+    # checkpoints).
+    return YOLOv8Config(num_classes=num_classes, stem_pad_c=8)
 
 
 def yolov8s_config(num_classes: int = 80) -> YOLOv8Config:
-    return YOLOv8Config(num_classes=num_classes, depth_mult=0.33, width_mult=0.5)
+    return YOLOv8Config(num_classes=num_classes, depth_mult=0.33,
+                        width_mult=0.5, stem_pad_c=8)
 
 
 def tiny_yolov8_config(num_classes: int = 4) -> YOLOv8Config:
@@ -202,6 +215,13 @@ class YOLOv8(nn.Module):
             x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * ci)
             x = ConvBN(ch(64), dtype=self.dtype, name="stem")(x, train)             # P1
         else:
+            if c.stem_pad_c > x.shape[-1]:
+                # Lane-fill: zero input planes cost bandwidth but let XLA
+                # tile the stem conv with full input-channel vectors.
+                x = jnp.pad(
+                    x, ((0, 0), (0, 0), (0, 0),
+                        (0, c.stem_pad_c - x.shape[-1]))
+                )
             x = ConvBN(ch(64), stride=2, dtype=self.dtype, name="stem")(x, train)   # P1
         x = ConvBN(ch(128), stride=2, dtype=self.dtype, name="down2")(x, train)     # P2
         x = C2f(ch(128), d(3), True, self.dtype, name="c2f_2")(x, train)

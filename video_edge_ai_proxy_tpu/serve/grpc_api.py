@@ -107,7 +107,6 @@ class ImageServicer:
     # -- ListStreams --
 
     def ListStreams(self, request, context) -> Iterator[pb.ListStream]:
-        now_ms = int(time.time() * 1000)
         for record in self._pm.list():
             state = record.state
             # Parsed-fresh heartbeat comes WITH the record (Info fills it,

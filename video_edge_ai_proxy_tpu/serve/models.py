@@ -70,7 +70,10 @@ class StreamProcess:
     source: str = ""
     # Full parsed fresh heartbeat (Info fills it; {} = stale/absent) so
     # consumers (ListStreams health) don't re-fetch the bus key per
-    # record. Transient: from_json ignores it, so it never persists.
+    # record. Transient: _persist round-trips every write through
+    # from_json (process_manager.py::_persist), which ignores this
+    # field, so it never reaches storage even when an info()-derived
+    # record is passed to update_record.
     heartbeat: Optional[dict] = None
 
     def to_json(self) -> bytes:
