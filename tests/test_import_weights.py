@@ -513,6 +513,28 @@ def test_cpad_stem_imports_3channel_checkpoints():
     )
 
 
+def test_stem_pad_is_config_gated_not_shape_inferred():
+    """The zero-pad shim must fire ONLY for the channel-padded stem: the
+    s2d stem's extra input planes carry real pixels (a shape-only pad
+    would silently serve garbage — round-3 review), and a width that
+    doesn't match the config's stem_pad_c means a different architecture
+    and must stay a loud failure."""
+    import dataclasses
+
+    from video_edge_ai_proxy_tpu.models.import_weights import _stem_pad_ok
+    from video_edge_ai_proxy_tpu.models.yolov8 import (
+        YOLOv8, yolov8n_config,
+    )
+
+    cpad = YOLOv8(yolov8n_config()).cfg                     # stem_pad_c=8
+    s2d = YOLOv8(dataclasses.replace(
+        yolov8n_config(), s2d_stem=True, stem_pad_c=0)).cfg
+    assert _stem_pad_ok(cpad, (3, 3, 3, 16), (3, 3, 8, 16))
+    assert not _stem_pad_ok(s2d, (3, 3, 3, 16), (3, 3, 12, 16))
+    assert not _stem_pad_ok(cpad, (3, 3, 3, 16), (3, 3, 12, 16))
+    assert not _stem_pad_ok(None, (3, 3, 3, 16), (3, 3, 8, 16))
+
+
 def test_engine_serves_imported_checkpoint(tmp_path):
     """import -> save_msgpack -> engine checkpoint_path: the serving plane
     actually loads converted weights (the documented recipe end to end)."""

@@ -92,8 +92,11 @@ def main() -> None:
         # bf16 number (197 TFLOP/s) — the dev chip class; treat MFU as a
         # per-config ACCOUNTING column, not a cross-chip claim.
         try:
-            single = jax.jit(step).lower(var_dev, dev).compile()
-            cost = single.cost_analysis() or {}
+            # Lowered (pre-backend-compile) cost analysis: FLOP counts
+            # come from the HLO, so the step is NOT compiled a second
+            # time (ViT/VideoMAE compiles cost tens of seconds through
+            # the dev tunnel).
+            cost = jax.jit(step).lower(var_dev, dev).cost_analysis() or {}
             flops = float(cost.get("flops", 0.0))
             if flops > 0:
                 achieved = flops / (batch_ms / 1e3)

@@ -70,12 +70,11 @@ def build_variant(name: str):
             jnp.zeros((1, spec.input_size, spec.input_size, 3), jnp.bfloat16),
         )
         if name == "int8":
-            from video_edge_ai_proxy_tpu.models.quantize import (
-                dequantize_tree as deq, quantize_tree as q,
-            )
-
             base = build_serving_step(model, spec)
-            return (lambda qv, u8, _b=base: _b(deq(qv), u8)), q(variables)
+            return (
+                lambda qv, u8, _b=base: _b(dequantize_tree(qv), u8),
+                quantize_tree(variables),
+            )
         return build_serving_step(model, spec), variables
     model, variables = spec.init_params(jax.random.PRNGKey(0))
     raw = build_serving_step(model, spec)

@@ -45,9 +45,14 @@ def evaluate(model_name: str, checkpoint: str, images: np.ndarray,
         raise ValueError(f"{model_name!r} is {spec.kind!r}, not a detector")
     model, variables = spec.init_params(jax.random.PRNGKey(0))
     if checkpoint:
-        variables = load_msgpack(
-            checkpoint, jax.tree.map(np.asarray, variables)
+        from video_edge_ai_proxy_tpu.models.import_weights import (
+            pad_stem_on_load,
         )
+
+        template = jax.tree.map(np.asarray, variables)
+        loaded = load_msgpack(checkpoint, template)
+        # Same pre-stem_pad_c compat shim the engine load path applies.
+        variables = pad_stem_on_load(loaded, template, model)
     step = jax.jit(build_serving_step(model, spec))
 
     ev = DetectionEvaluator()

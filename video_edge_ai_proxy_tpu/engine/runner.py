@@ -60,32 +60,6 @@ def _rebox(template, values):
     )
 
 
-def _pad_stem_on_load(raw, template):
-    """Zero-pad a checkpoint's stem conv kernel when the model now runs
-    the channel-padded stem (YOLOv8Config.stem_pad_c, BASELINE.md lever):
-    checkpoints saved before the pad was adopted carry [3,3,3,C]; the
-    padded input planes are zeros, so zero weights reproduce the saved
-    model's outputs exactly (same rule as models/import_weights.py)."""
-    try:
-        kern = raw["params"]["stem"]["conv"]["kernel"]
-        want = template["params"]["stem"]["conv"]["kernel"].shape
-    except (KeyError, TypeError):
-        return raw
-    have = np.shape(kern)
-    if (len(have) == 4 and len(want) == 4 and have != want
-            and have[:2] == want[:2] and have[3] == want[3]
-            and have[2] < want[2]):
-        raw["params"]["stem"]["conv"]["kernel"] = np.pad(
-            np.asarray(kern),
-            ((0, 0), (0, 0), (0, want[2] - have[2]), (0, 0)),
-        )
-        log.info(
-            "checkpoint stem kernel zero-padded %s -> %s (stem_pad_c)",
-            have, want,
-        )
-    return raw
-
-
 def build_serving_step(model, spec):
     """The per-tick device program for one model kind: uint8 frames in,
     postprocessed results out. SINGLE source of truth — the engine compiles
@@ -259,10 +233,17 @@ class InferenceEngine:
                 # mirrors); restore against an unboxed template, then
                 # re-box so ViT-family logical sharding names survive for
                 # mesh serving.
+                from ..models.import_weights import pad_stem_on_load
+
                 raw = load_msgpack(
                     ckpt, jax.tree.map(np.asarray, unbox(self._variables))
                 )
-                raw = _pad_stem_on_load(raw, unbox(self._variables))
+                # Pre-stem_pad_c checkpoints: zero-pad the stem kernel
+                # (config-gated — never fires for the s2d stem, whose
+                # extra input planes carry real pixels).
+                raw = pad_stem_on_load(
+                    raw, unbox(self._variables), self._model
+                )
                 self._variables = jax.device_put(
                     _rebox(self._variables, raw)
                 )

@@ -87,6 +87,43 @@ def _strip_model_prefix(state: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     return state
 
 
+def _stem_pad_ok(model_cfg, have: tuple, want: tuple) -> bool:
+    """Is zero-padding a stem conv kernel ``have`` -> ``want`` sound for
+    this model config? True only when the model really runs the
+    channel-padded stem (stem_pad_c, NOT the space-to-depth stem — its
+    extra input planes carry real pixels) and the shapes differ solely by
+    the missing padded input channels."""
+    pad_c = getattr(model_cfg, "stem_pad_c", 0)
+    if not pad_c or getattr(model_cfg, "s2d_stem", False):
+        return False
+    return (
+        len(have) == 4 and len(want) == 4
+        and have[:2] == want[:2] and have[3] == want[3]
+        and have[2] < want[2] == pad_c
+    )
+
+
+def pad_stem_on_load(raw, template, model) -> dict:
+    """Compat shim for checkpoints saved before ``stem_pad_c`` was
+    adopted: zero-pad the stem conv kernel to the template's shape when
+    (and only when) the model config says the extra input planes are
+    zero-padding. Shared by the engine load path and tools/eval_detector
+    — every ``load_msgpack`` consumer of detector checkpoints."""
+    cfg = getattr(model, "cfg", None)
+    try:
+        kern = raw["params"]["stem"]["conv"]["kernel"]
+        want = np.shape(template["params"]["stem"]["conv"]["kernel"])
+    except (KeyError, TypeError):
+        return raw
+    have = np.shape(kern)
+    if have != want and _stem_pad_ok(cfg, have, want):
+        raw["params"]["stem"]["conv"]["kernel"] = np.pad(
+            np.asarray(kern),
+            ((0, 0), (0, 0), (0, want[2] - have[2]), (0, 0)),
+        )
+    return raw
+
+
 def _conv_kernel(w: np.ndarray) -> np.ndarray:
     """torch OIHW -> flax HWIO."""
     return np.transpose(w, (2, 3, 1, 0))
@@ -262,7 +299,10 @@ def convert(model_name: str, state: Dict[str, np.ndarray]):
     if key_fn is _yolo_key:
         state = _strip_model_prefix(state)
 
-    _, template = registry.get(model_name).init_params(jax.random.PRNGKey(0))
+    model, template = registry.get(model_name).init_params(
+        jax.random.PRNGKey(0)
+    )
+    model_cfg = getattr(model, "cfg", None)
     # ViT-family params are boxed in LogicallyPartitioned (sharding names);
     # the importer works on raw arrays — the engine re-boxes when it shards.
     from ..parallel.sharding import unbox
@@ -284,15 +324,14 @@ def convert(model_name: str, state: Dict[str, np.ndarray]):
             val = transform(val)
         tgt = np.shape(target)
         if (full_path[-3:] == ("stem", "conv", "kernel")
-                and len(tgt) == 4 and np.shape(val)[:2] == tgt[:2]
-                and np.shape(val)[3] == tgt[3]
-                and np.shape(val)[2] < tgt[2]):
+                and _stem_pad_ok(model_cfg, np.shape(val), tgt)):
             # Channel-padded stem (YOLOv8Config.stem_pad_c): the model
             # zero-pads its INPUT planes beyond the source's 3 channels,
             # so zero weights there reproduce source outputs exactly —
             # the checkpoint-transferable lane-fill lever (BASELINE.md).
-            # Only the stem qualifies: a mid-network channel pad would
-            # see real activations and zero weights would be WRONG.
+            # Gated on the TARGET CONFIG, not shape inference: the s2d
+            # stem's extra input planes carry real pixels (a shape-only
+            # pad would silently produce garbage there).
             val = np.pad(
                 val,
                 ((0, 0), (0, 0), (0, tgt[2] - np.shape(val)[2]), (0, 0)),
