@@ -640,3 +640,54 @@ class TestAnnotationPolicy:
         assert ann.dropped == 0
         assert ann.published == 16 * 3             # first sighting only
         assert eng.annotations_suppressed == (300 - 1) * 16 * 3
+
+
+class TestModelParallelServing:
+    def test_tp_sharded_vit_serving(self, bus):
+        """Model-parallel serving (dp x tp): transformer params shard over
+        tp per their logical axis names while the batch shards over dp —
+        the big/long-context serving path (ViT-B, VideoMAE-64) where
+        replicate-everywhere would not fit. Conv trees (no logical names)
+        keep replicating."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        cfg = EngineConfig(
+            model="tiny_vit", batch_buckets=(2, 4), tick_ms=5,
+            mesh={"dp": 2, "tp": 4},
+        )
+        eng = InferenceEngine(bus, cfg, annotations=_sink())
+        eng.warmup()
+        # qkv kernel sharded over tp on its output axis; cls_token
+        # (unannotated-equivalent axes) replicated across the mesh.
+        qkv = eng._variables["params"]["encoder"]["block0"]["attn"]["qkv"][
+            "kernel"
+        ]
+        assert len(qkv.sharding.device_set) == 8
+        # embed axis maps to fsdp (size 1 here = no split), qkv width to tp
+        assert qkv.sharding.spec == P("fsdp", "tp")
+        bus.create_stream("cam0", 32 * 32 * 3)
+        _publish(bus, "cam0", w=32, h=32)
+        groups = eng._collector.collect()
+        placed = eng._place(groups[0].frames)
+        assert len(placed.sharding.device_set) == 8  # dp x tp mesh
+        out = eng._step(groups[0].src_hw, groups[0].bucket)(
+            eng._variables, placed
+        )
+        assert np.asarray(out["top_probs"]).shape == (2, 5)
+        # Same results as a single-chip engine with identical init.
+        eng1 = InferenceEngine(
+            bus, EngineConfig(model="tiny_vit", batch_buckets=(2,)),
+            annotations=_sink(),
+        )
+        eng1.warmup()
+        out1 = eng1._step(groups[0].src_hw, 2)(
+            eng1._variables, groups[0].frames
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["top_probs"]), np.asarray(out1["top_probs"]),
+            rtol=2e-2, atol=2e-3,  # bf16 + collective reduction order
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["top_ids"]), np.asarray(out1["top_ids"])
+        )

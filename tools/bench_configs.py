@@ -92,11 +92,12 @@ def main() -> None:
         # bf16 number (197 TFLOP/s) — the dev chip class; treat MFU as a
         # per-config ACCOUNTING column, not a cross-chip claim.
         try:
-            # Lowered (pre-backend-compile) cost analysis: FLOP counts
-            # come from the HLO, so the step is NOT compiled a second
-            # time (ViT/VideoMAE compiles cost tens of seconds through
-            # the dev tunnel).
-            cost = jax.jit(step).lower(var_dev, dev).cost_analysis() or {}
+            # NB: must be Compiled.cost_analysis() — Lowered.cost_analysis()
+            # returns None on this jax/axon backend (verified), which would
+            # silently drop the MFU columns. The extra single-step compile
+            # is the price of the FLOP count.
+            cost = jax.jit(step).lower(var_dev, dev).compile() \
+                .cost_analysis() or {}
             flops = float(cost.get("flops", 0.0))
             if flops > 0:
                 achieved = flops / (batch_ms / 1e3)

@@ -277,9 +277,7 @@ class InferenceEngine:
                 )
             dp = self._mesh.shape["dp"]
             buckets = tuple(b for b in buckets if b % dp == 0) or (dp,)
-            self._variables = jax.device_put(
-                self._variables, replicated(self._mesh)
-            )
+            self._variables = self._place_variables(self._variables)
             log.info(
                 "engine mesh: %s (buckets -> %s)",
                 dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
@@ -323,6 +321,29 @@ class InferenceEngine:
         )
         return qt
 
+    def _place_variables(self, variables):
+        """Put a model's variables onto the serving mesh. With model
+        axes configured (tp/fsdp/sp/ep > 1) and full-precision weights,
+        transformer params shard per their flax logical axis names
+        ("embed"/"qkv"/"mlp"/"expert"; conv trees carry none and
+        replicate) — big/long-context models (ViT-B, VideoMAE-64) fit and
+        serve across chips with XLA inserting the collectives
+        (scaling-book recipe, parallel/sharding.py rules). dp-only meshes
+        and int8 weight trees (already tiny) replicate. ONE decision for
+        the default model and every per-stream extra."""
+        import jax
+
+        from ..parallel import replicated
+
+        model_axes = any(
+            self._mesh.shape.get(a, 1) > 1 for a in ("tp", "fsdp", "sp", "ep")
+        )
+        if model_axes and not self._cfg.quantize:
+            from ..parallel.sharding import place_params
+
+            return place_params(self._mesh, variables)
+        return jax.device_put(variables, replicated(self._mesh))
+
     def _ensure_model(self, name: str):
         """(spec, model, variables) for a registry model, lazily built.
         Only the default model reads cfg.checkpoint_path; per-stream extras
@@ -337,9 +358,7 @@ class InferenceEngine:
             model, variables = spec.init_params(jax.random.PRNGKey(0))
             variables = self._maybe_quantize(variables)
             if self._mesh is not None:
-                from ..parallel import replicated
-
-                variables = jax.device_put(variables, replicated(self._mesh))
+                variables = self._place_variables(variables)
             entry = (spec, model, variables)
             self._models[name] = entry
             log.info("engine loaded extra model '%s' (kind=%s)", name, spec.kind)

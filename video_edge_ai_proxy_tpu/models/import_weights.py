@@ -121,6 +121,14 @@ def pad_stem_on_load(raw, template, model) -> dict:
             np.asarray(kern),
             ((0, 0), (0, 0), (0, want[2] - have[2]), (0, 0)),
         )
+        # Loud trace: served weights now differ in shape from the on-disk
+        # checkpoint; an operator debugging that must see why.
+        from ..utils.logging import get_logger
+
+        get_logger("models.import").info(
+            "checkpoint stem kernel zero-padded %s -> %s (stem_pad_c "
+            "compat)", have, want,
+        )
     return raw
 
 
