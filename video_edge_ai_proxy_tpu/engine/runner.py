@@ -241,23 +241,24 @@ class InferenceEngine:
                 # Pre-stem_pad_c checkpoints: zero-pad the stem kernel
                 # (config-gated — never fires for the s2d stem, whose
                 # extra input planes carry real pixels).
-                raw = pad_stem_on_load(
-                    raw, unbox(self._variables), self._model
-                )
-                self._variables = jax.device_put(
-                    _rebox(self._variables, raw)
-                )
+                # Host tree for now: placement happens ONCE below (mesh
+                # sharding or single-chip put). An eager device_put here
+                # would materialize the full tree on one chip first —
+                # exactly what sharded serving of big models must avoid.
+                self._variables = _rebox(self._variables, raw)
                 log.info("loaded engine params from %s", ckpt)
             else:
                 log.warning("checkpoint %s missing; using random init", ckpt)
         self._variables = self._maybe_quantize(self._variables)
         buckets = tuple(self._cfg.batch_buckets)
         if self._cfg.mesh:
-            # Multi-chip serving: batch axis sharded over dp, params
-            # replicated (inference weights are small; fsdp-style sharding
-            # belongs to training). Buckets must divide evenly across dp so
-            # every chip gets identical static shapes.
-            from ..parallel import factor_mesh, make_mesh, replicated
+            # Multi-chip serving: batch axis sharded over dp; params
+            # placed by _place_variables (replicated for dp-only meshes
+            # and conv trees, SHARDED per logical axis names when the
+            # mesh has tp/fsdp/sp/ep — big/long-context transformers).
+            # Buckets must divide evenly across dp so every chip gets
+            # identical static shapes.
+            from ..parallel import factor_mesh, make_mesh
 
             if isinstance(self._cfg.mesh, str):
                 if self._cfg.mesh != "auto":
@@ -283,6 +284,11 @@ class InferenceEngine:
                 dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
                 buckets,
             )
+        else:
+            # Single chip: a checkpoint-loaded tree is host numpy at this
+            # point — place it once so the serving step isn't re-shipping
+            # params every tick. (No-op for random-init device arrays.)
+            self._variables = jax.device_put(self._variables)
         self._models[self._spec.name] = (self._spec, self._model, self._variables)
         self._buckets = buckets   # effective (mesh-filtered) buckets
         self._collector = Collector(
