@@ -535,6 +535,51 @@ def test_stem_pad_is_config_gated_not_shape_inferred():
     assert not _stem_pad_ok(None, (3, 3, 3, 16), (3, 3, 8, 16))
 
 
+def test_engine_load_path_pads_pre_cpad_checkpoint(tmp_path):
+    """The ENGINE's warmup must apply the stem-pad shim (not just the
+    importer): a checkpoint saved before stem_pad_c was adopted loads
+    into a padded model and serves — round-3 review caught a refactor
+    silently dropping this call, so it gets its own regression test."""
+    import dataclasses
+
+    from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+    from video_edge_ai_proxy_tpu.engine import InferenceEngine
+    from video_edge_ai_proxy_tpu.models.registry import ModelSpec
+    from video_edge_ai_proxy_tpu.models.yolov8 import (
+        YOLOv8, tiny_yolov8_config,
+    )
+    from video_edge_ai_proxy_tpu.utils.checkpoint import save_msgpack
+    from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+    m_old = YOLOv8(tiny_yolov8_config())       # pre-adoption: no pad
+    v_old = jax.jit(m_old.init)(
+        jax.random.PRNGKey(3), np.zeros((1, 64, 64, 3), np.float32)
+    )
+    ckpt = str(tmp_path / "old.msgpack")
+    save_msgpack(ckpt, jax.tree.map(np.asarray, v_old))
+
+    registry.register(ModelSpec(
+        "_test_tiny_cpad",
+        lambda: YOLOv8(
+            dataclasses.replace(tiny_yolov8_config(), stem_pad_c=8)
+        ),
+        input_size=64, preprocess="letterbox", kind="detect",
+    ))
+    bus = MemoryFrameBus()
+    eng = InferenceEngine(bus, EngineConfig(
+        model="_test_tiny_cpad", batch_buckets=(1,), checkpoint_path=ckpt,
+    ))
+    eng.warmup()
+    kern = np.asarray(eng._variables["params"]["stem"]["conv"]["kernel"])
+    assert kern.shape[2] == 8
+    np.testing.assert_array_equal(kern[:, :, 3:, :], 0.0)
+    out = eng._step((64, 64), 1)(
+        eng._variables, np.zeros((1, 64, 64, 3), np.uint8)
+    )
+    assert np.isfinite(np.asarray(out["scores"])).all()
+    bus.close()
+
+
 def test_engine_serves_imported_checkpoint(tmp_path):
     """import -> save_msgpack -> engine checkpoint_path: the serving plane
     actually loads converted weights (the documented recipe end to end)."""

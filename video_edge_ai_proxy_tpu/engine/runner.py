@@ -241,6 +241,9 @@ class InferenceEngine:
                 # Pre-stem_pad_c checkpoints: zero-pad the stem kernel
                 # (config-gated — never fires for the s2d stem, whose
                 # extra input planes carry real pixels).
+                raw = pad_stem_on_load(
+                    raw, unbox(self._variables), self._model
+                )
                 # Host tree for now: placement happens ONCE below (mesh
                 # sharding or single-chip put). An eager device_put here
                 # would materialize the full tree on one chip first —
@@ -279,6 +282,7 @@ class InferenceEngine:
             dp = self._mesh.shape["dp"]
             buckets = tuple(b for b in buckets if b % dp == 0) or (dp,)
             self._variables = self._place_variables(self._variables)
+            self._model = self._maybe_seq_parallel(self._model)
             log.info(
                 "engine mesh: %s (buckets -> %s)",
                 dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
@@ -327,6 +331,27 @@ class InferenceEngine:
         )
         return qt
 
+    def _maybe_seq_parallel(self, model):
+        """Long-context serving: when the mesh carries a sequence axis
+        (sp > 1), transformer-family models re-instantiate with the
+        ring-attention ``attn_fn`` so the [T, T] attention tiles shard
+        over sp instead of materializing per chip — the serving-side
+        twin of parallel.with_ring_attention (params are unchanged;
+        attn_fn is not a parameter). Conv models pass through."""
+        if self._mesh is None or self._mesh.shape.get("sp", 1) <= 1:
+            return model
+        import dataclasses
+
+        if not any(f.name == "attn_fn" for f in dataclasses.fields(model)):
+            return model
+        from ..parallel import with_ring_attention
+
+        log.info("serving with ring attention over sp=%d",
+                 self._mesh.shape["sp"])
+        return with_ring_attention(
+            type(model), model.cfg, self._mesh, dtype=model.dtype
+        )
+
     def _place_variables(self, variables):
         """Put a model's variables onto the serving mesh. With model
         axes configured (tp/fsdp/sp/ep > 1) and full-precision weights,
@@ -365,6 +390,7 @@ class InferenceEngine:
             variables = self._maybe_quantize(variables)
             if self._mesh is not None:
                 variables = self._place_variables(variables)
+                model = self._maybe_seq_parallel(model)
             entry = (spec, model, variables)
             self._models[name] = entry
             log.info("engine loaded extra model '%s' (kind=%s)", name, spec.kind)
