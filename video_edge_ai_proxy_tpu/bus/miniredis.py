@@ -400,6 +400,8 @@ class MiniRedis:
         result = _collect()
         if result or block_ms is None:
             return self._arr(result) if result else b"*-1\r\n"
+        # BLOCK 0 = "forever" in Redis; bound it to an hour so a buggy
+        # client can never wedge a test process indefinitely.
         deadline = time.monotonic() + (block_ms / 1000.0 if block_ms else 3600)
         while not result:
             remaining = deadline - time.monotonic()
