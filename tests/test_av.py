@@ -196,3 +196,33 @@ class TestEncoder:
         with av.Encoder(W, H, gop=GOP) as enc:
             assert enc.info.extradata  # SPS/PPS out-of-band for MP4/FLV
             assert enc.info.codec_name == "h264"
+
+
+def test_threaded_decode_matches_serial(tmp_path):
+    """Opt-in frame-threaded decode ("decode_threads=0" in av options,
+    for cameras whose decode exceeds one core) must produce bit-identical
+    frames to the default single-threaded decoder — threading only adds
+    decoder delay, which drain() flushes."""
+    import numpy as np
+
+    from video_edge_ai_proxy_tpu.ingest import av
+
+    path = str(tmp_path / "thr.mp4")
+    av.write_test_video(path, 160, 120, frames=24, fps=24.0, gop=8)
+
+    def decode_all(opts):
+        out = []
+        with av.PacketDemuxer(path, options=opts) as d:
+            while d.read() is not None:
+                fr = d.decode()
+                if fr is not None:
+                    out.append(fr)
+            while (fr := d.drain()) is not None:
+                out.append(fr)
+        return out
+
+    serial = decode_all("")
+    threaded = decode_all("decode_threads=0")
+    assert len(serial) == len(threaded) == 24
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a, b)

@@ -30,6 +30,7 @@ extern "C" {
 }
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 
@@ -99,6 +100,13 @@ struct Demux {
   AVCodecContext* dec = nullptr; // lazy
   AVFrame* frame = nullptr;
   SwsContext* sws = nullptr;
+  int decode_threads = 1;        // caller opt-in ("decode_threads=N"):
+                                 // frame threading for cameras whose
+                                 // decode exceeds one core (e.g. 4K).
+                                 // Default 1 = today's behavior; the
+                                 // worker already handles the added
+                                 // decoder delay (grab/retrieve split +
+                                 // frame-pts passthrough).
 };
 
 struct Mux {
@@ -151,6 +159,13 @@ int open_decoder(Demux* d) {
   int rc = avcodec_parameters_to_context(d->dec, par);
   if (rc < 0) return rc;
   d->dec->pkt_timebase = d->fmt->streams[d->vstream]->time_base;
+  if (d->decode_threads != 1) {
+    // 0 = auto (one per core). Frame+slice threading: real multi-core
+    // scaling for high-rate cameras at the cost of decoder delay, which
+    // the worker's grab/retrieve split already accounts for.
+    d->dec->thread_count = d->decode_threads;
+    d->dec->thread_type = FF_THREAD_FRAME | FF_THREAD_SLICE;
+  }
   rc = avcodec_open2(d->dec, codec, nullptr);
   if (rc < 0) return rc;
   d->frame = av_frame_alloc();
@@ -229,6 +244,27 @@ void* va_open(const char* url, int64_t timeout_us, const char* options,
       delete d;
       return nullptr;
     }
+  }
+  // "decode_threads" is OURS (decoder setup), not an AVOption: consume
+  // it before avformat sees the dict, or the unconsumed-option check
+  // would reject it as a typo.
+  if (const AVDictionaryEntry* e =
+          av_dict_get(opts, "decode_threads", nullptr, 0)) {
+    // Strict value parse to match the strict key check below: "auto"
+    // (atoi -> 0) or a negative count must fail HERE with a clear
+    // message, not silently enable per-core threading fleet-wide or
+    // surface later as a baffling decoder-init error.
+    char* endp = nullptr;
+    long n = std::strtol(e->value, &endp, 10);
+    if (endp == e->value || *endp != '\0' || n < 0 || n > 256) {
+      set_err(err, errcap,
+              "decode_threads must be an integer 0..256 (0 = auto)");
+      av_dict_free(&opts);
+      delete d;
+      return nullptr;
+    }
+    d->decode_threads = (int)n;
+    av_dict_set(&opts, "decode_threads", nullptr, 0);  // remove
   }
   int rc = avformat_open_input(&d->fmt, url, nullptr, &opts);
   if (rc < 0) {

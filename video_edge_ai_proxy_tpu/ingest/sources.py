@@ -323,4 +323,11 @@ def open_source(url: str, prefer: str = "") -> VideoSource:
 
         if not av.available():
             return OpenCVSource(url)
-    return PacketSource(url)
+    # env `vep_av_options`: extra "k=v:k=v" AVOptions for every packet
+    # source a worker opens (inherited from the server env, same channel
+    # as the reference's worker env contract). Notable key:
+    # "decode_threads=0" enables auto frame-threaded decode for cameras
+    # whose decode exceeds one core (4K/high-fps); default stays 1
+    # thread/worker (process-level parallelism, BASELINE.md capacity
+    # table).
+    return PacketSource(url, av_options=os.environ.get("vep_av_options", ""))
