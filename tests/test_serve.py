@@ -607,3 +607,27 @@ class TestInferenceEndToEnd:
         with urllib.request.urlopen(rest + "/api/v1/stats") as resp:
             stats = json.loads(resp.read())
         assert stats["engine"]["streams"]["cam1"]["frames"] >= 3
+
+        # InferenceRequest.model filter: a REGISTERED model that no
+        # stream runs yields nothing until the deadline (and ONLY a
+        # deadline — any other status is a regression)...
+        got_other = []
+        with pytest.raises(grpc.RpcError) as exc:
+            for r in stub.Inference(
+                pb.InferenceRequest(model="tiny_yolov8"), timeout=2
+            ):
+                got_other.append(r)
+        assert exc.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        assert got_other == []
+        # ...an UNKNOWN name fails fast instead of hanging forever...
+        with pytest.raises(grpc.RpcError) as exc:
+            next(iter(stub.Inference(
+                pb.InferenceRequest(model="yolov8m_typo"), timeout=5
+            )))
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # ...and the matching name streams normally.
+        for r in stub.Inference(
+            pb.InferenceRequest(model="tiny_mobilenet_v2"), timeout=60
+        ):
+            assert r.model == "tiny_mobilenet_v2"
+            break

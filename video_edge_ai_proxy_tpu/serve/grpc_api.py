@@ -212,9 +212,26 @@ class ImageServicer:
             context.abort(
                 grpc.StatusCode.UNIMPLEMENTED, "TPU engine not running"
             )
-        yield from self._engine.subscribe(
+        if request.model:
+            from ..models import registry
+
+            if request.model not in registry.names():
+                # Fail fast: a typo'd filter would otherwise hang the
+                # stream forever, indistinguishable from "no frames yet".
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"unknown model {request.model!r}; registered: "
+                    f"{registry.names()}",
+                )
+        for result in self._engine.subscribe(
             device_ids=list(request.device_ids), context=context
-        )
+        ):
+            # InferenceRequest.model: with per-stream model overrides one
+            # subscription can carry results from several models; a
+            # non-empty filter narrows to one of them (empty = no filter).
+            if request.model and result.model != request.model:
+                continue
+            yield result
 
 
 def _frame_to_proto(device_id: str, frame) -> pb.VideoFrame:
