@@ -1,0 +1,113 @@
+"""Weight pipeline end to end, fully offline: torch-layout state dict ->
+import -> serve -> mAP eval (the capability the reference delegates to
+clients who bring their own trained models, examples/opencv_display.py:19
+in the reference — here the TPU engine serves the weights itself).
+
+    python examples/import_serve_eval.py [--model tiny_yolov8]
+
+With no real checkpoint at hand this demo fabricates a random-weight
+state dict in the canonical ultralytics layout, which exercises every
+step of the real recipe:
+
+  1. models/import_weights.convert    (strict-accounted conversion)
+  2. utils/checkpoint.save_msgpack    (engine checkpoint format)
+  3. engine serving step with the imported weights
+  4. tools/eval_detector.evaluate     (COCO mAP on a self-consistent set)
+
+For real weights, replace step 0 with your exported file:
+  python tools/import_weights.py --model yolov8n --src yolov8n.pt \
+      --out /var/lib/vep/yolov8n.msgpack --validate
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fabricate_state_dict(model_name: str) -> dict:
+    """Random weights in the exact layout a real checkpoint would have:
+    reverse-map our model's template through the importer's key scheme."""
+    import jax
+
+    from video_edge_ai_proxy_tpu.models import import_weights as iw
+    from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.parallel.sharding import unbox
+
+    from flax import traverse_util
+
+    _, template = registry.get(model_name).init_params(jax.random.PRNGKey(7))
+    state = {}
+    for path, leaf in traverse_util.flatten_dict(unbox(template)).items():
+        key, transform = iw._yolo_key(tuple(path[1:]))
+        arr = np.asarray(leaf, np.float32)
+        if transform is iw._conv_kernel:
+            arr = np.transpose(arr, (3, 2, 0, 1))       # HWIO -> OIHW
+        elif transform is iw._dense_kernel:
+            arr = np.transpose(arr)
+        state[f"model.{key}"] = arr
+    return state
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny_yolov8",
+                    help="detect-kind registry model (tiny_yolov8 runs "
+                         "anywhere; yolov8n needs a few GB + minutes)")
+    args = ap.parse_args()
+
+    import jax
+
+    from tools import eval_detector
+    from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
+    from video_edge_ai_proxy_tpu.models import import_weights as iw
+    from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.utils.checkpoint import save_msgpack
+
+    print(f"[0/4] fabricating a canonical-layout state dict for {args.model}")
+    state = fabricate_state_dict(args.model)
+
+    print(f"[1/4] importing {len(state)} tensors (strict accounting)")
+    variables = iw.convert(args.model, state)
+
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="vep_import_"), "model.msgpack")
+    save_msgpack(ckpt, variables)
+    print(f"[2/4] saved engine checkpoint -> {ckpt}")
+
+    spec = registry.get(args.model)
+    step = jax.jit(build_serving_step(spec.build(), spec))
+    size = spec.input_size
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, (4, size, size, 3), np.uint8)
+    res = step(variables, images)
+    n_det = int(np.asarray(res["valid"]).sum())
+    print(f"[3/4] serving step ran: {n_det} detections over 4 frames")
+
+    # Self-consistency eval: the model's own detections as ground truth
+    # must score mAP 1.0 — proves the serve->eval plumbing end to end.
+    valid = np.asarray(res["valid"], bool)
+    scores = np.asarray(res["scores"], np.float32)
+    keep = valid & (scores >= 0.05)
+    m = keep.shape[1]
+    boxes = np.full((4, m, 4), -1, np.float32)
+    classes = np.full((4, m), -1, np.int64)
+    for i in range(4):
+        k = keep[i]
+        boxes[i, : k.sum()] = np.asarray(res["boxes"])[i][k]
+        classes[i, : k.sum()] = np.asarray(res["classes"])[i][k]
+    summary = eval_detector.evaluate(
+        args.model, ckpt, images, boxes, classes, batch=4
+    )
+    print(f"[4/4] eval: {summary}")
+    ok = summary["mAP"] > 0.99
+    print("OK — imported weights serve and evaluate consistently"
+          if ok else "MISMATCH — eval disagrees with the serving step")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,6 +135,34 @@ class TestDurability:
         q2.requeue_rejected()
         assert q2.drain_once() == 3
 
+    def test_live_peer_unacked_is_not_stolen(self, server, raw):
+        """Duplicate-delivery guard (round-3 review): a FOREIGN connection
+        with a FRESH heartbeat is mid-delivery, not dead — its unacked
+        batch must survive our startup sweep. Once the heartbeat goes
+        stale (or vanishes), the periodic cleaner recovers it."""
+        import time as _t
+
+        raw.command("LPUSH", READY, b"a", b"b")
+        peer = "rmq::connection::peerProc::queue::[annotationqueue]::unacked"
+        raw.command("RPOPLPUSH", READY, peer)
+        raw.command("SET", "rmq::connection::peerProc::heartbeat",
+                    str(int(_t.time() * 1000)))   # peer is alive NOW
+
+        q = _q(server, lambda b: True)
+        assert q.resumed == 0                     # live peer untouched
+        assert int(raw.command("LLEN", peer)) == 1
+
+        # Peer dies: heartbeat goes stale -> cleaner leg recovers.
+        raw.command("SET", "rmq::connection::peerProc::heartbeat",
+                    str(int(_t.time() * 1000) - 60_000))
+        q._last_sweep = float("-inf")             # due now (no 30 s wait)
+        q.requeue_rejected()
+        assert int(raw.command("LLEN", peer) or 0) == 0
+        delivered = []
+        q2 = _q(server, lambda b: delivered.extend(b) or True)
+        assert q2.drain_once() == 2               # b + recovered a
+        assert sorted(delivered) == [b"a", b"b"]
+
     def test_depth_counts_inherited_backlog_against_limit(self, server):
         q1 = _q(server, lambda b: True)
         for i in range(4):
@@ -156,7 +184,10 @@ class TestWireParity:
         assert int(raw.command("LLEN", READY)) == 0
         assert int(raw.command("LLEN", REJECTED)) == 1
         keys = raw.command("KEYS", "rmq::*")
-        assert sorted(k.decode() for k in keys) == [REJECTED]
+        assert sorted(k.decode() for k in keys) == [
+            "rmq::connection::vepTpu::heartbeat",   # liveness marker
+            REJECTED,
+        ]
 
     def test_foreign_rmq_producer_is_drained(self, server, raw):
         """Events LPUSHed by a reference component (rmq publish) flow

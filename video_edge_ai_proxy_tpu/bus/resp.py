@@ -142,6 +142,41 @@ class RespClient:
                         raise
             raise ConnectionError("unreachable")  # pragma: no cover
 
+    def pipeline(self, commands) -> list:
+        """Send N commands in ONE write and read N replies — one round
+        trip instead of N (the batch-drain path needs this: popping and
+        acking a 299-event batch command-by-command costs ~600 sequential
+        RTTs against a remote server). Same resync-retry-once semantics
+        as ``command``; the retry can double-apply non-idempotent
+        commands, which callers must tolerate (the annotation queue's
+        rmq semantics already do — duplicates over loss).
+
+        A server error reply mid-pipeline is returned in place as a
+        RespError INSTANCE (not raised): later replies still need
+        draining to keep the stream in sync, and callers decide per-slot
+        what an error means."""
+        if not commands:
+            return []
+        msg = b"".join(self._encode(c) for c in commands)
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    self._sock.sendall(msg)
+                    out = []
+                    for _ in commands:
+                        try:
+                            out.append(self._read_reply())
+                        except RespError as exc:
+                            out.append(exc)
+                    return out
+                except (OSError, ConnectionError):
+                    self.close()
+                    if attempt:
+                        raise
+            raise ConnectionError("unreachable")  # pragma: no cover
+
     # -- convenience --
 
     def command_str(self, *parts) -> Optional[str]:

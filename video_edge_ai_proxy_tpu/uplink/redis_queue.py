@@ -17,9 +17,15 @@ framework publishes and vice versa:
 - rejected: ``rmq::queue::[annotationqueue]::rejected``
 
 A delivery moves ready → unacked atomically (RPOPLPUSH), so there is no
-instant at which a crash loses it: at startup every unacked list for this
-queue (ANY connection — a crashed process can't clean its own) sweeps
-back to ready, which is rmq's stale-connection cleaner behavior.
+instant at which a crash loses it. Recovery is rmq's stale-connection
+cleaner, heartbeat-gated: each instance maintains
+``rmq::connection::<name>::heartbeat`` (timestamp value ≈ rmq's TTL'd
+key); at startup and periodically, unacked lists of connections whose
+heartbeat is stale or absent sweep back to ready — a LIVE peer's
+mid-delivery batch is never stolen into duplicate uploads. Our own
+connection name sweeps unconditionally at startup (we are its new
+incarnation; give each instance of a multi-consumer fleet a distinct
+``connection`` name).
 
 Counter semantics note: ``published``/``acked``/``dropped`` count THIS
 process's traffic (Prometheus counters must be monotonic per process);
@@ -62,12 +68,17 @@ class RedisAnnotationQueue(AnnotationQueue):
             addr, timeout_s, handshake=tuple(handshake)
         )
         self._qname = queue_name
+        self._conn_name = connection
         self._ready = f"rmq::queue::[{queue_name}]::ready"
         self._rejected_key = f"rmq::queue::[{queue_name}]::rejected"
         self._unacked = (
             f"rmq::connection::{connection}::queue::[{queue_name}]::unacked"
         )
+        self._hb_key = f"rmq::connection::{connection}::heartbeat"
         self._other_cached, self._other_at = 0, float("-inf")
+        self._last_beat = float("-inf")
+        self._last_sweep = time.monotonic()
+        self._beat()   # claim our connection before sweeping others
         self.resumed = self._sweep_orphans()
         if self.resumed:
             log.info(
@@ -77,11 +88,53 @@ class RedisAnnotationQueue(AnnotationQueue):
 
     # -- crash recovery --
 
+    # A connection whose heartbeat timestamp is older than this (or whose
+    # heartbeat key is gone) is considered dead and its unacked deliveries
+    # recoverable. Must comfortably exceed the consumer cycle (~300 ms).
+    _HEARTBEAT_STALE_S = 30.0
+
+    def _beat(self) -> None:
+        """Refresh this connection's liveness marker (~2 s throttle).
+        rmq uses a TTL'd heartbeat key; a TIMESTAMP value gives the same
+        observable contract (stale/absent = dead) without requiring key
+        expiry from the server. Live peers check it before sweeping our
+        unacked list (and we check theirs)."""
+        now = time.monotonic()
+        if now - self._last_beat < 2.0:
+            return
+        self._last_beat = now
+        try:
+            self._client.command(
+                "SET", self._hb_key, str(int(time.time() * 1000))
+            )
+        except (RespError, IOError) as exc:
+            log.warning("heartbeat write failed: %s", exc)
+
+    def _connection_alive(self, conn: str) -> bool:
+        try:
+            raw = self._client.command(
+                "GET", f"rmq::connection::{conn}::heartbeat"
+            )
+        except (RespError, IOError):
+            return True    # can't tell: never steal a maybe-live batch
+        if raw is None:
+            return False   # no heartbeat: dead (or pre-heartbeat rmq gone)
+        try:
+            ts = int(raw)
+        except ValueError:
+            # rmq's own heartbeat value ("1" with TTL): existence = alive.
+            return True
+        return time.time() * 1000 - ts < self._HEARTBEAT_STALE_S * 1000
+
     def _sweep_orphans(self) -> int:
-        """Unacked deliveries of ANY connection back to ready (rmq cleaner
-        parity): a crashed process left them mid-flight; re-delivering is
-        correct because the uplink POST is idempotent on the cloud side
-        (same event payload)."""
+        """Unacked deliveries of DEAD connections back to ready (rmq
+        cleaner parity — rmq likewise gates on connection heartbeats, so
+        a live peer's mid-POST batch is never stolen into duplicate
+        delivery). Our own connection name is swept unconditionally: we
+        are its new incarnation (run multi-instance fleets with distinct
+        ``connection`` names). Re-delivering a dead connection's events
+        is correct because the uplink POST is idempotent on the cloud
+        side (same event payload)."""
         n = 0
         try:
             cursor = b"0"
@@ -102,6 +155,9 @@ class RedisAnnotationQueue(AnnotationQueue):
                 if cursor in (b"0", 0, "0"):
                     break
             for key in keys:
+                conn = key.split("::")[2]   # rmq::connection::<name>::…
+                if conn != self._conn_name and self._connection_alive(conn):
+                    continue
                 # `is not None`: RESP nil ends the list; an EMPTY payload
                 # (b"", falsy) is a legal queued event and must not halt
                 # the sweep with entries still stranded.
@@ -166,13 +222,18 @@ class RedisAnnotationQueue(AnnotationQueue):
     # -- consumer side --
 
     def drain_once(self) -> int:
+        self._beat()
         batch: list[bytes] = []
         try:
-            for _ in range(self._max_batch):
-                v = self._client.command(
-                    "RPOPLPUSH", self._ready, self._unacked
-                )
-                if v is None:
+            # Pipelined pop: max_batch RPOPLPUSHes in ONE round trip
+            # (command-by-command this is 299 sequential RTTs per batch —
+            # slower than the 299/300 ms drain budget on a ~1 ms link).
+            # Extra commands past the queue tail return nil, harmlessly.
+            replies = self._client.pipeline([
+                ("RPOPLPUSH", self._ready, self._unacked)
+            ] * self._max_batch)
+            for v in replies:
+                if isinstance(v, (RespError, type(None))):
                     break
                 batch.append(v)
         except (RespError, IOError) as exc:
@@ -187,19 +248,21 @@ class RedisAnnotationQueue(AnnotationQueue):
             ok = False
         try:
             if ok:
-                for v in batch:
-                    self._client.command("LREM", self._unacked, "-1", v)
+                self._client.pipeline([
+                    ("LREM", self._unacked, "-1", v) for v in batch
+                ])
                 self.acked += len(batch)
                 return len(batch)
             self.rejected_batches += 1
+            # LPUSH before LREM per event: a crash between the two leaves
+            # a DUPLICATE (in rejected + unacked, reconciled to double
+            # delivery by the startup sweep — the uplink is idempotent),
+            # never a loss. Pipelining preserves this server-side order.
+            cmds = []
             for v in batch:
-                # LPUSH before LREM: a crash between the two leaves a
-                # DUPLICATE (in rejected + unacked, reconciled to double
-                # delivery by the startup sweep — the uplink is
-                # idempotent), never a loss. The reverse order would
-                # strand the event in no list at all.
-                self._client.command("LPUSH", self._rejected_key, v)
-                self._client.command("LREM", self._unacked, "-1", v)
+                cmds.append(("LPUSH", self._rejected_key, v))
+                cmds.append(("LREM", self._unacked, "-1", v))
+            self._client.pipeline(cmds)
         except (RespError, IOError) as exc:
             # Whatever we couldn't move stays in unacked; the startup
             # sweep of the next incarnation returns it to ready.
@@ -214,9 +277,24 @@ class RedisAnnotationQueue(AnnotationQueue):
                 pass
         except (RespError, IOError) as exc:
             log.warning("annotation requeue failed: %s", exc)
+        # Periodic cleaner leg (rmq parity): a connection that dies AFTER
+        # our boot becomes sweepable once its heartbeat goes stale.
+        now = time.monotonic()
+        if now - self._last_sweep > self._HEARTBEAT_STALE_S:
+            self._last_sweep = now
+            n = self._sweep_orphans()
+            if n:
+                log.info("cleaner recovered %d unacked annotation(s)", n)
 
     def stop(self) -> None:
         super().stop()
+        try:
+            # Clean shutdown: drop the liveness marker so a successor (or
+            # a peer's cleaner) can recover anything left immediately
+            # instead of waiting out the staleness window.
+            self._client.command("DEL", self._hb_key)
+        except Exception:
+            pass
         try:
             self._client.close()
         except Exception:
