@@ -243,6 +243,16 @@ class TestRestAux:
         assert "# TYPE vep_engine_ticks_total counter" in text
         assert "vep_annotation_queue_depth 0" in text
         assert "vep_annotation_rejected_batches_total 0" in text
+        assert "vep_subscriber_dropped_total 0" in text
+        # Tripped per-stream models surface with a model label.
+        server.engine._bad_models["brokenmodel"] = {
+            "failures": 2, "retry_at": 0.0, "error": "boom",
+        }
+        try:
+            _, body2 = self._get(server, "/metrics")
+            assert 'vep_model_disabled{model="brokenmodel"} 1' in body2.decode()
+        finally:
+            server.engine._bad_models.clear()
         # One HELP/TYPE block per metric name, even with many label sets.
         assert text.count("# TYPE vep_workers_total ") == 1
         # Families must be contiguous (text-format 0.0.4): every sample

@@ -233,6 +233,152 @@ class TestProcessManager:
         finally:
             manager2.close()
 
+    def test_worker_readoption_across_manager_restart(self, shm_dir, tmp_path):
+        """Reference parity rtsp_process_manager.go:191-233: a server
+        restart re-attaches to still-running workers — same pid, frames
+        keep flowing, no respawn."""
+        bus = open_bus("shm", shm_dir)
+        storage = Storage(str(tmp_path / "reg.db"))
+        log_dir = str(tmp_path / "wlogs")
+        m1 = ProcessManager(storage, bus, shm_dir=shm_dir, log_dir=log_dir)
+        try:
+            m1.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+            bus.touch_query("cam1")
+            assert wait_for(lambda: bus.read_latest("cam1") is not None)
+            pid1 = m1.info("cam1").state.pid
+            rec = m1.info("cam1")
+            assert rec.runtime and rec.runtime["pid"] == pid1
+            assert rec.runtime["starttime"]
+            # Control-plane restart: detach (workers keep running).
+            m1.detach()
+            assert os.path.exists(f"/proc/{pid1}")
+            m2 = ProcessManager(storage, bus, shm_dir=shm_dir, log_dir=log_dir)
+            try:
+                assert m2.resume() == 1
+                info = m2.info("cam1")
+                assert info.state.running and info.state.pid == pid1  # ADOPTED
+                # Frames keep flowing through the restart: a publish NEWER
+                # than adoption time arrives.
+                t_adopt = int(time.time() * 1000)
+                bus.touch_query("cam1")
+                assert wait_for(
+                    lambda: (f := bus.read_latest("cam1")) is not None
+                    and f.meta.timestamp_ms >= t_adopt
+                )
+                # Adopted log tail follows the file the worker still owns.
+                assert wait_for(
+                    lambda: m2.info("cam1").logs is not None
+                    and m2.info("cam1").logs["total"] > 0
+                )
+                # stop() through the adopted handle really kills it.
+                m2.stop("cam1")
+                assert wait_for(
+                    lambda: not os.path.exists(f"/proc/{pid1}")
+                    or open(f"/proc/{pid1}/stat").read().split(") ")[1][0] == "Z"
+                )
+            finally:
+                m2.close()
+        finally:
+            m1.close()
+            bus.close()
+            storage.close()
+
+    def test_readoption_contract_mismatch_respawns(self, shm_dir, tmp_path):
+        """A live worker whose env contract no longer matches the persisted
+        record is killed and respawned (kill only on mismatch)."""
+        import json as _json
+
+        from video_edge_ai_proxy_tpu.serve.models import PREFIX_RTSP_PROCESS
+
+        bus = open_bus("shm", shm_dir)
+        storage = Storage(str(tmp_path / "reg.db"))
+        log_dir = str(tmp_path / "wlogs")
+        m1 = ProcessManager(storage, bus, shm_dir=shm_dir, log_dir=log_dir)
+        try:
+            m1.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+            pid1 = m1.info("cam1").state.pid
+            m1.detach()
+            # Operator edited the record while the server was down.
+            raw = _json.loads(storage.get(PREFIX_RTSP_PROCESS, "cam1"))
+            raw["rtsp_endpoint"] = synth_url(frames=99999)
+            storage.put(PREFIX_RTSP_PROCESS, "cam1",
+                        _json.dumps(raw).encode())
+            m2 = ProcessManager(storage, bus, shm_dir=shm_dir, log_dir=log_dir)
+            try:
+                assert m2.resume() == 1
+                pid2 = m2.info("cam1").state.pid
+                assert pid2 != pid1  # respawned under the new contract
+                assert wait_for(
+                    lambda: not os.path.exists(f"/proc/{pid1}")
+                    or open(f"/proc/{pid1}/stat").read().split(") ")[1][0] == "Z"
+                )
+            finally:
+                m2.close()
+        finally:
+            m1.close()
+            bus.close()
+            storage.close()
+
+    def test_adoption_disabled_restart_kills_orphan(self, shm_dir, tmp_path):
+        """worker_adoption turned OFF between restarts: the surviving
+        worker must be killed before the respawn, or two publishers would
+        fight over one ring."""
+        bus = open_bus("shm", shm_dir)
+        storage = Storage(str(tmp_path / "reg.db"))
+        log_dir = str(tmp_path / "wlogs")
+        m1 = ProcessManager(storage, bus, shm_dir=shm_dir, log_dir=log_dir)
+        try:
+            m1.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+            pid1 = m1.info("cam1").state.pid
+            m1.detach()
+            assert os.path.exists(f"/proc/{pid1}")
+            m2 = ProcessManager(storage, bus, shm_dir=shm_dir)  # no log_dir
+            try:
+                assert m2.resume() == 1
+                pid2 = m2.info("cam1").state.pid
+                assert pid2 != pid1
+                assert wait_for(
+                    lambda: not os.path.exists(f"/proc/{pid1}")
+                    or open(f"/proc/{pid1}/stat").read().split(") ")[1][0] == "Z"
+                )
+            finally:
+                m2.close()
+        finally:
+            m1.close()
+            bus.close()
+            storage.close()
+
+    def test_dead_worker_resume_respawns(self, shm_dir, tmp_path):
+        """Adoption only claims LIVE processes: a worker that died while the
+        server was down is respawned, and a reused-looking pid with the
+        wrong birth cookie is never touched."""
+        import signal as _signal
+
+        bus = open_bus("shm", shm_dir)
+        storage = Storage(str(tmp_path / "reg.db"))
+        log_dir = str(tmp_path / "wlogs")
+        m1 = ProcessManager(storage, bus, shm_dir=shm_dir, log_dir=log_dir)
+        try:
+            m1.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+            pid1 = m1.info("cam1").state.pid
+            m1.detach()
+            os.kill(pid1, _signal.SIGKILL)
+            try:
+                os.waitpid(pid1, 0)  # reap so /proc entry clears
+            except ChildProcessError:
+                pass
+            m2 = ProcessManager(storage, bus, shm_dir=shm_dir, log_dir=log_dir)
+            try:
+                assert m2.resume() == 1
+                assert wait_for(lambda: m2.info("cam1").state.running)
+                assert m2.info("cam1").state.pid != pid1
+            finally:
+                m2.close()
+        finally:
+            m1.close()
+            bus.close()
+            storage.close()
+
     def test_info_includes_log_tail(self, pm):
         manager, bus, _ = pm
         manager.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
@@ -250,9 +396,15 @@ def _boot_server(tmp_path, shm_dir, **cfg_overrides):
     cfg = Config()
     cfg.bus.shm_dir = shm_dir
     cfg.annotation.endpoint = "http://127.0.0.1:1/annotate"  # fail fast, no egress
+    # Tests default adoption OFF so a stopped server never leaks synthetic
+    # workers; the adoption tests turn it on and clean up explicitly.
+    cfg.worker_adoption = False
     for key, value in cfg_overrides.items():
         section, _, field = key.partition("__")
-        setattr(getattr(cfg, section), field, value)
+        if field:
+            setattr(getattr(cfg, section), field, value)
+        else:
+            setattr(cfg, section, value)
     srv = Server(cfg, data_dir=str(tmp_path), grpc_port=0, rest_port=0)
     srv.start()
     return srv
@@ -263,6 +415,35 @@ def server(tmp_path, shm_dir):
     srv = _boot_server(tmp_path, shm_dir)
     yield srv
     srv.stop()
+
+
+def test_server_restart_keeps_frames_flowing(tmp_path, shm_dir):
+    """Full-server restart with worker_adoption on (the default config):
+    stop() detaches, the next boot re-adopts, frames never stop
+    (reference rtsp_process_manager.go:191-233 availability parity)."""
+    srv = _boot_server(tmp_path, shm_dir, worker_adoption=True)
+    srv.process_manager.start(
+        StreamProcess(name="cam1", rtsp_endpoint=synth_url())
+    )
+    srv.bus.touch_query("cam1")
+    assert wait_for(lambda: srv.bus.read_latest("cam1") is not None)
+    pid1 = srv.process_manager.info("cam1").state.pid
+    srv.stop()  # detaches: worker must still be alive
+    assert os.path.exists(f"/proc/{pid1}")
+    srv2 = _boot_server(tmp_path, shm_dir, worker_adoption=True)
+    try:
+        assert srv2.process_manager.info("cam1").state.pid == pid1
+        t_adopt = int(time.time() * 1000)
+        srv2.bus.touch_query("cam1")
+        assert wait_for(
+            lambda: (f := srv2.bus.read_latest("cam1")) is not None
+            and f.meta.timestamp_ms >= t_adopt
+        )
+    finally:
+        # Kill workers before stopping or the detach path would leak the
+        # synthetic worker past the test.
+        srv2.process_manager.shutdown_workers()
+        srv2.stop()
 
 
 def test_storage_toggle_signed_put(tmp_path, shm_dir):

@@ -101,6 +101,95 @@ class TestCron:
         with pytest.raises(ValueError):
             parse_duration("whenever")
 
+    def test_parse_schedule_duration_and_cron(self):
+        """Reference robfig/cron parity (cron_jobs.go:39-49): a migrating
+        config may carry a duration OR any cron expression; both parse."""
+        from video_edge_ai_proxy_tpu.serve.cron import (
+            CronSpec, EverySchedule, parse_schedule,
+        )
+
+        assert isinstance(parse_schedule("5m"), EverySchedule)
+        assert isinstance(parse_schedule("@every 1h"), EverySchedule)
+        assert isinstance(parse_schedule("0 3 * * *"), CronSpec)
+        assert isinstance(parse_schedule("@daily"), CronSpec)
+        with pytest.raises(ValueError):
+            parse_schedule("whenever")
+        with pytest.raises(ValueError):
+            parse_schedule("61 3 * * *")  # minute out of range
+        # Quartz-style '?' (robfig/cron accepts it in dom/dow).
+        assert isinstance(parse_schedule("0 3 * * ?"), CronSpec)
+        # Parseable-but-unsatisfiable (Feb 31): must fail at PARSE time
+        # (boot), not kill the scheduler thread on first next_after.
+        with pytest.raises(ValueError):
+            parse_schedule("0 0 31 2 *")
+
+    def test_cron_next_after(self):
+        from datetime import datetime, timezone
+
+        from video_edge_ai_proxy_tpu.serve.cron import CronSpec
+
+        def ts(*args):
+            return datetime(*args, tzinfo=timezone.utc).timestamp()
+
+        # "0 3 * * *" from 01:30 -> 03:00 same day; from 03:00 -> next day.
+        daily = CronSpec("0 3 * * *")
+        assert daily.next_after(ts(2026, 7, 31, 1, 30)) == ts(2026, 7, 31, 3, 0)
+        assert daily.next_after(ts(2026, 7, 31, 3, 0)) == ts(2026, 8, 1, 3, 0)
+        # Steps: every 15 minutes.
+        q = CronSpec("*/15 * * * *")
+        assert q.next_after(ts(2026, 7, 31, 1, 7)) == ts(2026, 7, 31, 1, 15)
+        assert q.next_after(ts(2026, 7, 31, 1, 45)) == ts(2026, 7, 31, 2, 0)
+        # Weekday names: Friday 2026-07-31 -> next Monday 2026-08-03.
+        mon = CronSpec("30 9 * * mon")
+        assert mon.next_after(ts(2026, 7, 31, 12, 0)) == ts(2026, 8, 3, 9, 30)
+        # Month names + dom; year rollover.
+        jan = CronSpec("0 0 1 jan *")
+        assert jan.next_after(ts(2026, 7, 31, 0, 0)) == ts(2027, 1, 1, 0, 0)
+        # Standard-cron quirk: dom AND dow both restricted -> either matches.
+        either = CronSpec("0 0 15 * sun")
+        # 2026-08-15 is a Saturday; first Sunday after Jul 31 is Aug 2.
+        assert either.next_after(ts(2026, 7, 31, 0, 0)) == ts(2026, 8, 2, 0, 0)
+        # Ranges and lists.
+        rl = CronSpec("0 8-10,18 * * *")
+        assert rl.next_after(ts(2026, 7, 31, 9, 30)) == ts(2026, 7, 31, 10, 0)
+        assert rl.next_after(ts(2026, 7, 31, 11, 0)) == ts(2026, 7, 31, 18, 0)
+        # Feb 29 exists within the 4-year search horizon (2028).
+        leap = CronSpec("0 0 29 feb *")
+        assert leap.next_after(ts(2026, 7, 31, 0, 0)) == ts(2028, 2, 29, 0, 0)
+
+    def test_cron_jobs_fire_on_cron_spec(self, tmp_path):
+        """CronJobs accepts a 5-field spec end-to-end (the migration shape
+        the reference README documents, README.md:296)."""
+        import os
+        import time as _time
+        from types import SimpleNamespace
+
+        from video_edge_ai_proxy_tpu.serve.cron import CronJobs
+
+        old = tmp_path / "0_1.mp4"
+        old.write_bytes(b"x")
+        os.utime(old, (_time.time() - 9000, _time.time() - 9000))
+        cfg = SimpleNamespace(
+            on_disk=True,
+            # Every minute of every hour: fires at the next minute boundary.
+            on_disk_schedule="* * * * *",
+            on_disk_clean_older_than="1h",
+            on_disk_folder=str(tmp_path),
+        )
+        jobs = CronJobs(cfg)
+        # Don't wait up to 60 s for a real boundary: verify the thread is
+        # wired by checking the computed delay, then fire the body directly.
+        from video_edge_ai_proxy_tpu.serve.cron import (
+            cleanup_archive, parse_schedule,
+        )
+
+        sched = parse_schedule(cfg.on_disk_schedule)
+        assert 0 < sched.next_after(_time.time()) - _time.time() <= 60
+        jobs.start()
+        assert jobs._thread is not None and jobs._thread.is_alive()
+        jobs.stop()
+        assert cleanup_archive(cfg.on_disk_folder, 3600) == 1
+
     def test_cleanup_archive(self, tmp_path):
         import os
         import time

@@ -131,6 +131,12 @@ class InferenceEngine:
     # gap, far shorter than "stream is really gone" timescales.
     _TRACKER_GC_GRACE_S = 10.0
 
+    # Per-stream model failure breaker: first retry after this long,
+    # doubling per consecutive failure up to the cap. Class attributes so
+    # tests can shrink them without monkeypatching module globals.
+    BAD_MODEL_BACKOFF_S = 30.0
+    BAD_MODEL_BACKOFF_MAX_S = 600.0
+
     def __init__(
         self,
         bus: FrameBus,
@@ -154,7 +160,13 @@ class InferenceEngine:
         self._model_resolver = model_resolver
         self._ann_policy_resolver = annotation_policy_resolver
         self._models: Dict[str, tuple] = {}
-        self._bad_models: set = set()
+        # Per-model failure circuit breaker: name -> {"failures", "retry_at"
+        # (monotonic), "error"}. Entries half-open after an exponential
+        # backoff so a transient init failure (OOM during a contention
+        # spike) does not disable the model until process restart; a model
+        # that keeps failing backs off harder instead of starving every
+        # healthy stream with multi-second re-init attempts per tick.
+        self._bad_models: Dict[str, dict] = {}
         self._step_cache: Dict[tuple, Any] = {}
         self._collector: Optional[Collector] = None
         self._subscribers: List[tuple] = []   # (queue, device_id filter set|None)
@@ -174,6 +186,12 @@ class InferenceEngine:
         self._ann_state: Dict[str, dict] = {}
         self._ann_policy_warned: set = set()  # (device_id, bad policy)
         self.annotations_suppressed = 0
+        # Results dropped on slow subscribers (queue full in _publish):
+        # total + per-stream, surfaced in /metrics and /api/v1/stats so a
+        # client that cannot keep up is visible, not silently starved
+        # (annotation suppression already has this treatment).
+        self.subscriber_drops = 0
+        self.subscriber_drops_by_stream: Dict[str, int] = {}
         self._probe_cache: tuple = (0.0, None)   # (monotonic, ok | None)
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_spawn_lock = threading.Lock()
@@ -408,21 +426,38 @@ class InferenceEngine:
             return "none", 0
         if not name or name == self._spec.name:
             return None
-        if name in self._bad_models:
+        bad = self._bad_models.get(name)
+        if bad is not None and time.monotonic() < bad["retry_at"]:
             return None
         try:
             spec, _, _ = self._ensure_model(name)
-        except Exception:
+        except Exception as exc:
             # Unknown name OR a model that fails to build (OOM, bug): either
             # way confine the damage to this stream's model choice — a
             # per-tick re-attempt of a failing multi-second init would
-            # starve every healthy stream.
-            log.exception(
-                "stream %s model '%s' unavailable; using default",
-                device_id, name,
+            # starve every healthy stream. The breaker half-opens after an
+            # exponential backoff (next attempt is the probe) rather than
+            # disabling the model until restart.
+            failures = (bad["failures"] if bad else 0) + 1
+            backoff = min(
+                self.BAD_MODEL_BACKOFF_S * (2 ** (failures - 1)),
+                self.BAD_MODEL_BACKOFF_MAX_S,
             )
-            self._bad_models.add(name)
+            self._bad_models[name] = {
+                "failures": failures,
+                "retry_at": time.monotonic() + backoff,
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+            log.exception(
+                "stream %s model '%s' unavailable (failure %d); using "
+                "default, retrying in %.0fs",
+                device_id, name, failures, backoff,
+            )
             return None
+        if bad is not None:
+            self._bad_models.pop(name, None)
+            log.info("model '%s' recovered after %d failure(s)",
+                     name, bad["failures"])
         return name, spec.clip_len
 
     # -- profiling (SURVEY.md §5.1: the reference has no tracing at all) --
@@ -627,7 +662,20 @@ class InferenceEngine:
             ok = False
         stale_after = self._cfg.health_stale_after_s
         stale = age is not None and age > stale_after
+        # Per-stream models currently tripped by the failure breaker:
+        # operators see WHY a stream silently serves the default model and
+        # when the next half-open retry is due. Informational — does not
+        # flip `healthy` (the default model still serves every stream).
+        disabled = {
+            name: {
+                "failures": bad["failures"],
+                "retry_in_s": round(max(0.0, bad["retry_at"] - now), 1),
+                "error": bad["error"],
+            }
+            for name, bad in list(self._bad_models.items())
+        }
         return {
+            "disabled_models": disabled,
             "healthy": bool(alive and ok and not stale),
             "engine_thread_alive": alive,
             "tick_age_s": round(age, 3) if age is not None else None,
@@ -866,7 +914,12 @@ class InferenceEngine:
             try:
                 q.put_nowait(result)
             except queue.Full:
-                pass  # slow subscriber: latest-wins spirit, drop
+                # Slow subscriber: latest-wins spirit, drop — but count it
+                # (engine thread is the only writer; plain increments).
+                self.subscriber_drops += 1
+                self.subscriber_drops_by_stream[result.device_id] = (
+                    self.subscriber_drops_by_stream.get(result.device_id, 0) + 1
+                )
 
     def _annotate(
         self, device_id: str, meta: FrameMeta, detections: Sequence[pb.Detection],

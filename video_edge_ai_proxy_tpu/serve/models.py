@@ -75,6 +75,12 @@ class StreamProcess:
     # field, so it never reaches storage even when an info()-derived
     # record is passed to update_record.
     heartbeat: Optional[dict] = None
+    # PERSISTED live-worker descriptor for re-adoption across server
+    # restarts (reference re-attaches to still-running containers on boot,
+    # ``rtsp_process_manager.go:191-233``): {"pid", "starttime" (the
+    # /proc/<pid>/stat birth tick — guards against pid reuse), "log_path"}.
+    # Filled by the spawn path when adoption is enabled; None otherwise.
+    runtime: Optional[dict] = None
 
     def to_json(self) -> bytes:
         def drop_none(obj: Any) -> Any:
@@ -104,6 +110,7 @@ class StreamProcess:
             inference_model=data.get("inference_model", ""),
             annotation_policy=data.get("annotation_policy", ""),
             limits=data.get("limits"),
+            runtime=data.get("runtime"),
         )
 
     @staticmethod

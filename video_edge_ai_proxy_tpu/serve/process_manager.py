@@ -16,10 +16,16 @@ not core (SURVEY.md §7) — with the same lifecycle semantics:
 - ``stop``: terminate + deregister + drop the bus ring (``:153-188``).
 - ``info``: merge the persisted record with live state and the last N stdout
   lines (``:283-335`` pulls the last 100 container log lines).
-- registry resume: on boot, persisted cameras are re-spawned (the reference
-  re-attaches to still-running containers; workers are not containerized here
-  so resume = restart, same observable registry behavior,
-  ``rtsp_process_manager.go:191-233``).
+- registry resume with RE-ADOPTION: on boot, a persisted camera whose worker
+  process is still alive (verified by pid + /proc birth-tick cookie + cmdline
+  + env contract) is re-attached, not respawned — camera pipelines survive a
+  control-plane restart exactly like the reference's containers do
+  (``rtsp_process_manager.go:191-233``). A live worker whose env contract no
+  longer matches the record is killed and respawned; anything else at that
+  pid is someone else's process and is left alone. Adoption requires
+  ``log_dir`` (file-backed worker logs + no parent-death signal); with
+  ``log_dir=""`` workers pipe to the server and die with it (resume =
+  respawn, the pre-adoption behavior).
 """
 
 from __future__ import annotations
@@ -92,9 +98,14 @@ except ImportError:  # non-POSIX; preexec is linux-gated at the call site
 
 
 def _worker_preexec(mem_limit_mb: int = WORKER_MEM_LIMIT_MB,
-                    nice: int = WORKER_NICE) -> None:
-    """Runs between fork and exec (no locks, no imports, no allocation)."""
-    _pdeathsig()
+                    nice: int = WORKER_NICE,
+                    pdeathsig: bool = True) -> None:
+    """Runs between fork and exec (no locks, no imports, no allocation).
+    ``pdeathsig=False`` when adoption is enabled: workers must survive a
+    server restart to be re-adopted (the reference gets this from dockerd
+    owning the container lifecycle)."""
+    if pdeathsig:
+        _pdeathsig()
     if mem_limit_mb > 0 and _resource is not None:
         lim = mem_limit_mb << 20
         _resource.setrlimit(_resource.RLIMIT_AS, (lim, lim))
@@ -102,29 +113,118 @@ def _worker_preexec(mem_limit_mb: int = WORKER_MEM_LIMIT_MB,
         os.nice(nice)
 
 
+def _proc_starttime(pid: int) -> Optional[int]:
+    """The process's birth tick from ``/proc/<pid>/stat`` field 22 — a
+    cookie that distinguishes "this exact process" from a reused pid."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as fh:
+            stat = fh.read().decode("ascii", "replace")
+    except OSError:
+        return None
+    # comm (field 2) may contain spaces/parens; fields resume after the
+    # LAST ')'. starttime is field 22 overall = index 19 after comm+state.
+    rest = stat.rsplit(")", 1)[-1].split()
+    try:
+        # rest[0] is state (field 3); field N maps to rest[N-3], so
+        # starttime (field 22) is rest[19].
+        return int(rest[19])
+    except (IndexError, ValueError):
+        return None
+
+
+def _proc_state(pid: int) -> str:
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as fh:
+            stat = fh.read().decode("ascii", "replace")
+        return stat.rsplit(")", 1)[-1].split()[0]
+    except OSError:
+        return ""
+
+
+# Sentinel exit code for adopted workers that died while not our child:
+# the real status was reaped by init, so only "exited" is knowable. > 255
+# so it can never collide with a genuine wait status or -signal.
+ADOPTED_EXIT_UNKNOWN = 256
+
+
+class _AdoptedProc:
+    """Popen-shaped handle over a worker we did not spawn (re-adopted after
+    a server restart). poll() prefers ``waitpid`` (exact status when the
+    worker happens to be our child — same-process adoption) and falls back
+    to /proc liveness gated on the birth-tick cookie."""
+
+    def __init__(self, pid: int, starttime: Optional[int]):
+        self.pid = pid
+        self._starttime = starttime
+        self._code: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._code is not None:
+            return self._code
+        try:
+            wpid, status = os.waitpid(self.pid, os.WNOHANG)
+            if wpid == self.pid:
+                self._code = (
+                    -os.WTERMSIG(status) if os.WIFSIGNALED(status)
+                    else os.WEXITSTATUS(status)
+                )
+                return self._code
+        except ChildProcessError:
+            pass  # not our child: /proc is the only source of truth
+        except OSError:
+            pass
+        st = _proc_state(self.pid)
+        alive = st not in ("", "Z", "X") and (
+            self._starttime is None
+            or _proc_starttime(self.pid) == self._starttime
+        )
+        if alive:
+            return None
+        self._code = ADOPTED_EXIT_UNKNOWN
+        return self._code
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def _signal(self, sig: int) -> None:
+        if self.poll() is not None:
+            return
+        try:
+            os.kill(self.pid, sig)
+        except ProcessLookupError:
+            pass
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = time.monotonic() + (timeout if timeout is not None else 3600)
+        while time.monotonic() < deadline:
+            code = self.poll()
+            if code is not None:
+                return code
+            time.sleep(0.05)
+        raise subprocess.TimeoutExpired(f"adopted:{self.pid}", timeout or 0)
+
+
 class ProcessError(RuntimeError):
     pass
 
 
-class _Tail:
-    """Capture a worker's stdout into a bounded deque (reference: Docker
-    json-file logs capped at 3x3 MB, ``rtsp_process_manager.go:71-74``)."""
+class _TailBase:
+    """Bounded in-memory log ring with a monotone live-follow cursor
+    (reference: Docker json-file logs capped at 3x3 MB,
+    ``rtsp_process_manager.go:71-74``). Subclasses provide the pump."""
 
-    def __init__(self, proc: subprocess.Popen, maxlen: int = 2000):
+    def __init__(self, maxlen: int = 2000):
         self.lines: collections.deque[str] = collections.deque(maxlen=maxlen)
         self.total = 0  # lines ever pumped (monotone; live-follow cursor)
         self._lock = threading.Lock()
-        self._thread = threading.Thread(
-            target=self._pump, args=(proc,), daemon=True
-        )
-        self._thread.start()
 
-    def _pump(self, proc: subprocess.Popen) -> None:
-        assert proc.stdout is not None
-        for line in proc.stdout:
-            with self._lock:
-                self.lines.append(line.rstrip("\n"))
-                self.total += 1
+    def _append(self, line: str) -> None:
+        with self._lock:
+            self.lines.append(line.rstrip("\n"))
+            self.total += 1
 
     def since(self, cursor: int) -> tuple[int, list[str]]:
         """(total, lines appended after ``cursor``). A cursor from before a
@@ -144,6 +244,93 @@ class _Tail:
         mutates the deque, so iterating it unlocked can raise."""
         with self._lock:
             return self.total, list(self.lines)[-n:]
+
+    def close(self) -> None:
+        pass
+
+
+class _Tail(_TailBase):
+    """Tail over the worker's stdout PIPE (non-adoption mode); ends with
+    the process, so close() is a no-op."""
+
+    def __init__(self, proc: subprocess.Popen, maxlen: int = 2000):
+        super().__init__(maxlen)
+        self._thread = threading.Thread(
+            target=self._pump, args=(proc,), daemon=True
+        )
+        self._thread.start()
+
+    def _pump(self, proc: subprocess.Popen) -> None:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            self._append(line)
+
+
+# File-log cap: copytruncate when the log grows past this (the reference
+# caps container logs at json-file 3 files x 3 MB,
+# ``rtsp_process_manager.go:71-74``; one 9 MB budget, same bound).
+LOG_MAX_BYTES = 9 << 20
+
+
+class _FileTail(_TailBase):
+    """Tail over a log FILE (adoption mode): the worker appends with its
+    own fd, so the tail survives — and can be re-created after — a server
+    restart. Preloads the ring from the existing file, then follows."""
+
+    def __init__(self, path: str, maxlen: int = 2000):
+        super().__init__(maxlen)
+        self._path = path
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._follow, name="worker-logtail", daemon=True
+        )
+        self._thread.start()
+
+    def _follow(self) -> None:
+        fh = None
+        try:
+            while not self._closed.is_set():
+                if fh is None:
+                    try:
+                        fh = open(self._path, "rb")  # binary: tell() is a
+                        # byte offset, so partial-line rewind is exact
+                    except OSError:
+                        if self._closed.wait(0.2):
+                            return
+                        continue
+                line = fh.readline()
+                if line:
+                    if line.endswith(b"\n"):
+                        self._append(line.decode("utf-8", "replace"))
+                    else:
+                        # Partial write mid-line: wait for the rest.
+                        fh.seek(fh.tell() - len(line))
+                        self._closed.wait(0.05)
+                    continue
+                # EOF: rotate if oversized, detect truncation, then idle.
+                try:
+                    size = os.path.getsize(self._path)
+                    if size > LOG_MAX_BYTES:
+                        # copytruncate: O_APPEND writers land at offset 0
+                        # after this; the ring already holds the recent
+                        # lines, so nothing user-visible is lost.
+                        with open(self._path, "r+b") as tf:
+                            tf.truncate(0)
+                        size = 0
+                    if fh.tell() > size:
+                        fh.close()
+                        fh = None  # truncated under us: reopen from 0
+                        continue
+                except OSError:
+                    pass
+                if self._closed.wait(0.1):
+                    return
+        finally:
+            if fh is not None:
+                fh.close()
+
+    def close(self) -> None:
+        self._closed.set()
 
 
 class _Entry:
@@ -174,10 +361,17 @@ class ProcessManager:
         redis_db: int = 0,
         mem_limit_mb: int = WORKER_MEM_LIMIT_MB,
         nice: int = WORKER_NICE,
+        log_dir: str = "",
     ):
         self._storage = storage
         self._bus = bus
         self._shm_dir = shm_dir
+        # Adoption mode: workers log to files under log_dir and skip the
+        # parent-death signal, so they outlive the server and resume() can
+        # re-attach to them ("" = pipe logs, workers die with the server).
+        self._log_dir = log_dir
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
         self._bus_backend = bus_backend
         self._redis_addr = redis_addr
         self._redis_password = redis_password
@@ -260,20 +454,45 @@ class ProcessManager:
             vep_redis_db=str(self._redis_db),
             PYTHONUNBUFFERED="1",
         )
-        proc = subprocess.Popen(
-            [self._python, "-m", "video_edge_ai_proxy_tpu.ingest.worker"],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            preexec_fn=(
-                (lambda: _worker_preexec(self._mem_limit_mb, self._nice))
-                if sys.platform == "linux" else None
-            ),
-        )
+        if entry.tail is not None:
+            entry.tail.close()  # replacing a previous run's follower
+        argv = [self._python, "-m", "video_edge_ai_proxy_tpu.ingest.worker"]
+        if self._log_dir:
+            # Adoption mode: file-backed logs (the worker owns its fd, so
+            # logging survives server death — a broken stdout pipe would
+            # otherwise SIGPIPE the orphan) and no pdeathsig.
+            log_path = os.path.join(self._log_dir, f"{record.name}.log")
+            with open(log_path, "ab") as log_fh:
+                proc = subprocess.Popen(
+                    argv, env=env,
+                    stdout=log_fh, stderr=subprocess.STDOUT,
+                    preexec_fn=(
+                        (lambda: _worker_preexec(
+                            self._mem_limit_mb, self._nice, pdeathsig=False))
+                        if sys.platform == "linux" else None
+                    ),
+                )
+            entry.tail = _FileTail(log_path)
+            record.runtime = {
+                "pid": proc.pid,
+                "starttime": _proc_starttime(proc.pid),
+                "log_path": log_path,
+            }
+        else:
+            proc = subprocess.Popen(
+                argv, env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                preexec_fn=(
+                    (lambda: _worker_preexec(self._mem_limit_mb, self._nice))
+                    if sys.platform == "linux" else None
+                ),
+            )
+            entry.tail = _Tail(proc)
+            record.runtime = None
         entry.proc = proc
         entry.last_spawn = time.monotonic()
-        entry.tail = _Tail(proc)
         record.container_id = f"{proc.pid}@{os.uname().nodename}"
 
     def inference_model_of(self, device_id: str) -> str:
@@ -314,6 +533,15 @@ class ProcessManager:
                     except subprocess.TimeoutExpired:
                         entry.proc.kill()
                         entry.proc.wait(timeout=5)
+                if entry.tail is not None:
+                    entry.tail.close()
+            if self._log_dir:
+                # Deregistered camera leaves no log behind (reference Stop
+                # deletes the container and with it its json-file logs).
+                try:
+                    os.unlink(os.path.join(self._log_dir, f"{device_id}.log"))
+                except OSError:
+                    pass
             self._storage.delete(PREFIX_RTSP_PROCESS, device_id)
             self._bus.drop_stream(device_id)
             self._bus.kv_del(KEY_STATUS_PREFIX + device_id)
@@ -449,8 +677,12 @@ class ProcessManager:
         self._storage.put(PREFIX_RTSP_PROCESS, clean.name, clean.to_json())
 
     def resume(self) -> int:
-        """Re-spawn all persisted cameras (boot-time registry resume,
-        reference rtsp_process_manager.go:191-233)."""
+        """Boot-time registry resume (reference
+        rtsp_process_manager.go:191-233): re-ADOPT each persisted camera
+        whose worker is still alive and matches the record's env contract
+        (frames never stop flowing across a control-plane restart); kill +
+        respawn a live worker whose contract no longer matches; respawn
+        when the worker is gone or the pid now belongs to someone else."""
         count = 0
         for device_id, raw in self._storage.list(PREFIX_RTSP_PROCESS).items():
             with self._lock:
@@ -462,6 +694,10 @@ class ProcessManager:
             entry.inference_model = record.inference_model
             entry.annotation_policy = record.annotation_policy
             try:
+                if self._try_adopt(device_id, record, entry):
+                    self._persist(record)
+                    count += 1
+                    continue
                 self._spawn(record, entry)
                 self._persist(record)
                 count += 1
@@ -470,6 +706,92 @@ class ProcessManager:
                 with self._lock:
                     self._entries.pop(device_id, None)
         return count
+
+    def _identify_worker(self, pid: int, starttime,
+                         device_id: str) -> Optional[dict]:
+        """The environ of the process at ``pid`` IF it is provably this
+        camera's surviving worker: birth-tick cookie matches (no pid
+        reuse), cmdline is our worker module, env device_id is this
+        camera. None otherwise — a pid that now belongs to anything else
+        must never be touched."""
+        if _proc_state(pid) in ("", "Z", "X"):
+            return None
+        if starttime is not None and _proc_starttime(pid) != starttime:
+            return None
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                cmdline = fh.read().split(b"\0")
+            with open(f"/proc/{pid}/environ", "rb") as fh:
+                environ = dict(
+                    pair.split(b"=", 1)
+                    for pair in fh.read().split(b"\0") if b"=" in pair
+                )
+        except OSError:
+            return None
+        if b"video_edge_ai_proxy_tpu.ingest.worker" not in cmdline:
+            return None
+        if environ.get(b"device_id", b"").decode() != device_id:
+            return None
+        return environ
+
+    def _try_adopt(self, device_id: str, record: StreamProcess,
+                   entry: _Entry) -> bool:
+        """Attach to a still-running worker from a previous server life.
+        True only when the persisted pid is provably the SAME process
+        (birth-tick cookie + cmdline + device_id) and its FULL env
+        contract — media endpoints AND bus/buffer wiring — matches what
+        _spawn would set today. Any verified-ours-but-stale worker (env
+        drift, or adoption now disabled) is killed first so the respawn is
+        the only publisher on the ring; an unverifiable pid is left alone."""
+        rt = record.runtime
+        if not rt or not rt.get("pid"):
+            return False
+        pid = int(rt["pid"])
+        environ = self._identify_worker(pid, rt.get("starttime"), device_id)
+        if environ is None:
+            return False
+        # The full contract _spawn would set NOW (reference env contract +
+        # bus/buffer wiring): a worker frozen on an old shm_dir or Redis
+        # would be adopted "live" yet publish where the new server never
+        # looks — every checked key must match current config.
+        want = {
+            "rtsp_endpoint": record.rtsp_endpoint,
+            "rtmp_endpoint": record.rtmp_endpoint or "",
+            "disk_buffer_path": self._disk_buffer_path,
+            "vep_shm_dir": self._shm_dir,
+            "vep_bus_backend": (
+                "shm" if self._bus_backend == "memory" else self._bus_backend
+            ),
+            "vep_redis_addr": self._redis_addr,
+            "vep_redis_db": str(self._redis_db),
+        }
+        same_contract = self._log_dir and all(
+            environ.get(k.encode(), b"").decode() == v
+            for k, v in want.items()
+        )
+        proc = _AdoptedProc(pid, rt.get("starttime"))
+        if not same_contract:
+            # Our worker, wrong config (record/config changed while we were
+            # down, or adoption was turned off): kill it — leaving it would
+            # put two publishers on one ring once we respawn.
+            log.warning(
+                "worker %s (pid %d) env contract stale; killing for respawn",
+                device_id, pid,
+            )
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            return False
+        entry.proc = proc
+        entry.last_spawn = time.monotonic()
+        entry.tail = _FileTail(
+            rt.get("log_path")
+            or os.path.join(self._log_dir, f"{device_id}.log"),
+        )
+        log.info("re-adopted live worker %s (pid %d)", device_id, pid)
+        return True
 
     # -- supervision (RestartPolicy: always) --
 
@@ -533,6 +855,22 @@ class ProcessManager:
         self._supervisor.join(timeout=15)
         self.shutdown_workers()
 
+    def detach(self) -> None:
+        """Stop supervising WITHOUT killing workers: the adoption-mode
+        shutdown (reference parity — its server shutdown leaves camera
+        containers running under dockerd; the next boot re-attaches,
+        rtsp_process_manager.go:191-233). Workers keep demuxing/publishing;
+        resume() on the next boot adopts them via the persisted runtime
+        descriptor."""
+        self._stop.set()
+        self._supervisor.join(timeout=15)
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for entry in entries:
+            if entry.tail is not None:
+                entry.tail.close()
+
     def shutdown_workers(self) -> None:
         """Terminate workers without deregistering (server shutdown keeps the
         registry so ``resume()`` restores cameras on next boot)."""
@@ -549,3 +887,5 @@ class ProcessManager:
                     entry.proc.wait(timeout=5)
                 except subprocess.TimeoutExpired:
                     entry.proc.kill()
+            if entry.tail is not None:
+                entry.tail.close()

@@ -164,6 +164,7 @@ def build_app(
                 "model": engine._spec.name if engine._spec else None,
                 "ticks": engine.ticks,
                 "batches": engine.batches,
+                "subscriber_drops": engine.subscriber_drops,
                 "streams": {
                     did: dataclasses.asdict(st)
                     for did, st in engine.stats().items()
@@ -193,9 +194,15 @@ def build_app(
             return (str(v).replace("\\", "\\\\").replace('"', '\\"')
                     .replace("\n", "\\n"))
 
-        def emit(name, value, help_text, kind="gauge", device_id=None):
+        def emit(name, value, help_text, kind="gauge", device_id=None,
+                 model=None):
             fam = families.setdefault(name, (help_text, kind, []))
-            labels = f'{{device_id="{esc(device_id)}"}}' if device_id else ""
+            pairs = []
+            if device_id:
+                pairs.append(f'device_id="{esc(device_id)}"')
+            if model:
+                pairs.append(f'model="{esc(model)}"')
+            labels = "{" + ",".join(pairs) + "}" if pairs else ""
             fam[2].append(f"{name}{labels} {value}")
 
         procs = await asyncio.to_thread(pm.list)
@@ -218,6 +225,17 @@ def build_app(
                      device_id=did)
                 emit("vep_stream_latency_ms", round(st.ema_latency_ms, 3),
                      "EMA end-to-end latency per stream (ms)", device_id=did)
+            emit("vep_subscriber_dropped_total", engine.subscriber_drops,
+                 "Inference results dropped on slow subscribers",
+                 kind="counter")
+            for did, n in dict(engine.subscriber_drops_by_stream).items():
+                emit("vep_stream_subscriber_dropped_total", n,
+                     "Results dropped on slow subscribers per stream",
+                     kind="counter", device_id=did)
+            for name in list(engine._bad_models):
+                emit("vep_model_disabled", 1,
+                     "Per-stream models tripped by the failure breaker "
+                     "(value 1 while disabled)", model=name)
         if annotations is not None:
             emit("vep_annotation_queue_depth", annotations.depth(),
                  "Annotation uplink queue depth")

@@ -66,6 +66,12 @@ class Server:
             redis_addr=self.cfg.bus.redis_addr,
             redis_password=self.cfg.bus.redis_password,
             redis_db=self.cfg.bus.redis_db,
+            # Adoption mode: camera pipelines survive a control-plane
+            # restart (workers log to files, resume() re-attaches).
+            log_dir=(
+                os.path.join(data_dir, "worker_logs")
+                if self.cfg.worker_adoption else ""
+            ),
         )
         ann_kwargs = dict(
             handler=make_batch_handler(
@@ -178,7 +184,13 @@ class Server:
         self.cron.stop()
         # Keep the registry: cameras resume on next boot (reference behavior —
         # BadgerDB registry survives restart, rtsp_process_manager.go:191-233).
-        self.process_manager.close()
+        # Adoption mode detaches — workers keep demuxing through the restart
+        # and the next boot re-adopts them (the reference's containers keep
+        # running under dockerd the same way).
+        if self.cfg.worker_adoption:
+            self.process_manager.detach()
+        else:
+            self.process_manager.close()
         self.bus.close()
         self.storage.close()
         self._stopped.set()

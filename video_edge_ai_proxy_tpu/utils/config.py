@@ -144,6 +144,13 @@ class Config:
     mode: str = "release"
     port: int = 8080
     grpc_port: int = 50001
+    # Worker re-adoption across server restarts (reference parity: camera
+    # containers keep running under dockerd through a control-plane restart
+    # and are re-attached on boot, rtsp_process_manager.go:191-233). True:
+    # workers log to <data_dir>/worker_logs, survive server death, and
+    # resume() re-adopts them; false: workers pipe to the server, die with
+    # it, resume = respawn.
+    worker_adoption: bool = True
     bus: BusConfig = field(default_factory=BusConfig)
     annotation: AnnotationConfig = field(default_factory=AnnotationConfig)
     api: ApiConfig = field(default_factory=ApiConfig)
