@@ -1,0 +1,339 @@
+"""Hard-isolation worker runner: one container per camera.
+
+The reference's ONLY runner is Docker (``server/services/rtsp_process_manager
+.go:70-115``): per-camera HostConfig with json-file logs capped 3 files x
+3 MB (``:71-74``), ``RestartPolicy: always`` (``:76``), CPUShares 1024
+(``:78``), optional archive bind-mount (``:80-88``), the env contract
+(``:96-104``), create+start over the Docker socket (``:106-115``), and boot
+re-attachment to still-running containers (``:191-233``). The subprocess
+runner (process_manager.py) is this framework's default — Docker is an ops
+choice, not core (SURVEY.md §7.5) — and THIS module is the optional hard
+half: cgroup-enforced CPU weight and memory limits, kernel OOM kills, and
+runtime-owned log rotation, driven through the ``docker``/``podman`` CLI
+(feature-equivalent to the reference's socket client, no SDK dependency).
+
+Divergences, deliberate:
+- ``--network host`` + a bind-mount of the shm bus dir instead of the
+  reference's ``chrysnet`` bridge: our fast path is the shared-memory ring
+  (bus/shm_bus.py), which needs a shared filesystem, and the Redis backend
+  rides loopback. A bridge network would force the Redis backend only.
+- Restart supervision stays with the runtime (``--restart always``), so the
+  server's supervisor only *observes* container state (streak accounting
+  comes from the runtime's RestartCount) instead of respawning.
+
+Tests drive a fake CLI (``exec_fn`` injection); a skip-gated test runs the
+real binary when one exists on the host.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import subprocess
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils.logging import get_logger
+from .process_manager import _TailBase
+
+log = get_logger("serve.container")
+
+# Reference HostConfig constants (rtsp_process_manager.go:71-78).
+LOG_MAX_SIZE = "3m"
+LOG_MAX_FILE = "3"
+CPU_SHARES = 1024
+
+CONTAINER_PREFIX = "vep_"
+
+ExecFn = Callable[[list[str]], tuple[int, str]]
+
+
+class RuntimeUnavailable(RuntimeError):
+    """The container runtime itself did not answer (daemon down, CLI
+    timeout) — distinct from 'this container does not exist'. Callers keep
+    last-known state instead of tearing anything down."""
+
+
+def _default_exec(args: list[str], timeout: float = 60.0) -> tuple[int, str]:
+    proc = subprocess.run(
+        args, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=timeout,
+    )
+    return proc.returncode, proc.stdout
+
+
+def _default_stream(args: list[str]) -> subprocess.Popen:
+    return subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+class ContainerCLI:
+    """Thin wrapper over the docker/podman CLI. All state queries go
+    through ``inspect``, so docker and podman both work."""
+
+    def __init__(self, binary: str = "docker",
+                 exec_fn: Optional[ExecFn] = None,
+                 stream_fn: Optional[Callable] = None):
+        self.binary = binary
+        self._exec = exec_fn or _default_exec
+        self._stream = stream_fn or _default_stream
+
+    def run(self, args: list[str]) -> tuple[int, str]:
+        try:
+            return self._exec([self.binary] + args)
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            # A wedged daemon / missing binary must surface as a
+            # distinguishable rc, never as an exception out of poll paths.
+            return 125, f"cli error: {exc}"
+
+    def stream(self, args: list[str]):
+        """Popen-like handle (``.stdout`` line-iterable, ``.terminate()``)
+        for long-lived commands (``logs --follow``)."""
+        return self._stream([self.binary] + args)
+
+    def available(self) -> bool:
+        rc, _ = self.run(["version", "--format", "{{.Client.Version}}"])
+        return rc == 0
+
+    def inspect(self, name: str) -> Optional[dict]:
+        """Parsed ``inspect`` JSON for one container; None when the
+        container does not exist; RuntimeUnavailable when the RUNTIME did
+        not answer (daemon blip ≠ container gone — conflating the two
+        would make the supervisor tear down healthy containers)."""
+        rc, out = self.run(["inspect", name])
+        if rc != 0:
+            if "no such" in out.lower():
+                return None
+            raise RuntimeUnavailable(out.strip()[:200])
+        try:
+            data = json.loads(out)
+        except ValueError:
+            raise RuntimeUnavailable(f"unparseable inspect output: {out[:120]}")
+        return data[0] if data else None
+
+
+class ContainerHandle:
+    """Popen-shaped handle over a container (the shape process_manager's
+    supervisor and stop path expect: poll/terminate/kill/wait/pid)."""
+
+    _POLL_CACHE_S = 0.5  # inspect is a CLI roundtrip; debounce supervisor polls
+
+    def __init__(self, cli: ContainerCLI, name: str):
+        self.cli = cli
+        self.name = name
+        self.pid = 0                   # refreshed from inspect
+        self.oom_killed = False
+        self.restart_count = 0
+        self._cached: tuple[float, Optional[int]] = (0.0, None)
+        self._lock = threading.Lock()
+
+    def poll(self) -> Optional[int]:
+        """None while the runtime keeps the container alive (including its
+        own restart cycles — ``--restart always`` means a dying worker is
+        the RUNTIME's to revive); the exit code once it is gone/stopped.
+        A daemon blip (RuntimeUnavailable) keeps the LAST-KNOWN answer: a
+        healthy container must not read as exited — the supervisor would
+        rm -f + respawn it — just because dockerd restarted."""
+        with self._lock:
+            ts, code = self._cached
+            if time.monotonic() - ts < self._POLL_CACHE_S:
+                return code
+            try:
+                info = self.cli.inspect(self.name)
+            except RuntimeUnavailable as exc:
+                log.warning("container runtime unreachable polling %s: %s",
+                            self.name, exc)
+                self._cached = (time.monotonic(), code)
+                return code
+            if info is None:
+                code = 0  # removed out from under us
+            else:
+                state = info.get("State", {})
+                self.oom_killed = bool(state.get("OOMKilled"))
+                self.pid = int(state.get("Pid") or 0)
+                self.restart_count = int(info.get("RestartCount") or 0)
+                if state.get("Running") or state.get("Restarting"):
+                    code = None
+                else:
+                    code = int(state.get("ExitCode") or 0)
+            self._cached = (time.monotonic(), code)
+            return code
+
+    def _invalidate(self) -> None:
+        with self._lock:
+            self._cached = (0.0, None)
+
+    def terminate(self) -> None:
+        self.cli.run(["stop", "-t", "10", self.name])
+        self._invalidate()
+
+    def kill(self) -> None:
+        self.cli.run(["kill", self.name])
+        self._invalidate()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = time.monotonic() + (timeout if timeout is not None else 3600)
+        while time.monotonic() < deadline:
+            code = self.poll()
+            if code is not None:
+                return code
+            time.sleep(0.1)
+        raise subprocess.TimeoutExpired(f"container:{self.name}", timeout or 0)
+
+
+class ContainerTail(_TailBase):
+    """Log tail over one long-lived ``<cli> logs --follow --tail N``
+    stream, pumped line-by-line into the shared ring (same machinery as
+    the subprocess runner's tails; the reference serves the last 100
+    json-file log lines the same way, ``rtsp_process_manager.go:296``).
+    One child process per camera for its whole life — not a CLI exec per
+    poll — and the monotone ``total`` comes from _TailBase, so a full
+    window can never freeze the cursor."""
+
+    def __init__(self, cli: ContainerCLI, name: str, maxlen: int = 2000):
+        super().__init__(maxlen)
+        self._proc = cli.stream(
+            ["logs", "--follow", "--tail", str(maxlen), name]
+        )
+        self._thread = threading.Thread(
+            target=self._pump, name="container-logtail", daemon=True
+        )
+        self._thread.start()
+
+    def _pump(self) -> None:
+        out = self._proc.stdout
+        if out is None:
+            return
+        try:
+            for line in out:
+                self._append(line)
+        except ValueError:
+            pass  # stream closed under us
+
+    def close(self) -> None:
+        try:
+            self._proc.terminate()
+        except Exception:
+            pass
+
+
+class ContainerLauncher:
+    """Spawn/adopt/remove camera workers as containers. Plugged into
+    ProcessManager via ``runner: container`` — the lifecycle/registry/
+    supervision logic stays in one place; only the process mechanics and
+    the isolation vocabulary change (reference HostConfig parity,
+    ``rtsp_process_manager.go:70-115``)."""
+
+    def __init__(
+        self,
+        image: str,
+        binary: str = "docker",
+        *,
+        memory_mb: int = 2048,
+        cpu_shares: int = CPU_SHARES,
+        network: str = "host",
+        mounts: tuple = (),            # host dirs bind-mounted rw (shm, archive)
+        worker_cmd: str = "python -m video_edge_ai_proxy_tpu.ingest.worker",
+        exec_fn: Optional[ExecFn] = None,
+        stream_fn: Optional[Callable] = None,
+    ):
+        self.cli = ContainerCLI(binary, exec_fn, stream_fn)
+        self.image = image
+        self.memory_mb = memory_mb
+        self.cpu_shares = cpu_shares
+        self.network = network
+        self.mounts = tuple(mounts)
+        self.worker_cmd = worker_cmd
+
+    def name_of(self, device_id: str) -> str:
+        return CONTAINER_PREFIX + device_id
+
+    # Env keys forwarded into the container: the reference's worker
+    # contract (rtsp_process_manager.go:96-104) + this framework's bus
+    # wiring. The server's own environment (PATH, PYTHONPATH, JAX vars)
+    # stays host-side.
+    ENV_KEYS = (
+        "rtsp_endpoint", "device_id", "rtmp_endpoint", "in_memory_buffer",
+        "disk_buffer_path", "vep_shm_dir", "vep_bus_backend",
+        "vep_redis_addr", "vep_redis_password", "vep_redis_db",
+        "PYTHONUNBUFFERED", "vep_max_frames",
+    )
+
+    def spawn(self, device_id: str, env: dict) -> tuple[ContainerHandle,
+                                                        ContainerTail, dict]:
+        """``docker run -d`` with the reference HostConfig vocabulary.
+        Returns (handle, tail, runtime descriptor for the registry)."""
+        name = self.name_of(device_id)
+        # Prune any stale same-name container first (reference Start prunes
+        # before create, rtsp_process_manager.go:63-69).
+        self.cli.run(["rm", "-f", name])
+        args = [
+            "run", "-d", "--name", name,
+            "--restart", "always",                       # :76
+            "--cpu-shares", str(self.cpu_shares),        # :78
+            "--memory", f"{self.memory_mb}m",
+            "--log-driver", "json-file",                 # :71-74
+            "--log-opt", f"max-size={LOG_MAX_SIZE}",
+            "--log-opt", f"max-file={LOG_MAX_FILE}",
+            "--network", self.network,
+        ]
+        for host_dir in self.mounts:
+            if host_dir:
+                args += ["-v", f"{host_dir}:{host_dir}"]
+        for key in self.ENV_KEYS:
+            if key in env:
+                args += ["-e", f"{key}={env[key]}"]
+        args += [self.image] + shlex.split(self.worker_cmd)
+        rc, out = self.cli.run(args)
+        if rc != 0:
+            raise RuntimeError(
+                f"container spawn for {device_id} failed (rc={rc}): "
+                f"{out.strip()[:500]}"
+            )
+        handle = ContainerHandle(self.cli, name)
+        handle.poll()  # prime pid/state
+        tail = ContainerTail(self.cli, name)
+        return handle, tail, {
+            "container": name,
+            "container_id": out.strip().splitlines()[-1][:12] if out.strip() else "",
+        }
+
+    def adopt(self, device_id: str, want_env: dict) -> Optional[
+            tuple[ContainerHandle, ContainerTail]]:
+        """Re-attach to a still-running container on boot (reference
+        ``:191-233``). Same contract check as the subprocess runner: every
+        env key we would set now must match what the container runs with;
+        drift → remove it (respawn is the caller's job); absent/stopped →
+        None (the runtime's restart policy notwithstanding, a stopped
+        container at boot means `docker stop` happened — respawn)."""
+        name = self.name_of(device_id)
+        info = self.cli.inspect(name)
+        if info is None:
+            return None
+        state = info.get("State", {})
+        if not (state.get("Running") or state.get("Restarting")):
+            self.cli.run(["rm", "-f", name])
+            return None
+        have = {}
+        for pair in (info.get("Config", {}).get("Env") or []):
+            k, _, v = pair.partition("=")
+            have[k] = v
+        for key in self.ENV_KEYS:
+            if key in want_env and have.get(key, "") != str(want_env[key]):
+                log.warning(
+                    "container %s env %s drifted (%r != %r); removing for "
+                    "respawn", name, key, have.get(key, ""), want_env[key],
+                )
+                self.cli.run(["rm", "-f", name])
+                return None
+        handle = ContainerHandle(self.cli, name)
+        handle.poll()
+        log.info("re-adopted container %s for %s", name, device_id)
+        return handle, ContainerTail(self.cli, name)
+
+    def remove(self, device_id: str) -> None:
+        """Stop + delete (reference Stop: stop, remove, prune,
+        ``rtsp_process_manager.go:153-188``)."""
+        self.cli.run(["rm", "-f", self.name_of(device_id)])

@@ -362,10 +362,16 @@ class ProcessManager:
         mem_limit_mb: int = WORKER_MEM_LIMIT_MB,
         nice: int = WORKER_NICE,
         log_dir: str = "",
+        launcher=None,  # serve.container.ContainerLauncher | None
     ):
         self._storage = storage
         self._bus = bus
         self._shm_dir = shm_dir
+        # Hard-isolation runner (``runner: container`` config): spawn/adopt/
+        # remove delegate to the launcher; lifecycle/registry/supervision
+        # logic is unchanged (SURVEY.md §7.5 "subprocess first, Docker
+        # optional"; reference HostConfig parity in serve/container.py).
+        self._launcher = launcher
         # Adoption mode: workers log to files under log_dir and skip the
         # parent-death signal, so they outlive the server and resume() can
         # re-attach to them ("" = pipe logs, workers die with the server).
@@ -425,19 +431,12 @@ class ProcessManager:
         log.info("started camera process %s (%s)", device_id, record.rtsp_endpoint)
         return record
 
-    def _spawn(self, record: StreamProcess, entry: _Entry) -> None:
-        env = dict(os.environ)
-        # Ensure the worker can import this package regardless of cwd.
-        pkg_parent = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        )
-        env["PYTHONPATH"] = (
-            pkg_parent + os.pathsep + env["PYTHONPATH"]
-            if env.get("PYTHONPATH")
-            else pkg_parent
-        )
-        # Reference env contract (rtsp_process_manager.go:96-104).
-        env.update(
+    def _contract_env(self, record: StreamProcess) -> dict:
+        """The worker's env contract (reference
+        rtsp_process_manager.go:96-104 + this framework's bus wiring) —
+        shared by the subprocess spawn, the container launcher, and the
+        adoption contract check."""
+        return dict(
             rtsp_endpoint=record.rtsp_endpoint,
             device_id=record.name,
             rtmp_endpoint=record.rtmp_endpoint or "",
@@ -454,6 +453,32 @@ class ProcessManager:
             vep_redis_db=str(self._redis_db),
             PYTHONUNBUFFERED="1",
         )
+
+    def _spawn(self, record: StreamProcess, entry: _Entry) -> None:
+        if self._launcher is not None:
+            if entry.tail is not None:
+                entry.tail.close()
+            env = self._contract_env(record)
+            if "vep_max_frames" in os.environ:  # test lever rides along
+                env["vep_max_frames"] = os.environ["vep_max_frames"]
+            handle, tail, rt = self._launcher.spawn(record.name, env)
+            entry.proc = handle
+            entry.tail = tail
+            entry.last_spawn = time.monotonic()
+            record.runtime = rt
+            record.container_id = rt.get("container_id", "")
+            return
+        env = dict(os.environ)
+        # Ensure the worker can import this package regardless of cwd.
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_parent
+        )
+        env.update(self._contract_env(record))
         if entry.tail is not None:
             entry.tail.close()  # replacing a previous run's follower
         argv = [self._python, "-m", "video_edge_ai_proxy_tpu.ingest.worker"]
@@ -535,6 +560,10 @@ class ProcessManager:
                         entry.proc.wait(timeout=5)
                 if entry.tail is not None:
                     entry.tail.close()
+            if self._launcher is not None:
+                # stop+delete+prune (reference Stop,
+                # rtsp_process_manager.go:153-188).
+                self._launcher.remove(device_id)
             if self._log_dir:
                 # Deregistered camera leaves no log behind (reference Stop
                 # deletes the container and with it its json-file logs).
@@ -637,18 +666,25 @@ class ProcessManager:
         if entry is None or entry.proc is None:
             return ProcessState(status="exited", running=False, dead=True)
         code = entry.proc.poll()
+        # Container runner: restart supervision lives in the runtime, so
+        # the streak is its RestartCount and OOM is its OOMKilled flag
+        # (exactly the fields the reference reads, grpc_api.go:102-117).
+        runtime_streak = getattr(entry.proc, "restart_count", 0)
+        runtime_oom = getattr(entry.proc, "oom_killed", False)
         if code is None:
             return ProcessState(
                 status="restarting" if entry.restarting else "running",
                 running=True,
                 pid=entry.proc.pid,
                 restarting=entry.restarting,
-                failing_streak=entry.failing_streak,
+                failing_streak=max(entry.failing_streak, runtime_streak),
                 # Sticky across the restart (the reference surfaces Docker's
                 # OOMKilled the same way): the PREVIOUS run's SIGKILL exit
                 # stays visible so ListStreams health shows why the streak
                 # is climbing, not just that it is.
-                oom_killed=(entry.last_exit == -signal.SIGKILL),
+                oom_killed=(
+                    entry.last_exit == -signal.SIGKILL or runtime_oom
+                ),
             )
         return ProcessState(
             status="restarting" if entry.desired else "exited",
@@ -656,13 +692,14 @@ class ProcessManager:
             pid=entry.proc.pid,
             exit_code=code,
             restarting=entry.desired,
-            failing_streak=entry.failing_streak,
+            failing_streak=max(entry.failing_streak, runtime_streak),
             # SIGKILL exit is the kernel OOM killer's signature for a
             # subprocess runner (the reference reads Docker's OOMKilled flag,
             # ``grpc_api.go:102-117``; without a cgroup supervisor, -9 is
             # the best-available heuristic and can also mean a manual
             # kill -9 — surfaced identically in ListStreams either way).
-            oom_killed=(code == -signal.SIGKILL),
+            # Container runner: the runtime's real OOMKilled flag.
+            oom_killed=(code == -signal.SIGKILL or runtime_oom),
         )
 
     # -- persistence / resume --
@@ -743,6 +780,15 @@ class ProcessManager:
         _spawn would set today. Any verified-ours-but-stale worker (env
         drift, or adoption now disabled) is killed first so the respawn is
         the only publisher on the ring; an unverifiable pid is left alone."""
+        if self._launcher is not None:
+            adopted = self._launcher.adopt(
+                device_id, self._contract_env(record)
+            )
+            if adopted is None:
+                return False
+            entry.proc, entry.tail = adopted
+            entry.last_spawn = time.monotonic()
+            return True
         rt = record.runtime
         if not rt or not rt.get("pid"):
             return False
@@ -754,17 +800,7 @@ class ProcessManager:
         # bus/buffer wiring): a worker frozen on an old shm_dir or Redis
         # would be adopted "live" yet publish where the new server never
         # looks — every checked key must match current config.
-        want = {
-            "rtsp_endpoint": record.rtsp_endpoint,
-            "rtmp_endpoint": record.rtmp_endpoint or "",
-            "disk_buffer_path": self._disk_buffer_path,
-            "vep_shm_dir": self._shm_dir,
-            "vep_bus_backend": (
-                "shm" if self._bus_backend == "memory" else self._bus_backend
-            ),
-            "vep_redis_addr": self._redis_addr,
-            "vep_redis_db": str(self._redis_db),
-        }
+        want = self._contract_env(record)
         same_contract = self._log_dir and all(
             environ.get(k.encode(), b"").decode() == v
             for k, v in want.items()
@@ -809,7 +845,15 @@ class ProcessManager:
                 proc = entry.proc
                 if proc is None or not entry.desired:
                     continue
-                code = proc.poll()
+                try:
+                    code = proc.poll()
+                except Exception:
+                    # poll() can shell out for container handles; an
+                    # unexpected failure there must not kill the supervisor
+                    # thread for every camera. Treat as "state unknown,
+                    # assume alive" until the next cycle answers.
+                    log.exception("supervisor poll for %s failed", device_id)
+                    continue
                 if code is None:
                     if (
                         entry.failing_streak

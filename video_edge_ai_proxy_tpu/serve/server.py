@@ -55,6 +55,30 @@ class Server:
             self.cfg.bus.redis_db,
         )
         self.settings = SettingsManager(self.storage)
+        launcher = None
+        if self.cfg.runner.kind == "container":
+            # Hard-isolation runner (reference HostConfig parity,
+            # rtsp_process_manager.go:70-115): cgroup CPU/memory limits,
+            # runtime log rotation + restart policy.
+            from .container import ContainerLauncher
+
+            launcher = ContainerLauncher(
+                self.cfg.runner.image,
+                self.cfg.runner.binary,
+                memory_mb=self.cfg.runner.memory_mb,
+                cpu_shares=self.cfg.runner.cpu_shares,
+                network=self.cfg.runner.network,
+                mounts=(
+                    self.cfg.bus.shm_dir,
+                    self.cfg.buffer.on_disk_folder
+                    if self.cfg.buffer.on_disk else "",
+                ),
+            )
+        elif self.cfg.runner.kind != "subprocess":
+            raise ValueError(
+                f"runner.kind={self.cfg.runner.kind!r} unknown "
+                "(subprocess | container)"
+            )
         self.process_manager = ProcessManager(
             self.storage,
             self.bus,
@@ -70,8 +94,9 @@ class Server:
             # restart (workers log to files, resume() re-attaches).
             log_dir=(
                 os.path.join(data_dir, "worker_logs")
-                if self.cfg.worker_adoption else ""
+                if self.cfg.worker_adoption and launcher is None else ""
             ),
+            launcher=launcher,
         )
         ann_kwargs = dict(
             handler=make_batch_handler(

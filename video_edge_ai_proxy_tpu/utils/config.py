@@ -137,6 +137,23 @@ class EngineConfig:
 
 
 @dataclass
+class RunnerConfig:
+    """Worker isolation runner (SURVEY.md §7.5 "subprocess first, Docker
+    optional"). "subprocess": RLIMIT_AS + niceness containment (default).
+    "container": one container per camera via the docker/podman CLI with
+    the reference's HostConfig vocabulary — cgroup CPU weight, kernel
+    memory limits, runtime log rotation, restart-always
+    (rtsp_process_manager.go:70-115; serve/container.py)."""
+
+    kind: str = "subprocess"     # subprocess | container
+    image: str = "vep-tpu-worker"  # worker image (container kind)
+    binary: str = "docker"       # docker | podman
+    memory_mb: int = 2048        # cgroup memory limit per camera
+    cpu_shares: int = 1024       # reference CPUShares parity (:78)
+    network: str = "host"        # host: shm bus + loopback Redis work
+
+
+@dataclass
 class Config:
     version: str = "0.1.0"
     title: str = "video-edge-ai-proxy-tpu"
@@ -152,6 +169,7 @@ class Config:
     # it, resume = respawn.
     worker_adoption: bool = True
     bus: BusConfig = field(default_factory=BusConfig)
+    runner: RunnerConfig = field(default_factory=RunnerConfig)
     annotation: AnnotationConfig = field(default_factory=AnnotationConfig)
     api: ApiConfig = field(default_factory=ApiConfig)
     buffer: BufferConfig = field(default_factory=BufferConfig)
