@@ -135,10 +135,19 @@ def assign(
     align = (cls_score ** ALPHA) * (jnp.maximum(ious, 0) ** BETA)
     align = jnp.where(valid, align, 0.0)
 
-    # top-k anchors per GT (dense mask, no gathers)
+    # top-k anchors per GT (dense mask, no gathers). The floor is the
+    # k-th value itself, RELATIVE, never an absolute epsilon: at random
+    # init align = cls^0.5 * iou^6 can sit at 1e-10 for small objects,
+    # and an absolute cut (the old `max(kth, 1e-9)`) rejected every real
+    # candidate — zero positives forever, so the only gradient left was
+    # background suppression and the cls head collapsed to -inf (observed:
+    # fg=0 from step 0, logits at -1e10 by step 30). With kth == 0 (< k
+    # positive-align anchors exist) every align > 0 anchor is admitted —
+    # more than k, but they are the only real candidates and the per-
+    # anchor conflict resolution below keeps the best GT per anchor.
     k = min(TOP_K, a)
     kth = jnp.sort(align, axis=-1)[..., -k][..., None]     # [B, M, 1]
-    topk = (align >= jnp.maximum(kth, EPS)) & (align > 0)
+    topk = (align >= kth) & (align > 0)
 
     # conflicts: anchor claimed by the GT with max align
     align_masked = jnp.where(topk, align, 0.0)
@@ -167,10 +176,18 @@ def detection_loss(
     """
     box_logits, cls_logits, anchors, strides = flatten_levels(head_out, cfg)
     pred_boxes = _decode_dfl(box_logits, anchors, strides, cfg.reg_max)
+    # The assigner is a TARGET BUILDER, not part of the differentiable
+    # objective (ultralytics runs it under no_grad). Detaching matters
+    # numerically, not just semantically: align = cls^0.5 * iou^6 spans
+    # ~1e-40..1, and grad paths like d/db (a / max(b, EPS)) = -a/b^2
+    # overflow to inf for tiny aligns, NaN-ing the whole step — observed
+    # on the first self-train runs.
     fg, gt_idx, weight = assign(
-        cls_logits, pred_boxes, anchors,
+        jax.lax.stop_gradient(cls_logits),
+        jax.lax.stop_gradient(pred_boxes), anchors,
         targets["boxes"], targets["labels"], targets["mask"],
     )
+    weight = jax.lax.stop_gradient(weight)
 
     b, a, c = cls_logits.shape
     t_boxes = jnp.take_along_axis(
@@ -218,12 +235,26 @@ def optax_bce(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def make_detection_loss_fn(cfg: YOLOv8Config):
+def make_detection_loss_fn(cfg: YOLOv8Config, update_stats: bool = False):
     """Adapter for parallel.make_trainer: loss_fn(model, params, aux,
-    batch, targets) with targets as the padded dict above. BatchNorm runs
-    with frozen statistics (train=False) — the standard fine-tune stance,
-    and what keeps the step purely functional."""
+    batch, targets) with targets as the padded dict above.
+
+    ``update_stats=False`` (default): BatchNorm runs with frozen
+    statistics (train=False) — the near-distribution fine-tune stance
+    for imported pretrained checkpoints, and what keeps the step purely
+    functional. ``update_stats=True``: BatchNorm normalizes by batch
+    statistics and the loss_fn returns ``(loss, new_aux)`` for
+    ``make_trainer(..., mutable_aux=True)`` — REQUIRED from scratch;
+    frozen random-init stats degenerate deep features into constants
+    (see make_trainer's docstring)."""
     def loss_fn(model, params, aux, batch, targets):
+        if update_stats:
+            head_out, mutated = model.apply(
+                {"params": params, **(aux or {})}, batch, train=True,
+                decode=False, mutable=["batch_stats"],
+            )
+            new_aux = {**(aux or {}), **mutated}
+            return detection_loss(head_out, targets, cfg), new_aux
         head_out = model.apply(
             {"params": params, **(aux or {})}, batch, train=False, decode=False
         )

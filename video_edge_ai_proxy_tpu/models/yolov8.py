@@ -147,6 +147,8 @@ class DetectHead(nn.Module):
 
     @nn.compact
     def __call__(self, feats, train: bool = False):
+        import math
+
         c = self.cfg
         c_box = max(16, self.level_ch[0] // 4, c.reg_max * 4)
         c_cls = max(self.level_ch[0], min(c.num_classes, 100))
@@ -154,12 +156,33 @@ class DetectHead(nn.Module):
         for i, f in enumerate(feats):
             box = ConvBN(c_box, kernel=3, dtype=self.dtype, name=f"box{i}_cv1")(f, train)
             box = ConvBN(c_box, kernel=3, dtype=self.dtype, name=f"box{i}_cv2")(box, train)
-            box = nn.Conv(4 * c.reg_max, (1, 1), dtype=jnp.float32, name=f"box{i}_out")(
+            # DFL bin prior: decay the bias over distance bins so the
+            # initial expected ltrb distance is ~1.5 strides instead of
+            # the uniform-softmax 7.5. Random-init boxes then start near
+            # object scale, so first-assignment IoUs (the TAL target
+            # weights) are O(0.1) rather than O(0.001) — without this,
+            # from-scratch fine-tunes spend hundreds of steps in a
+            # background-suppression-only regime before any positive
+            # signal emerges. Imported checkpoints overwrite it.
+            dfl_prior = jnp.tile(
+                -0.5 * jnp.arange(c.reg_max, dtype=jnp.float32), 4)
+            box = nn.Conv(4 * c.reg_max, (1, 1), dtype=jnp.float32, name=f"box{i}_out",
+                          bias_init=lambda *_a, v=dfl_prior: v)(
                 box.astype(jnp.float32)
             )
+            # Prior bias (the ultralytics Detect.bias_init scheme): start
+            # class probabilities at roughly 5 objects per 640-px image
+            # per level instead of sigmoid(0)=0.5 on every anchor. From
+            # scratch, a zero bias makes the initial loss almost entirely
+            # background BCE — the fastest descent direction is "push all
+            # logits down", which outruns the positives and collapses the
+            # head (see detect_loss.assign's relative-floor note).
+            # Imported checkpoints overwrite these values.
+            prior = math.log(5 / c.num_classes / (640 / c.strides[i]) ** 2)
             cls = ConvBN(c_cls, kernel=3, dtype=self.dtype, name=f"cls{i}_cv1")(f, train)
             cls = ConvBN(c_cls, kernel=3, dtype=self.dtype, name=f"cls{i}_cv2")(cls, train)
-            cls = nn.Conv(c.num_classes, (1, 1), dtype=jnp.float32, name=f"cls{i}_out")(
+            cls = nn.Conv(c.num_classes, (1, 1), dtype=jnp.float32, name=f"cls{i}_out",
+                          bias_init=nn.initializers.constant(prior))(
                 cls.astype(jnp.float32)
             )
             outs.append((box, cls))

@@ -50,6 +50,15 @@ class Trainer:
         variables = jax.jit(functools.partial(self.model.init, train=False))(
             rng, example
         )
+        return self.init_state_from(variables)
+
+    def init_state_from(self, variables: Any) -> TrainState:
+        """TrainState from restored variables (``{"params": ..., aux
+        collections...}`` — the unboxed msgpack format `utils.checkpoint`
+        writes and the engine serves). This is the fine-tune entrypoint:
+        start from an imported / previously-trained checkpoint instead of
+        a fresh init. Re-box first (engine `_rebox`) if the model family
+        carries logical sharding names and the mesh should honor them."""
         params = shd.place_params(self.mesh, variables["params"])
         aux = {k: jax.device_put(shd.unbox(v), shd.replicated(self.mesh))
                for k, v in variables.items() if k != "params"} or None
@@ -87,28 +96,53 @@ def cross_entropy_loss(model: nn.Module, params, aux, batch, labels) -> jnp.ndar
 def make_trainer(
     model: nn.Module,
     mesh: Mesh,
-    learning_rate: float = 1e-4,
+    learning_rate=1e-4,
     weight_decay: float = 0.05,
     loss_fn: Optional[Callable] = None,
+    clip_norm: Optional[float] = None,
+    mutable_aux: bool = False,
 ) -> Trainer:
     """Build a Trainer whose step is jitted over ``mesh``.
 
     ``loss_fn(model, params, aux, batch, labels) -> scalar`` defaults to
     softmax cross entropy (classification fine-tune, configs 1/3/4/5);
-    ``aux`` carries frozen non-param collections (BatchNorm stats).
+    ``aux`` carries non-param collections (BatchNorm stats).
+    ``learning_rate`` may be an optax schedule. ``clip_norm`` prepends
+    global-norm gradient clipping — detection fine-tunes need it: the
+    TAL/BCE loss starts in the hundreds on fresh heads, and one unclipped
+    bf16 step can overflow activations into NaN.
+
+    ``mutable_aux=True`` changes the loss_fn contract to
+    ``-> (scalar, new_aux)`` and threads the returned collections back
+    into the state each step — REQUIRED when training BatchNorm models
+    from scratch (or far from their import distribution): frozen
+    random-init statistics mis-normalize every layer and the deep
+    features degenerate to input-independent constants (observed: a
+    detector whose class probabilities were identical on every frame).
+    Frozen stats remain the right stance for near-distribution
+    fine-tunes of imported checkpoints.
     """
     tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    if clip_norm is not None:
+        tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
     loss_fn = loss_fn or cross_entropy_loss
 
     def step_fn(state: TrainState, batch, labels):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, state.aux, batch, labels)
-        )(state.params)
+        if mutable_aux:
+            (loss, new_aux), grads = jax.value_and_grad(
+                lambda p: loss_fn(model, p, state.aux, batch, labels),
+                has_aux=True,
+            )(state.params)
+        else:
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(model, p, state.aux, batch, labels)
+            )(state.params)
+            new_aux = state.aux
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return (
             TrainState(step=state.step + 1, params=params,
-                       opt_state=opt_state, aux=state.aux),
+                       opt_state=opt_state, aux=new_aux),
             loss,
         )
 
