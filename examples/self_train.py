@@ -150,7 +150,10 @@ def main():
     augment = jax.jit(augment_detection_batch)
     with mesh:
         for batch in Loader(ds, batch_size=args.batch):
-            x = jnp.asarray(batch.astype(np.float32) / 255.0)
+            # BGR archive -> RGB [0,1]: the serving preprocess convention
+            # (ops/preprocess.py::preprocess_letterbox); training must
+            # match or the served model sees swapped channels.
+            x = jnp.asarray(batch[..., ::-1].astype(np.float32) / 255.0)
             if state is None:
                 state = trainer.init_state(init_rng, x[:1])
             t = targets_for(x.shape[0])

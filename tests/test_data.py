@@ -111,9 +111,43 @@ def test_loader_propagates_producer_error(archive, monkeypatch):
     def boom(_ref):
         raise RuntimeError("producer exploded")
 
-    monkeypatch.setattr(ds, "samples_from", boom)
+    monkeypatch.setattr(ds, "indexed_samples_from", boom)
     with pytest.raises(RuntimeError, match="producer exploded"):
         list(Loader(ds, batch_size=2))
+
+
+def test_loader_with_meta_joins_labels(tmp_path):
+    """with_meta=True yields (batch, SampleMeta list) — the supervised
+    label join for fine-tuning on archived footage (tools/selftrain_e2e).
+    npz segments (lossless) so sample identity is checkable per-pixel
+    (mp4 would smear the tagged values)."""
+    from video_edge_ai_proxy_tpu.data import SampleMeta
+
+    for cam in ("cam1", "cam2"):
+        (tmp_path / cam).mkdir()
+        for g in range(3):
+            frames = np.stack([
+                np.full((16, 16, 3), g * 10 + i, np.uint8) for i in range(10)
+            ])
+            np.savez(tmp_path / cam / f"{1000 * g}_333.npz",
+                     frames=frames, fps=30.0)
+    ds = SegmentDataset(str(tmp_path), size=(32, 32), seed=5)
+    seen = set()
+    for batch, metas in Loader(ds, batch_size=8, with_meta=True):
+        assert len(metas) == batch.shape[0]
+        for row, meta in zip(batch, metas):
+            assert isinstance(meta, SampleMeta)
+            assert meta.device_id in ("cam1", "cam2")
+            # frame value = segment_index*10 + frame_idx: identity join
+            assert row[0, 0, 0] == (meta.start_ms // 1000) * 10 + meta.frame_idx
+            seen.add((meta.device_id, meta.start_ms, meta.frame_idx))
+    assert len(seen) == 56          # 60 samples, drop_last trims 4
+
+
+def test_clip_meta_marks_clip_start(archive):
+    ds = SegmentDataset(archive, size=(32, 32), clip_len=4)
+    starts = [idx for idx, _ in ds.indexed_samples_from(ds.refs[0])]
+    assert starts == [0, 4]
 
 
 def test_loader_rejects_zero_prefetch(archive):

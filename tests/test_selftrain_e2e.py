@@ -1,0 +1,65 @@
+"""Self-training loop, end to end, scaled for CI (VERDICT r3 next #1).
+
+The real-chip artifact is SELFTRAIN_r04.json (tools/selftrain_e2e.py with
+yolov8n); this is the same CHAIN — production archiver -> data bridge with
+label join -> ultralytics-layout import -> sharded fine-tune -> held-out
+mAP -> engine serve-back — shrunk to tiny_yolov8 at 64 px on the CPU
+backend. The assertions are about the chain closing and learning being
+real (post > pre on held-out data), not about absolute accuracy.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import selftrain_e2e as st  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def chain(tmp_path_factory):
+    """One full run shared by the assertions below (the train leg is the
+    expensive part; run it once)."""
+    workdir = str(tmp_path_factory.mktemp("selftrain"))
+    record = st.run(
+        "tiny_yolov8", steps=250, batch_size=8, n_cameras=1,
+        segments_per_camera=4, frames_per_segment=16,
+        learning_rate=3e-3, val_images=12, workdir=workdir,
+        # CI trains ~250 steps, so the synthetic site is the easy end of
+        # the dial (big solid objects, low noise); the chip artifact run
+        # uses the defaults and more steps.
+        obj_frac=(0.3, 0.5), noise=4.0,
+        seed=3, engine_leg=True, log=lambda *_: None,
+    )
+    return record
+
+
+def test_chain_produces_artifacts(chain):
+    assert chain["archived_segments"] == 4
+    assert chain["train_frames"] == 64
+    assert chain["steps"] == 250
+    assert os.path.exists(chain["checkpoint"])
+    assert np.isfinite(chain["first_loss"])
+    assert np.isfinite(chain["last_loss"])
+
+
+def test_training_reduces_loss(chain):
+    assert chain["last_loss"] < chain["first_loss"]
+
+
+def test_heldout_map_improves(chain):
+    """The point of the loop: fine-tuning on the site's own archived
+    footage must lift held-out accuracy over the imported init."""
+    assert chain["post"]["mAP50"] > chain["pre"]["mAP50"]
+    assert chain["post"]["mAP"] >= chain["pre"]["mAP"]
+
+
+def test_engine_serves_the_tuned_model_better(chain):
+    """Serve-back leg: real bus -> engine -> subscriber, scored against
+    ground truth. The tuned checkpoint must not lose to the init on
+    recall (and should usually win)."""
+    assert chain["engine_post"]["images_served"] > 0
+    assert chain["engine_post"]["recall"] >= chain["engine_pre"]["recall"]

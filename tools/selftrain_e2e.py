@@ -1,0 +1,598 @@
+"""Self-training loop, end to end, as one recorded run.
+
+The reference's roadmap wishes for a feedback loop — frames out, model
+improvements back in (`/root/reference/README.md:320-331` "custom AI
+models ... training on your own footage") — but ships none of it. This
+tool drives the whole chain our framework actually has, and records the
+evidence:
+
+    synthetic site footage (known ground truth)
+      -> production archiver (`ingest/archive.py` GOP segments on disk)
+      -> training bridge (`data/segments.py` Loader, with_meta label join)
+      -> imported init (ultralytics-layout state dict through
+         `tools/import_weights.py` — the offline checkpoint recipe)
+      -> sharded fine-tune (`parallel/train.py` + `models/detect_loss.py`)
+      -> held-out mAP, pre vs post (`tools/eval_detector.py` — the EXACT
+         serving program, not an eval-only path)
+      -> engine serve-back (`engine/runner.py` checkpoint_path: frames on
+         the bus, detections out the Inference fan-out)
+
+Footage is synthesized (zero-egress image: no datasets, no published
+weights), so the "imported" init is a seeded random state dict in the
+canonical ultralytics layout — the import plumbing is fully exercised;
+only the origin of the numbers is synthetic. Ground truth is exact, so
+the pre/post mAP delta is a real measurement of learning, and the engine
+leg is a real measurement of the tuned weights serving.
+
+    python tools/selftrain_e2e.py --model yolov8n --steps 300 \
+        --record SELFTRAIN_r04.json
+
+The scaled-down CI twin lives in `tests/test_selftrain_e2e.py`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------ footage ----
+
+# BGR colors per synthetic class: red box / green ellipse / blue triangle.
+_CLASS_COLORS = ((40, 60, 220), (60, 200, 60), (220, 120, 40))
+
+
+def synth_sequence(rng: np.random.Generator, n_frames: int, hw, n_obj: int,
+                   obj_frac=(0.125, 0.334), noise: float = 8.0):
+    """One camera GOP: textured background, ``n_obj`` shapes moving
+    linearly (bouncing at edges). Returns (frames [T,H,W,3] u8 BGR,
+    per-frame list of (boxes xyxy px, classes)). ``obj_frac`` bounds
+    object size as a fraction of the frame — the task-difficulty dial
+    (the CI twin trains a few hundred steps, so it uses larger objects
+    than the real-chip artifact run)."""
+    h, w = hw
+    base = int(rng.integers(30, 90))
+    objs = []
+    for _ in range(n_obj):
+        ow = int(rng.integers(max(8, int(w * obj_frac[0])),
+                              max(9, int(w * obj_frac[1]))))
+        oh = int(rng.integers(max(8, int(h * obj_frac[0])),
+                              max(9, int(h * obj_frac[1]))))
+        objs.append({
+            "wh": (ow, oh),
+            "xy": np.array([rng.uniform(0, w - ow), rng.uniform(0, h - oh)]),
+            "v": rng.uniform(-3, 3, 2),
+            "cls": int(rng.integers(0, len(_CLASS_COLORS))),
+        })
+    frames, labels = [], []
+    for _ in range(n_frames):
+        img = np.full((h, w, 3), base, np.uint8)
+        img = (img + rng.normal(0, noise, img.shape)).clip(0, 255).astype(np.uint8)
+        boxes, classes = [], []
+        for o in objs:
+            ow, oh = o["wh"]
+            o["xy"] += o["v"]
+            for d, lim in ((0, w - ow), (1, h - oh)):
+                if o["xy"][d] < 0 or o["xy"][d] > lim:
+                    o["v"][d] *= -1
+                    o["xy"][d] = np.clip(o["xy"][d], 0, lim)
+            x, y = int(o["xy"][0]), int(o["xy"][1])
+            color = _CLASS_COLORS[o["cls"]]
+            region = img[y:y + oh, x:x + ow]
+            if o["cls"] == 0:
+                region[:] = color
+            elif o["cls"] == 1:
+                yy, xx = np.mgrid[0:oh, 0:ow]
+                mask = (((yy - oh / 2) / (oh / 2)) ** 2
+                        + ((xx - ow / 2) / (ow / 2)) ** 2) <= 1
+                region[mask] = color
+            else:
+                yy, xx = np.mgrid[0:oh, 0:ow]
+                region[xx * oh >= yy * ow] = color
+            boxes.append([x, y, x + ow, y + oh])
+            classes.append(o["cls"])
+        frames.append(img)
+        labels.append((np.array(boxes, np.float32),
+                       np.array(classes, np.int32)))
+    return np.stack(frames), labels
+
+
+def build_archive(root: str, rng: np.random.Generator, *, n_cameras: int,
+                  segments_per_camera: int, frames_per_segment: int, hw,
+                  max_objects: int, obj_frac=(0.125, 0.334),
+                  noise: float = 8.0):
+    """Write footage through the PRODUCTION archiver and return the label
+    join: {(device_id, start_ms, frame_idx): (boxes_px, classes)} in
+    SOURCE pixel space (`data.SampleMeta` keys)."""
+    from video_edge_ai_proxy_tpu.ingest.archive import (
+        GopSegment, SegmentArchiver,
+    )
+
+    arch = SegmentArchiver(root)
+    arch.start()
+    labels = {}
+    for cam in range(n_cameras):
+        device_id = f"synthcam{cam}"
+        for s in range(segments_per_camera):
+            start_ms = 10_000 * s
+            frames, per_frame = synth_sequence(
+                rng, frames_per_segment, hw,
+                n_obj=int(rng.integers(1, max_objects + 1)),
+                obj_frac=obj_frac, noise=noise,
+            )
+            arch.submit(GopSegment(
+                device_id=device_id, start_ts_ms=start_ms,
+                end_ts_ms=start_ms + int(frames_per_segment * 1000 / 30),
+                fps=30.0, frames=list(frames),
+            ))
+            for i, lab in enumerate(per_frame):
+                labels[(device_id, start_ms, i)] = lab
+    arch.stop()
+    if arch.written != n_cameras * segments_per_camera:
+        raise RuntimeError(
+            f"archiver wrote {arch.written} of "
+            f"{n_cameras * segments_per_camera} segments"
+        )
+    return labels
+
+
+def synth_val_set(rng: np.random.Generator, n_images: int, hw,
+                  max_objects: int, max_boxes: int,
+                  obj_frac=(0.125, 0.334), noise: float = 8.0):
+    """Held-out eval set in `tools/eval_detector.py` layout (boxes/classes
+    padded with -1). Fresh draws — never seen in training."""
+    images, boxes, classes = [], [], []
+    for _ in range(n_images):
+        frames, labs = synth_sequence(
+            rng, 1, hw, n_obj=int(rng.integers(1, max_objects + 1)),
+            obj_frac=obj_frac, noise=noise)
+        b, c = labs[0]
+        k = min(len(c), max_boxes)
+        pb_ = np.full((max_boxes, 4), -1, np.float32)
+        pc_ = np.full((max_boxes,), -1, np.int64)
+        pb_[:k] = b[:k]
+        pc_[:k] = c[:k]
+        images.append(frames[0])
+        boxes.append(pb_)
+        classes.append(pc_)
+    return np.stack(images), np.stack(boxes), np.stack(classes)
+
+
+# ------------------------------------------------ imported init leg ----
+
+def fabricate_imported_init(model_name: str, seed: int, out_dir: str) -> str:
+    """Seeded init -> ultralytics-layout state dict (npz) -> the real
+    importer CLI -> msgpack. Stand-in for a published checkpoint in a
+    zero-egress image: the layout, transforms, strict accounting, and
+    stem-pad shim all run for real."""
+    import jax
+    from flax import traverse_util
+
+    from tools import import_weights as iw_cli
+    from video_edge_ai_proxy_tpu.models import import_weights as iw
+    from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.parallel.sharding import unbox
+
+    _, tmpl = registry.get(model_name).init_params(jax.random.PRNGKey(seed))
+    flat = traverse_util.flatten_dict(unbox(tmpl))
+    state = {}
+    for path, leaf in flat.items():
+        key, tr = iw._yolo_key(tuple(path[1:]))
+        arr = np.asarray(leaf, np.float32)
+        if tr is iw._conv_kernel:
+            arr = np.transpose(arr, (3, 2, 0, 1))
+        elif tr is iw._dense_kernel:
+            arr = np.transpose(arr)
+        state[f"model.{key}"] = arr
+    # Canonical checkpoints ship a 3-channel stem; our serving config may
+    # pad it (stem_pad_c lane-fill lever) — slice back so the importer's
+    # zero-pad shim is the thing under test.
+    stem = "model.0.conv.weight"
+    if state[stem].shape[1] > 3:
+        state[stem] = state[stem][:, :3]
+    src = os.path.join(out_dir, "published_layout.npz")
+    np.savez(src, **state)
+    out = os.path.join(out_dir, f"{model_name}_imported.msgpack")
+    rc = iw_cli.main(["--model", model_name, "--src", src, "--out", out])
+    if rc != 0:
+        raise RuntimeError("import_weights CLI failed")
+    return out
+
+
+# ------------------------------------------------------- fine-tune ----
+
+def finetune(model_name: str, archive_root: str, labels: dict, *,
+             init_ckpt: str, steps: int, batch_size: int, max_boxes: int,
+             learning_rate: float, out_ckpt: str, augment: bool = False,
+             log_every: int = 25, log=print) -> dict:
+    """Fine-tune from the imported checkpoint on archived footage with the
+    `with_meta` label join; saves the tuned (serving-format) checkpoint.
+    Returns {"steps", "first_loss", "last_loss", "train_s"}."""
+    import jax
+    import jax.numpy as jnp
+
+    from video_edge_ai_proxy_tpu import parallel
+    from video_edge_ai_proxy_tpu.data import Loader, SegmentDataset
+    from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.models.detect_loss import (
+        make_detection_loss_fn,
+    )
+    from video_edge_ai_proxy_tpu.models.import_weights import pad_stem_on_load
+    from video_edge_ai_proxy_tpu.parallel.sharding import unbox
+    from video_edge_ai_proxy_tpu.utils.checkpoint import (
+        load_msgpack, save_msgpack,
+    )
+
+    spec = registry.get(model_name)
+    model = spec.build()
+    cfg = model.cfg
+    size = spec.input_size
+    mesh = parallel.factor_mesh()
+    # update_stats/mutable_aux: the init is random-through-the-importer,
+    # not a real pretrained distribution, so BatchNorm must adapt its
+    # statistics or deep features degenerate (make_trainer docstring).
+    # clip_norm: the TAL/BCE loss starts in the hundreds on fresh heads.
+    trainer = parallel.make_trainer(
+        model, mesh, learning_rate=learning_rate, clip_norm=10.0,
+        loss_fn=make_detection_loss_fn(cfg, update_stats=True),
+        mutable_aux=True,
+    )
+
+    _, tmpl_vars = spec.init_params(jax.random.PRNGKey(0))
+    tmpl = jax.tree.map(np.asarray, unbox(tmpl_vars))
+    variables = pad_stem_on_load(load_msgpack(init_ckpt, tmpl), tmpl, model)
+
+    ds = SegmentDataset(archive_root, size=(size, size), seed=1)
+    if not len(ds):
+        raise RuntimeError(f"no archived segments under {archive_root}")
+
+    def targets_for(metas):
+        b = np.zeros((len(metas), max_boxes, 4), np.float32)
+        l = np.zeros((len(metas), max_boxes), np.int32)
+        m = np.zeros((len(metas), max_boxes), bool)
+        for i, meta in enumerate(metas):
+            key = (meta.device_id, meta.start_ms, meta.frame_idx)
+            if key not in labels:
+                continue  # unlabeled frame trains as background
+            boxes_px, classes = labels[key]
+            # source px -> training space (SegmentDataset resizes
+            # anisotropically to size x size)
+            src = _source_hw(ds, meta.device_id)
+            sx, sy = size / src[1], size / src[0]
+            k = min(len(classes), max_boxes)
+            b[i, :k] = boxes_px[:k] * [sx, sy, sx, sy]
+            l[i, :k] = classes[:k]
+            m[i, :k] = True
+        return {"boxes": jnp.asarray(b), "labels": jnp.asarray(l),
+                "mask": jnp.asarray(m)}
+
+    aug_fn = None
+    if augment:
+        from video_edge_ai_proxy_tpu.ops.augment import (
+            augment_detection_batch,
+        )
+
+        aug_fn = jax.jit(augment_detection_batch)
+
+    rng = jax.random.PRNGKey(2)
+    t0 = time.monotonic()
+    first_loss = last_loss = None
+    step_count = 0
+    with mesh:
+        state = trainer.init_state_from(variables)
+        while step_count < steps:
+            epoch_start = step_count
+            for batch, metas in Loader(ds, batch_size=batch_size,
+                                       with_meta=True):
+                # Match the SERVING input convention exactly: archived
+                # frames are BGR u8; preprocess_letterbox serves RGB in
+                # [0,1] (ops/preprocess.py:148-149). Training in BGR
+                # while serving RGB silently zeroes held-out accuracy.
+                x = jnp.asarray(batch[..., ::-1].astype(np.float32) / 255.0)
+                t = targets_for(metas)
+                if aug_fn is not None:
+                    rng, akey = jax.random.split(rng)
+                    x, ab, am, al = aug_fn(
+                        akey, x, t["boxes"], t["mask"], t["labels"])
+                    t = {"boxes": ab, "mask": am, "labels": al}
+                state, loss = trainer.train_step(
+                    state, trainer.shard_batch(x),
+                    jax.tree.map(trainer.shard_batch, t),
+                )
+                step_count += 1
+                if first_loss is None:
+                    first_loss = float(loss)
+                if step_count % log_every == 0:
+                    log(f"  step {step_count}/{steps}: "
+                        f"loss {float(loss):.3f}")
+                if step_count >= steps:
+                    last_loss = float(loss)
+                    break
+            if step_count == epoch_start:
+                # zero full batches this epoch (batch_size > decodable
+                # samples with drop_last): looping again would busy-spin
+                # re-decoding the archive forever
+                raise RuntimeError(
+                    f"archive yields no full batch of {batch_size}; "
+                    "lower --batch or archive more footage"
+                )
+    train_s = time.monotonic() - t0
+
+    tuned = {"params": jax.tree.map(np.asarray, unbox(state.params)),
+             **{k: jax.tree.map(np.asarray, unbox(v))
+                for k, v in (state.aux or {}).items()}}
+    save_msgpack(out_ckpt, tuned)
+    return {"steps": step_count, "first_loss": first_loss,
+            "last_loss": last_loss, "train_s": round(train_s, 2)}
+
+
+def _source_hw(ds, device_id):
+    """Source (h, w) per device, cached on the dataset (all synthetic
+    cameras in one run share a geometry; fall back to reading a frame)."""
+    cache = getattr(ds, "_src_hw_cache", None)
+    if cache is None:
+        cache = {}
+        ds._src_hw_cache = cache
+    if device_id not in cache:
+        from video_edge_ai_proxy_tpu.data import read_segment
+
+        ref = next(r for r in ds.refs if r.device_id == device_id)
+        cache[device_id] = read_segment(ref).shape[1:3]
+    return cache[device_id]
+
+
+# ------------------------------------------------- engine serve-back ----
+
+def engine_serve_metrics(model_name: str, ckpt: str, images: np.ndarray,
+                         gt_boxes: np.ndarray, gt_classes: np.ndarray, *,
+                         conf: float = 0.25, iou_thr: float = 0.5,
+                         deadline_s: float = 60.0) -> dict:
+    """Serve ``ckpt`` through the REAL engine loop — frames published on
+    the bus, results read off the Inference subscriber fan-out — and score
+    detections against ground truth. Returns {"recall", "precision",
+    "images_served"}."""
+    import queue
+    import threading
+
+    from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+    from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+    from video_edge_ai_proxy_tpu.engine import InferenceEngine
+    from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+    h, w = images.shape[1:3]
+    bus = MemoryFrameBus()
+    eng = InferenceEngine(bus, EngineConfig(
+        model=model_name, batch_buckets=(1, 2, 4), tick_ms=5,
+        checkpoint_path=ckpt,
+    ))
+    results: "queue.Queue" = queue.Queue()
+
+    def pump():
+        for res in eng.subscribe():
+            results.put(res)
+
+    eng.start()
+    sub = threading.Thread(target=pump, daemon=True)
+    sub.start()
+    got = {}
+    published = set()
+    try:
+        deadline = time.monotonic() + deadline_s
+        i = 0
+        while len(got) < len(images) and time.monotonic() < deadline:
+            # one stream per held-out image: publish, await its result
+            if i not in published:
+                bus.create_stream(f"valcam{i}", w * h * 3)
+                bus.publish(f"valcam{i}", images[i], FrameMeta(
+                    width=w, height=h, channels=3,
+                    timestamp_ms=int(time.time() * 1000), is_keyframe=True,
+                ))
+                published.add(i)
+            try:
+                res = results.get(timeout=2.0)
+            except queue.Empty:
+                # result lost/suppressed: move on rather than wedge
+                i = min(i + 1, len(images) - 1)
+                continue
+            idx = int(res.device_id[len("valcam"):])
+            if idx not in got:
+                got[idx] = res
+            if idx == i:
+                i = min(i + 1, len(images) - 1)
+    finally:
+        eng.stop()
+        bus.close()
+
+    tp = fp = n_gt = 0
+    for idx, res in got.items():
+        gt_keep = gt_classes[idx] >= 0
+        gts = gt_boxes[idx][gt_keep]
+        gcs = gt_classes[idx][gt_keep]
+        n_gt += len(gts)
+        matched = np.zeros(len(gts), bool)
+        for det in res.detections:
+            if det.confidence < conf or not det.HasField("box"):
+                continue
+            b = np.array([det.box.left, det.box.top,
+                          det.box.left + det.box.width,
+                          det.box.top + det.box.height])
+            best, best_iou = -1, iou_thr
+            for gi, (gb, gc) in enumerate(zip(gts, gcs)):
+                if matched[gi] or det.class_id != gc:
+                    continue
+                iou = _iou(b, gb)
+                if iou >= best_iou:
+                    best, best_iou = gi, iou
+            if best >= 0:
+                matched[best] = True
+                tp += 1
+            else:
+                fp += 1
+    return {
+        "recall": round(tp / n_gt, 4) if n_gt else 0.0,
+        "precision": round(tp / (tp + fp), 4) if tp + fp else 0.0,
+        "images_served": len(got),
+    }
+
+
+def _iou(a, b):
+    lt = np.maximum(a[:2], b[:2])
+    rb = np.minimum(a[2:], b[2:])
+    wh = np.maximum(rb - lt, 0)
+    inter = wh[0] * wh[1]
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+# ------------------------------------------------------------ driver ----
+
+def run(model_name: str = "yolov8n", *, steps: int = 300,
+        batch_size: int = 8, n_cameras: int = 2,
+        segments_per_camera: int = 6, frames_per_segment: int = 24,
+        source_hw=None, max_objects: int = 3, max_boxes: int = 8,
+        learning_rate: float = 1e-3, val_images: int = 32,
+        obj_frac=(0.125, 0.334), noise: float = 8.0,
+        augment: bool = False, workdir: str = "", seed: int = 0,
+        engine_leg: bool = True, log=print) -> dict:
+    """The whole chain; returns the record dict (see module doc)."""
+    import jax
+
+    from tools import eval_detector
+
+    t_start = time.monotonic()
+    workdir = workdir or tempfile.mkdtemp(prefix="selftrain_")
+    os.makedirs(workdir, exist_ok=True)
+    from video_edge_ai_proxy_tpu.models import registry
+
+    spec = registry.get(model_name)
+    source_hw = tuple(source_hw or (spec.input_size, spec.input_size))
+    rng = np.random.default_rng(seed)
+
+    log(f"[1/6] archiving synthetic footage under {workdir}/archive ...")
+    archive_root = os.path.join(workdir, "archive")
+    if os.path.isdir(archive_root):
+        # a stale archive from a previous run would double the dataset
+        # and orphan half of it from this run's label join
+        import shutil
+
+        shutil.rmtree(archive_root)
+    labels = build_archive(
+        archive_root, rng, n_cameras=n_cameras,
+        segments_per_camera=segments_per_camera,
+        frames_per_segment=frames_per_segment, hw=source_hw,
+        max_objects=max_objects, obj_frac=obj_frac, noise=noise,
+    )
+    n_train = n_cameras * segments_per_camera * frames_per_segment
+
+    log("[2/6] importing the init checkpoint (ultralytics layout) ...")
+    init_ckpt = fabricate_imported_init(model_name, seed + 1, workdir)
+
+    log(f"[3/6] held-out val set ({val_images} images) ...")
+    images, vboxes, vclasses = synth_val_set(
+        rng, val_images, source_hw, max_objects, max_boxes,
+        obj_frac=obj_frac, noise=noise)
+
+    log("[4/6] pre-tune mAP (exact serving program) ...")
+    pre = eval_detector.evaluate(
+        model_name, init_ckpt, images, vboxes, vclasses,
+        batch=min(8, val_images))
+    log(f"  pre: {pre}")
+
+    log(f"[5/6] fine-tuning {steps} steps ...")
+    tuned_ckpt = os.path.join(workdir, f"{model_name}_tuned.msgpack")
+    train_info = finetune(
+        model_name, archive_root, labels, init_ckpt=init_ckpt, steps=steps,
+        batch_size=batch_size, max_boxes=max_boxes,
+        learning_rate=learning_rate, out_ckpt=tuned_ckpt, augment=augment,
+        log=log,
+    )
+    post = eval_detector.evaluate(
+        model_name, tuned_ckpt, images, vboxes, vclasses,
+        batch=min(8, val_images))
+    log(f"  post: {post}")
+
+    record = {
+        "model": model_name,
+        "chip": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+        "train_frames": n_train,
+        "archived_segments": n_cameras * segments_per_camera,
+        "source_hw": list(source_hw),
+        "steps": train_info["steps"],
+        "batch_size": batch_size,
+        "learning_rate": learning_rate,
+        "first_loss": train_info["first_loss"],
+        "last_loss": train_info["last_loss"],
+        "train_s": train_info["train_s"],
+        "val_images": int(val_images),
+        "pre": {k: pre[k] for k in ("mAP", "mAP50", "mAP75")},
+        "post": {k: post[k] for k in ("mAP", "mAP50", "mAP75")},
+        "checkpoint": tuned_ckpt,
+    }
+
+    if engine_leg:
+        log("[6/6] engine serve-back (bus -> engine -> subscriber) ...")
+        record["engine_pre"] = engine_serve_metrics(
+            model_name, init_ckpt, images, vboxes, vclasses)
+        record["engine_post"] = engine_serve_metrics(
+            model_name, tuned_ckpt, images, vboxes, vclasses)
+        log(f"  engine pre:  {record['engine_pre']}")
+        log(f"  engine post: {record['engine_post']}")
+
+    record["wall_s"] = round(time.monotonic() - t_start, 2)
+    return record
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--model", default="yolov8n")
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--cameras", type=int, default=2)
+    ap.add_argument("--segments", type=int, default=6,
+                    help="archived segments per camera")
+    ap.add_argument("--frames", type=int, default=24,
+                    help="frames per segment")
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--val-images", type=int, default=32)
+    ap.add_argument("--augment", action="store_true")
+    ap.add_argument("--easy", action="store_true",
+                    help="easy synthetic site (big solid objects, low "
+                         "noise) — the CI twin's setting, useful for "
+                         "short validation runs")
+    ap.add_argument("--no-engine-leg", action="store_true")
+    ap.add_argument("--workdir", default="")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--record", default="", help="write the JSON record here")
+    args = ap.parse_args(argv)
+
+    record = run(
+        args.model, steps=args.steps, batch_size=args.batch,
+        n_cameras=args.cameras, segments_per_camera=args.segments,
+        frames_per_segment=args.frames, learning_rate=args.lr,
+        val_images=args.val_images, augment=args.augment,
+        obj_frac=(0.3, 0.5) if args.easy else (0.125, 0.334),
+        noise=4.0 if args.easy else 8.0,
+        workdir=args.workdir, seed=args.seed,
+        engine_leg=not args.no_engine_leg,
+    )
+    print(json.dumps(record))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    improved = record["post"]["mAP50"] > record["pre"]["mAP50"]
+    return 0 if improved else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,8 @@
 """Training data pipeline: archived edge footage -> device batches."""
 
-from .segments import Loader, SegmentDataset, SegmentRef, read_segment, scan_archive
+from .segments import (
+    Loader, SampleMeta, SegmentDataset, SegmentRef, read_segment, scan_archive,
+)
 
-__all__ = ["Loader", "SegmentDataset", "SegmentRef", "read_segment", "scan_archive"]
+__all__ = ["Loader", "SampleMeta", "SegmentDataset", "SegmentRef",
+           "read_segment", "scan_archive"]

@@ -35,6 +35,19 @@ class SegmentRef:
     duration_ms: int
 
 
+@dataclass(frozen=True)
+class SampleMeta:
+    """Identity of one training sample: which camera, which archived
+    segment, which frame within it (the clip's first frame for clips).
+    This is the join key supervised fine-tuning needs to attach per-frame
+    labels — `examples/self_train.py` pools labels because the loader
+    used to discard identity; `Loader(with_meta=True)` closes that gap."""
+
+    device_id: str
+    start_ms: int
+    frame_idx: int
+
+
 def scan_archive(root: str, device_ids: Optional[Sequence[str]] = None) -> List[SegmentRef]:
     """Walk ``<root>/<device_id>/<start>_<dur>.{mp4,npz}`` into refs,
     sorted by (device, start time)."""
@@ -123,6 +136,14 @@ class SegmentDataset:
         return frames
 
     def samples_from(self, ref: SegmentRef) -> Iterator[np.ndarray]:
+        for _, sample in self.indexed_samples_from(ref):
+            yield sample
+
+    def indexed_samples_from(
+        self, ref: SegmentRef
+    ) -> Iterator[Tuple[int, np.ndarray]]:
+        """Like `samples_from` but yields ``(frame_idx, sample)`` so callers
+        can join per-frame labels (`SampleMeta`)."""
         try:
             frames = self._fit(read_segment(ref))
         except Exception as exc:
@@ -130,9 +151,10 @@ class SegmentDataset:
             return
         if self.clip_len:
             for start in range(0, len(frames) - self.clip_len + 1, self.clip_len):
-                yield frames[start:start + self.clip_len]
+                yield start, frames[start:start + self.clip_len]
         else:
-            yield from frames
+            for i, frame in enumerate(frames):
+                yield i, frame
 
     def shuffled_refs(self) -> List[SegmentRef]:
         refs = list(self.refs)
@@ -142,10 +164,15 @@ class SegmentDataset:
 
 class Loader:
     """Background-decoded, shuffled batcher: iterate numpy batches
-    [B, (T,) H, W, 3] uint8, ready for `Trainer.shard_batch`."""
+    [B, (T,) H, W, 3] uint8, ready for `Trainer.shard_batch`.
+
+    ``with_meta=True`` yields ``(batch, metas)`` instead, where ``metas``
+    is a list of `SampleMeta` aligned with batch rows — the label join for
+    supervised fine-tuning on archived footage."""
 
     def __init__(self, dataset: SegmentDataset, batch_size: int,
-                 prefetch: int = 4, drop_last: bool = True):
+                 prefetch: int = 4, drop_last: bool = True,
+                 with_meta: bool = False):
         if prefetch < 1:
             # queue.Queue(0) would mean UNBOUNDED readahead, not none.
             raise ValueError("prefetch must be >= 1")
@@ -153,6 +180,7 @@ class Loader:
         self.batch_size = batch_size
         self.prefetch = prefetch
         self.drop_last = drop_last
+        self.with_meta = with_meta
 
     def __iter__(self) -> Iterator[np.ndarray]:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
@@ -171,20 +199,26 @@ class Loader:
                     continue
             return False
 
+        def emit(batch, metas) -> bool:
+            stacked = np.stack(batch)
+            return put((stacked, metas) if self.with_meta else stacked)
+
         def producer():
             try:
                 batch: List[np.ndarray] = []
+                metas: List[SampleMeta] = []
                 for ref in self.dataset.shuffled_refs():
                     if stop.is_set():
                         return
-                    for sample in self.dataset.samples_from(ref):
+                    for idx, sample in self.dataset.indexed_samples_from(ref):
                         batch.append(sample)
+                        metas.append(SampleMeta(ref.device_id, ref.start_ms, idx))
                         if len(batch) == self.batch_size:
-                            if not put(np.stack(batch)):
+                            if not emit(batch, metas):
                                 return
-                            batch = []
+                            batch, metas = [], []
                 if batch and not self.drop_last:
-                    put(np.stack(batch))
+                    emit(batch, metas)
             except BaseException as exc:  # surfaced in the consumer
                 error.append(exc)
             finally:
