@@ -334,3 +334,170 @@ class TestWorkerOverRedis:
         assert f.data.shape == (48, 64, 3)
         assert f.meta.is_keyframe in (True, False)
         check.close()
+
+
+class TestScanPagination:
+    """SCAN must behave like the real server's cursor contract
+    (VERDICT r3 #8): paged results, possibly-empty pages with a non-zero
+    cursor, termination only at cursor 0. Runs against mini AND real."""
+
+    def test_scan_pages_until_cursor_zero(self, raw):
+        for i in range(25):
+            raw.command("SET", f"scankey:{i:02d}", "v")
+        got, cursor, pages = set(), b"0", 0
+        while True:
+            cur, keys = raw.command("SCAN", cursor, "MATCH", "scankey:*",
+                                    "COUNT", "7")
+            got.update(k.decode() for k in keys)
+            pages += 1
+            cursor = cur
+            if cur in (b"0", 0, "0"):
+                break
+            assert pages < 100
+        assert got == {f"scankey:{i:02d}" for i in range(25)}
+        assert pages > 1          # COUNT 7 over 25 keys cannot be one-shot
+
+    def test_scan_type_filter_with_pagination(self, raw):
+        for i in range(8):
+            raw.command("SET", f"str:{i}", "v")
+            raw.command("HSET", f"hsh:{i}", "f", "v")
+        got, cursor = set(), b"0"
+        while True:
+            cur, keys = raw.command("SCAN", cursor, "COUNT", "3",
+                                    "TYPE", "hash")
+            got.update(k.decode() for k in keys)
+            cursor = cur
+            if cur in (b"0", 0, "0"):
+                break
+        assert {k for k in got if k.startswith("hsh:")} == \
+            {f"hsh:{i}" for i in range(8)}
+        assert not any(k.startswith("str:") for k in got)
+
+    def test_scan_rejects_bad_cursor(self, raw):
+        with pytest.raises(Exception):
+            raw.command("SCAN", "notanumber")
+
+    def test_scan_survivors_not_skipped_by_concurrent_delete(self, raw):
+        """The SCAN guarantee: a key present for the WHOLE scan must be
+        returned. Offset cursors break this (deleting an earlier-sorted
+        key shifts every later key down a slot); keyset cursors don't."""
+        for i in range(20):
+            raw.command("SET", f"surv:{i:02d}", "v")
+        cur, first_page = raw.command("SCAN", "0", "MATCH", "surv:*",
+                                      "COUNT", "5")
+        assert cur not in (b"0", 0, "0")
+        # delete keys the first page already returned (they sort BEFORE
+        # the cursor position — under offset cursors this shifts the
+        # remaining keys down and skips some)
+        for k in first_page:
+            raw.command("DEL", k)
+        got = {k.decode() for k in first_page}
+        while cur not in (b"0", 0, "0"):
+            cur, page = raw.command("SCAN", cur, "MATCH", "surv:*",
+                                    "COUNT", "5")
+            got.update(k.decode() for k in page)
+        assert got == {f"surv:{i:02d}" for i in range(20)}
+
+
+class TestXrangeExclusiveBounds:
+    """Redis 6.2+ exclusive ``(id`` bounds — previously rejected by the
+    mini server (its own docstring admitted it)."""
+
+    def _fill(self, raw, key="xs"):
+        ids = []
+        for i in range(5):
+            ids.append(raw.command(
+                "XADD", key, f"{100 + i}-0", "n", str(i)).decode())
+        return ids
+
+    def test_exclusive_start(self, raw):
+        self._fill(raw)
+        entries = raw.command("XRANGE", "xs", "(102-0", "+")
+        assert [e[0].decode() for e in entries] == ["103-0", "104-0"]
+
+    def test_exclusive_end(self, raw):
+        self._fill(raw, "xe")
+        entries = raw.command("XRANGE", "xe", "-", "(102-0")
+        assert [e[0].decode() for e in entries] == ["100-0", "101-0"]
+
+    def test_exclusive_both_and_revrange(self, raw):
+        self._fill(raw, "xb")
+        entries = raw.command("XRANGE", "xb", "(100-0", "(104-0")
+        assert [e[0].decode() for e in entries] == \
+            ["101-0", "102-0", "103-0"]
+        rev = raw.command("XREVRANGE", "xb", "(104-0", "(100-0")
+        assert [e[0].decode() for e in rev] == ["103-0", "102-0", "101-0"]
+
+    def test_exclusive_ms_only_start(self, raw):
+        raw.command("XADD", "xm", "100-0", "n", "0")
+        raw.command("XADD", "xm", "100-1", "n", "1")
+        raw.command("XADD", "xm", "101-0", "n", "2")
+        # "(100" excludes 100-0 only (> 100-0), like real Redis
+        entries = raw.command("XRANGE", "xm", "(100", "+")
+        assert [e[0].decode() for e in entries] == ["100-1", "101-0"]
+
+    def test_exclusive_sentinel_rejected(self, raw):
+        with pytest.raises(Exception):
+            raw.command("XRANGE", "xs", "(-", "+")
+
+
+class TestRespFramingFuzz:
+    """Malformed wire bytes must never crash or wedge the server: every
+    fuzz connection gets garbage, then a fresh well-formed connection must
+    still be served (VERDICT r3 #8 RESP framing fuzz)."""
+
+    GARBAGE = [
+        b"\x00\xff\xfe\xfd" * 16,
+        b"*abc\r\n",
+        b"*2\r\n$notanum\r\n",
+        b"*1\r\n$-5\r\nxx\r\n",
+        b"*-3\r\n",
+        b"*0\r\n" * 4,
+        b"*99999999999999\r\n",
+        b"*2\r\n$3\r\nGET\r\n$1000000\r\n",     # truncated huge bulk
+        b"+inline reply as request\r\n",
+        b"*1\r\n*1\r\n$4\r\nPING\r\n",          # nested array header
+        b"$5\r\nhello\r\n",
+        b"\r\n\r\n\r\n",
+    ]
+
+    def test_garbage_never_kills_the_server(self, server):
+        import random
+        import socket
+
+        host, port = server.addr.rsplit(":", 1)
+        rng = random.Random(1234)
+        payloads = list(self.GARBAGE)
+        payloads += [bytes(rng.randrange(256) for _ in range(rng.randrange(1, 200)))
+                     for _ in range(30)]
+        for payload in payloads:
+            with socket.create_connection((host, int(port)), timeout=2) as s:
+                s.settimeout(0.5)
+                try:
+                    s.sendall(payload)
+                    try:
+                        s.recv(4096)   # error reply or silence, both fine
+                    except socket.timeout:
+                        pass
+                except OSError:
+                    pass               # server closed on us: acceptable
+        # the server must still serve a clean connection
+        c = RespClient.from_addr(server.addr)
+        try:
+            assert c.command("PING") in (b"PONG", "PONG")
+            c.command("SET", "after_fuzz", "ok")
+            assert c.command("GET", "after_fuzz") == b"ok"
+        finally:
+            c.close()
+
+    def test_truncated_frame_mid_command(self, server):
+        import socket
+
+        host, port = server.addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=2) as s:
+            s.sendall(b"*3\r\n$3\r\nSET\r\n$1\r\nk")   # cut mid-bulk
+        c = RespClient.from_addr(server.addr)
+        try:
+            assert c.command("GET", "k") is None   # never committed
+        finally:
+            c.close()

@@ -19,11 +19,13 @@ Known approximations vs real Redis (VERDICT r2 weak #2):
   real reply has many more fields. The client reads it as a field map,
   so extras are ignored — asserting on the exact field SET would pass
   here and fail on Redis 6 vs 7 (both add fields over versions).
-- ``SCAN`` is one-shot (cursor 0 returns everything; non-zero cursors
-  are rejected loudly). Real Redis may return keys across many pages
-  and repeat keys across rehashes — the client deduplicates.
-- ``XRANGE``/``XREVRANGE`` implement inclusive id bounds but not the
-  exclusive ``(id`` form (rejected loudly, not approximated).
+- ``SCAN`` paginates with keyset cursors over stable per-key ids (COUNT
+  per page, default 10, MATCH/TYPE filtered after paging like real Redis
+  — pages may be empty with a non-zero cursor). Because ids never shift,
+  the real server's core guarantee holds: a key present for the whole
+  scan is returned exactly once; keys created or deleted mid-scan may be
+  missed, which the contract allows. Cursor VALUES differ from Redis's
+  reverse-binary iteration (they are opaque in both).
 - RESP2 only: no HELLO/RESP3 push protocol; AUTH is the single-password
   form (no ACL users).
 - No expiry (TTL/EXPIRE), no transactions/pipelining guarantees beyond
@@ -52,6 +54,9 @@ class MiniRedis:
         self._streams: Dict[bytes, List[StreamEntry]] = {}
         self._last_stream_id: Dict[bytes, Tuple[int, int]] = {}
         self._lists: Dict[bytes, List[bytes]] = {}  # head = index 0
+        # SCAN keyset cursors: key -> stable id (see _cmd_scan)
+        self._scan_ids: Dict[bytes, int] = {}
+        self._next_scan_id = 1
         self._lock = threading.Lock()
         # XADD signals blocked XREADs (Condition over the dispatch lock:
         # cond.wait releases it, so other connections keep serving).
@@ -119,24 +124,40 @@ class MiniRedis:
             return out
 
         authed = not self._password
+
+        def bad_frame() -> None:
+            # Real Redis replies with a protocol error, then closes the
+            # connection; it never crashes the serving thread or corrupts
+            # other connections (the RESP framing fuzz test drives this).
+            try:
+                conn.sendall(b"-ERR Protocol error\r\n")
+            except OSError:
+                pass
+
         try:
             while not self._stop.is_set():
                 line = read_line()
                 if line is None:
                     return
-                if not line.startswith(b"*"):
-                    conn.sendall(b"-ERR protocol error\r\n")
-                    return
+                if not line.startswith(b"*") or not line[1:].isdigit():
+                    return bad_frame()
+                nargs = int(line[1:])
+                if nargs > 1_000_000:     # inline bomb: refuse, don't loop
+                    return bad_frame()
                 parts: List[bytes] = []
-                for _ in range(int(line[1:])):
+                for _ in range(nargs):
                     hdr = read_line()
-                    if hdr is None or not hdr.startswith(b"$"):
+                    if hdr is None:
                         return
+                    if not hdr.startswith(b"$") or not hdr[1:].isdigit():
+                        return bad_frame()
                     data = read_exact(int(hdr[1:]))
                     if data is None or read_exact(2) is None:
                         return
                     parts.append(data)
-                cmd = parts[0].upper() if parts else b""
+                if not parts:
+                    continue      # empty multibulk: ignored, like Redis
+                cmd = parts[0].upper()
                 # Connection-scoped auth, like Redis requirepass.
                 if cmd == b"AUTH":
                     if not self._password:
@@ -244,14 +265,19 @@ class MiniRedis:
         return self._arr(sorted(keys))
 
     def _cmd_scan(self, args):
-        # One-shot scan: returns cursor 0 with everything (valid per the
-        # SCAN contract — the server may return all keys in one page).
-        # A non-zero input cursor can therefore never be produced by a
-        # well-behaved client of THIS server; fail loudly instead of
-        # silently restarting the scan (round-2 advisor).
-        if args[0] != b"0":
-            return b"-ERR invalid cursor (miniredis scans are one-shot)\r\n"
-        match, want_type = "*", None
+        # Real cursor pagination (VERDICT r3 #8 — was one-shot). Keyset
+        # cursors, not offsets: each key gets a stable id on first sight,
+        # the cursor is "resume from id N", and deletions never renumber
+        # the survivors — so a concurrent DEL cannot make the scan skip a
+        # key that exists throughout (the guarantee real Redis's reverse-
+        # binary cursor provides, and the one the unacked-recovery sweep
+        # in uplink/redis_queue.py leans on). COUNT bounds the page
+        # (default 10, like Redis); MATCH/TYPE filter AFTER paging, so
+        # clients see possibly-empty pages with a non-zero cursor.
+        if not args[0].isdigit():
+            return b"-ERR invalid cursor\r\n"
+        cursor = int(args[0])
+        match, want_type, count = "*", None, 10
         i = 1
         while i < len(args):
             opt = args[i].upper()
@@ -259,14 +285,31 @@ class MiniRedis:
                 match = args[i + 1].decode()
             elif opt == b"TYPE":
                 want_type = args[i + 1].decode()
+            elif opt == b"COUNT":
+                count = int(args[i + 1])
+                if count < 1:
+                    return b"-ERR syntax error\r\n"
+            else:
+                return b"-ERR syntax error\r\n"
             i += 2
+        live = set(
+            (*self._strings, *self._hashes, *self._streams, *self._lists)
+        )
+        self._scan_ids = {k: v for k, v in self._scan_ids.items()
+                          if k in live}
+        for k in sorted(live - self._scan_ids.keys()):
+            self._scan_ids[k] = self._next_scan_id
+            self._next_scan_id += 1
+        ordered = sorted(self._scan_ids.items(), key=lambda kv: kv[1])
+        window = [(k, v) for k, v in ordered if v >= cursor]
+        page, rest = window[:count], window[count:]
+        next_cursor = rest[0][1] if rest else 0
         keys = [
-            k for k in (*self._strings, *self._hashes, *self._streams,
-                        *self._lists)
+            k for k, _ in page
             if fnmatchcase(k.decode(), match)
             and (want_type is None or self._type_of(k) == want_type)
         ]
-        return self._arr([b"0", sorted(keys)])
+        return self._arr([b"%d" % next_cursor, keys])
 
     def _cmd_type(self, args):
         return f"+{self._type_of(args[0])}\r\n".encode()
@@ -439,20 +482,30 @@ class MiniRedis:
     @staticmethod
     def _range_bound(raw: bytes, is_start: bool):
         """One XRANGE/XREVRANGE id bound -> inclusive (ms, n) tuple.
-        Supports the sentinels and explicit "ms[-n]" ids (missing seq
-        defaults to 0 for a start bound, +inf for an end bound — real
-        Redis semantics). Exclusive "(" bounds are not implemented and
-        fail loudly rather than silently returning wrong data."""
+        Supports the sentinels, explicit "ms[-n]" ids (missing seq
+        defaults to 0 for a start bound, +inf for an end bound), and the
+        exclusive "(id" form (Redis 6.2+) — converted to the adjacent
+        inclusive id, so the comparison stays one tuple range check."""
+        exclusive = raw.startswith(b"(")
+        if exclusive:
+            raw = raw[1:]
+            if raw in (b"-", b"+"):
+                # real Redis: "ERR Invalid stream ID specified"
+                raise ValueError("exclusive sentinel bounds are invalid")
         if raw == b"-":
             return (0, 0)
         if raw == b"+":
             return (1 << 63, 1 << 63)
-        if raw.startswith(b"("):
-            raise ValueError("exclusive range bounds unsupported")
         ms, sep, n = raw.partition(b"-")
-        if sep:
-            return (int(ms), int(n))
-        return (int(ms), 0 if is_start else 1 << 63)
+        bound = (int(ms), int(n) if sep else (0 if is_start else 1 << 63))
+        if exclusive:
+            if is_start:        # > bound  ==  >= next id
+                bound = (bound[0], bound[1] + 1)
+            elif bound[1] > 0:  # < bound  ==  <= previous id
+                bound = (bound[0], bound[1] - 1)
+            else:
+                bound = (bound[0] - 1, 1 << 63)
+        return bound
 
     def _xrange_entries(self, key, lo_raw, hi_raw):
         lo = self._range_bound(lo_raw, True)
@@ -576,4 +629,5 @@ class MiniRedis:
         self._streams.clear()
         self._last_stream_id.clear()
         self._lists.clear()
+        self._scan_ids.clear()
         return b"+OK\r\n"
