@@ -1,0 +1,270 @@
+"""Serving latency budget, measured stage by stage (VERDICT r3 weak #1).
+
+The <40 ms p50 north-star serving SLA (BASELINE.json) previously rested on
+arithmetic: device time was nailed by bench.py, but no measurement
+decomposed the FRAMEWORK's own host-side path — bus publish -> collector
+pickup -> dispatch -> drain -> emit -> subscriber receive. This tool runs
+the real engine loop (``EngineConfig.stage_trace``) against in-process
+synthetic cameras on the production shm bus and reports p50/p95 per stage.
+
+Tunnel honesty: this dev environment reaches the TPU through an RPC
+tunnel (~100 ms/RPC — bench.py docstring), which inflates exactly two
+stages: the submit->drain wait and the D2H fetch. Those two are therefore
+ALSO measured the way bench.py measures device work (one scan-folded
+program, single dispatch+fetch) and the production composition substitutes
+that on-chip number plus one tick of double-buffer deferral:
+
+    production_e2e_p50 = pub->collect + collect->submit    (measured host)
+                       + tick_ms + device_batch_ms          (measured chip)
+                       + drain->emit + emit->receive        (measured host)
+
+Every term is a measurement from this run; only the SUM is a composition,
+and the raw tunnel-bound stages are reported alongside so nothing hides.
+
+    python tools/bench_latency.py --record LATENCY_r04.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STAGES = [
+    ("pub_to_collect", "frame on the bus -> collector picked it up"),
+    ("collect_to_submit", "batch assembly + device dispatch"),
+    ("submit_to_drain", "double-buffer wait until drain begins"),
+    ("drain_fetch", "D2H fetch of the batch outputs"),
+    ("drain_to_emit", "postprocess + proto build + tracker"),
+    ("emit_to_recv", "subscriber queue hop"),
+    ("e2e", "publish timestamp -> subscriber receive"),
+]
+
+
+def percentiles(xs):
+    if not xs:
+        return {"p50": None, "p95": None, "n": 0}
+    a = np.asarray(xs, np.float64)
+    return {"p50": round(float(np.percentile(a, 50)), 3),
+            "p95": round(float(np.percentile(a, 95)), 3),
+            "n": len(xs)}
+
+
+def run(model: str, streams: int, src_hw, fps: float, duration_s: float,
+        bus_backend: str, tick_ms: int, log=print) -> dict:
+    from video_edge_ai_proxy_tpu.bus import FrameMeta, open_bus
+    from video_edge_ai_proxy_tpu.engine import InferenceEngine
+    from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+    h, w = src_hw
+    bus = open_bus(bus_backend)
+    eng = InferenceEngine(bus, EngineConfig(
+        model=model, tick_ms=tick_ms, stage_trace=True,
+        batch_buckets=(1, 2, 4, 8, 16),
+        annotation_emit="all", track=True,
+    ))
+    log(f"warmup + compile ({model}, {streams}x{h}x{w}) ...")
+    eng.warmup()
+    # The engine's default trace buffer (4096) holds ~28% of a default
+    # 16-stream x 30 fps x 30 s run; size it to the whole window so the
+    # percentiles cover the full measurement, not just its tail.
+    import collections
+
+    eng.stage_records = collections.deque(
+        maxlen=max(4096, int(streams * fps * duration_s * 2)))
+    eng.start()
+
+    recv_times = {}
+    recv_lock = threading.Lock()
+
+    def subscriber():
+        for res in eng.subscribe():
+            with recv_lock:
+                recv_times[(res.device_id, res.timestamp)] = time.time()
+
+    sub = threading.Thread(target=subscriber, daemon=True)
+    sub.start()
+
+    frames = [
+        np.random.default_rng(i).integers(0, 256, (h, w, 3), np.uint8)
+        for i in range(streams)
+    ]
+    for i in range(streams):
+        bus.create_stream(f"lat{i:02d}", h * w * 3)
+
+    # First frames force the (geometry, bucket) compiles before timing.
+    for i in range(streams):
+        bus.publish(f"lat{i:02d}", frames[i], FrameMeta(
+            width=w, height=h, channels=3,
+            timestamp_ms=int(time.time() * 1000), is_keyframe=True))
+    t_wait = time.monotonic()
+    while not eng.stage_records and time.monotonic() - t_wait < 600:
+        time.sleep(0.5)
+    eng.stage_records.clear()
+    with recv_lock:
+        recv_times.clear()
+
+    log(f"publishing {streams} streams at {fps} fps for {duration_s}s ...")
+    stop = threading.Event()
+
+    def camera(i: int):
+        period = 1.0 / fps
+        nxt = time.monotonic()
+        while not stop.is_set():
+            ts = int(time.time() * 1000)
+            bus.publish(f"lat{i:02d}", frames[i], FrameMeta(
+                width=w, height=h, channels=3,
+                timestamp_ms=ts, is_keyframe=True))
+            nxt += period
+            delay = nxt - time.monotonic()
+            if delay > 0:
+                stop.wait(delay)
+            else:
+                nxt = time.monotonic()
+
+    cams = [threading.Thread(target=camera, args=(i,), daemon=True)
+            for i in range(streams)]
+    for c in cams:
+        c.start()
+    time.sleep(duration_s)
+    stop.set()
+    for c in cams:
+        c.join(timeout=2)
+    time.sleep(1.0)          # let the last inflight drain
+    records = list(eng.stage_records)
+    eng.stop()
+    bus.close()
+
+    stage_ms = {name: [] for name, _ in STAGES}
+    for r in records:
+        key = (r["device_id"], r["ts_pub_ms"])
+        with recv_lock:
+            t_recv = recv_times.get(key)
+        if not r["ts_pub_ms"] or not r["t_collect"]:
+            continue
+        stage_ms["pub_to_collect"].append(
+            r["t_collect"] * 1000 - r["ts_pub_ms"])
+        stage_ms["collect_to_submit"].append(
+            (r["t_submit"] - r["t_collect"]) * 1000)
+        stage_ms["submit_to_drain"].append(
+            (r["t_drain0"] - r["t_submit"]) * 1000)
+        stage_ms["drain_fetch"].append(
+            (r["t_drained"] - r["t_drain0"]) * 1000)
+        stage_ms["drain_to_emit"].append(
+            (r["t_emitted"] - r["t_drained"]) * 1000)
+        if t_recv is not None:
+            stage_ms["emit_to_recv"].append(
+                (t_recv - r["t_emitted"]) * 1000)
+            stage_ms["e2e"].append(t_recv * 1000 - r["ts_pub_ms"])
+
+    return {
+        "frames_traced": len(records),
+        "stages_ms": {name: percentiles(stage_ms[name])
+                      for name, _ in STAGES},
+        "stage_legend": dict(STAGES),
+    }
+
+
+def device_batch_ms(model: str, streams: int, src_hw, iters: int) -> dict:
+    """On-chip time for one serving batch, tunnel folded out exactly like
+    bench.py (scan over iters, one dispatch+fetch, best-of-3 + contention
+    retry)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import timed_best
+    from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
+    from video_edge_ai_proxy_tpu.models import registry
+
+    spec = registry.get(model)
+    model_mod, variables = spec.init_params(jax.random.PRNGKey(0))
+    step = build_serving_step(model_mod, spec)
+
+    @jax.jit
+    def megastep(base_u8):
+        def body(carry, i):
+            out = step(variables, base_u8 + i.astype(jnp.uint8))
+            return carry + out["valid"].sum(), None
+
+        total, _ = jax.lax.scan(
+            body, jnp.zeros((), jnp.int32), jnp.arange(iters))
+        return total
+
+    rng = np.random.default_rng(0)
+    base_dev = jax.device_put(rng.integers(
+        0, 256, (streams,) + tuple(src_hw) + (3,), dtype=np.uint8))
+    np.asarray(megastep(base_dev))
+    backend = jax.default_backend()
+    elapsed, _, contended = timed_best(
+        lambda: megastep(base_dev), iters, backend, 16.0,
+        time.monotonic() + 240.0)
+    out = {"device_batch_ms": round(elapsed / iters * 1000.0, 3)}
+    if contended:
+        out["contended_device"] = True
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--model", default="yolov8n")
+    ap.add_argument("--streams", type=int, default=16)
+    ap.add_argument("--height", type=int, default=1080)
+    ap.add_argument("--width", type=int, default=1920)
+    ap.add_argument("--fps", type=float, default=30.0)
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--bus", default="shm", choices=("shm", "memory"))
+    ap.add_argument("--tick-ms", type=int, default=10)
+    ap.add_argument("--iters", type=int, default=150,
+                    help="scan length for the on-chip leg")
+    ap.add_argument("--skip-device-leg", action="store_true")
+    ap.add_argument("--record", default="")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    record = {
+        "model": args.model,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "streams": args.streams,
+        "src_hw": [args.height, args.width],
+        "fps_in": args.fps,
+        "tick_ms": args.tick_ms,
+        "bus": args.bus,
+    }
+    record.update(run(
+        args.model, args.streams, (args.height, args.width), args.fps,
+        args.duration, args.bus, args.tick_ms))
+
+    if not args.skip_device_leg:
+        record.update(device_batch_ms(
+            args.model, args.streams, (args.height, args.width), args.iters))
+        s = record["stages_ms"]
+        host = [s[k]["p50"] for k in
+                ("pub_to_collect", "collect_to_submit", "drain_to_emit",
+                 "emit_to_recv")]
+        if all(v is not None for v in host):
+            # the composition from the module docstring
+            record["production_e2e_p50_ms"] = round(
+                sum(host) + args.tick_ms + record["device_batch_ms"], 2)
+            record["sla_ms"] = 40.0
+            record["sla_met"] = record["production_e2e_p50_ms"] < 40.0
+
+    print(json.dumps(record))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,10 +20,17 @@ input perturbation against LICM, best-of-3, contention retry loop shared
 via bench.timed_best) so variants are comparable within this run; only
 within-run deltas are meaningful on this co-tenanted chip (BASELINE.md).
 One JSON line per variant + a summary line naming the winner.
+
+``--record LEVERS.json`` checks the evidence in: every variant's number
+WITH its measurement window (epoch start/end, contended flag, retries
+exhausted or not) lands in one committed artifact, so adopted-default
+claims (cpad8, BASELINE.md MFU table) can't drift from recorded data
+again (VERDICT r3 weak #2 / next #7).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -108,6 +115,7 @@ def bench_variant(name: str, base_dev, iters: int, backend: str) -> dict:
         return total
 
     np.asarray(megastep(variables, base_dev))  # compile + warm
+    t0 = time.time()
     elapsed, total, contended = timed_best(
         lambda: megastep(variables, base_dev), iters, backend, GOOD_MS,
         time.monotonic() + 240.0,
@@ -119,13 +127,36 @@ def bench_variant(name: str, base_dev, iters: int, backend: str) -> dict:
         "fps": round(STREAMS * iters / elapsed, 1)
         if base_dev.shape[0] == STREAMS else None,
         "checksum": int(total),
+        # Measurement-window metadata: co-tenant contention is the one
+        # confound on this chip (BASELINE.md); epoch bounds let any later
+        # reader align windows across artifacts.
+        "window_epoch_s": [round(t0, 1), round(time.time(), 1)],
     }
     if contended:
         out["contended_device"] = True
     return out
 
 
-def main() -> None:
+ALL_VARIANTS = ("baseline", "int8", "s2d", "s2d_int8",
+                "cpad8", "cpad16", "cpad32")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--record", default="",
+                    help="write the full evidence artifact (variants + "
+                         "windows + summary) to this JSON path")
+    ap.add_argument("--variants", default=",".join(ALL_VARIANTS),
+                    help="comma-separated subset to run")
+    args = ap.parse_args(argv)
+    variants = [v for v in args.variants.split(",") if v]
+    unknown = [v for v in variants if v not in ALL_VARIANTS]
+    if unknown:
+        # build_variant would silently fall through to the registry
+        # default (cpad8) and record the wrong program under a bogus
+        # label — the exact drift --record exists to prevent.
+        ap.error(f"unknown variants {unknown}; known: {list(ALL_VARIANTS)}")
+
     backend = jax.default_backend()
     streams = STREAMS if backend == "tpu" else 2
     iters = ITERS if backend == "tpu" else 2
@@ -137,16 +168,18 @@ def main() -> None:
     )
 
     results = []
-    for name in ("baseline", "int8", "s2d", "s2d_int8",
-                 "cpad8", "cpad16", "cpad32"):
+    for name in variants:
         r = bench_variant(name, base_dev, iters, backend)
         results.append(r)
         print(json.dumps(r), flush=True)
 
     ok = [r for r in results if not r.get("contended_device")]
-    baseline = next(r for r in results if r["variant"] == "baseline")
+    baseline = next(
+        (r for r in results if r["variant"] == "baseline"), None)
     summary: dict = {"all_uncontended": len(ok) == len(results)}
-    if baseline in ok and ok:
+    if baseline is None:
+        summary.update(winner=None, note="no baseline variant in this run")
+    elif baseline in ok and ok:
         # Within-run deltas only (co-tenanted chip): a contended baseline
         # makes every ratio a cross-window artifact — report nothing
         # rather than the wrong thing.
@@ -164,6 +197,21 @@ def main() -> None:
             note="baseline window contended; deltas not comparable — rerun",
         )
     print(json.dumps(summary), flush=True)
+
+    if args.record:
+        record = {
+            "backend": backend,
+            "device_kind": jax.devices()[0].device_kind,
+            "streams": streams,
+            "iters_per_megastep": iters,
+            "src_hw": list(src_hw),
+            "good_ms_gate": GOOD_MS,
+            "variants": results,
+            "summary": summary,
+        }
+        with open(args.record, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
 
 
 if __name__ == "__main__":

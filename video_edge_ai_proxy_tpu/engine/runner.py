@@ -122,6 +122,8 @@ class _Inflight:
     group: BatchGroup
     outputs: Any              # tree of jax.Arrays (async)
     t_submit: float
+    t_collect: float = 0.0    # wall s the collector returned this group
+                              # (stage_trace only; 0 when tracing is off)
 
 
 class InferenceEngine:
@@ -192,6 +194,13 @@ class InferenceEngine:
         # (annotation suppression already has this treatment).
         self.subscriber_drops = 0
         self.subscriber_drops_by_stream: Dict[str, int] = {}
+        # stage_trace: per-frame stage timestamps (wall s), bounded deque
+        # of dicts — see tools/bench_latency.py for the consumer.
+        import collections
+
+        self.stage_records: collections.deque = collections.deque(
+            maxlen=4096
+        )
         self._probe_cache: tuple = (0.0, None)   # (monotonic, ok | None)
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_spawn_lock = threading.Lock()
@@ -748,6 +757,7 @@ class InferenceEngine:
                 present, inferred = self._collector.partition()
                 self._collector.keep_streams_hot(device_ids=inferred)
                 groups = self._collector.collect(device_ids=inferred)
+                t_collect = time.time() if self._cfg.stage_trace else 0.0
                 submitted: List[_Inflight] = []
                 for group in groups:
                     step = self._step(group.src_hw, group.bucket, group.model)
@@ -755,7 +765,9 @@ class InferenceEngine:
                         group.model or self._spec.name
                     )
                     outputs = step(variables, self._place(group.frames))
-                    submitted.append(_Inflight(group, outputs, time.time()))
+                    submitted.append(
+                        _Inflight(group, outputs, time.time(), t_collect)
+                    )
                     self.batches += 1
                 # Drain the PREVIOUS tick's work while this tick's runs.
                 if inflight is not None:
@@ -811,7 +823,9 @@ class InferenceEngine:
     def _emit(self, inflight: _Inflight) -> None:
         group = inflight.group
         spec = self._models[group.model or self._spec.name][0]
+        t_drain0 = time.time() if self._cfg.stage_trace else 0.0
         host = {k: np.asarray(v) for k, v in inflight.outputs.items()}  # D2H
+        t_drained = time.time() if self._cfg.stage_trace else 0.0
         now_ms = int(time.time() * 1000)
         for i, device_id in enumerate(group.device_ids):
             meta = group.metas[i]
@@ -834,6 +848,17 @@ class InferenceEngine:
                 frame_packet=meta.packet,
             )
             self._publish(result)
+            if self._cfg.stage_trace:
+                self.stage_records.append({
+                    "device_id": device_id,
+                    "ts_pub_ms": meta.timestamp_ms,
+                    "t_collect": inflight.t_collect,
+                    "t_submit": inflight.t_submit,
+                    "t_drain0": t_drain0,
+                    "t_drained": t_drained,
+                    "t_emitted": time.time(),
+                    "bucket": group.bucket,
+                })
             self._annotate(device_id, meta, detections, spec)
             st = self._stats.setdefault(device_id, StreamStats())
             st.frames += 1

