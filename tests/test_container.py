@@ -315,6 +315,91 @@ class TestProcessManagerContainer:
         finally:
             m2.close()
 
+    def test_resume_adoption_disabled_respawns_container(self, pm):
+        """worker_adoption=false must mean resume = respawn even though
+        restart-always keeps the container alive across the crash —
+        previously the container path adopted unconditionally (r4 review)."""
+        manager, bus, storage, launcher = pm
+        fake = launcher.cli._exec
+        manager.start(_rec())
+        manager.detach()
+        m2 = ProcessManager(storage, bus, launcher=launcher,
+                            adopt_workers=False)
+        try:
+            runs_before = sum(1 for c in fake.calls if c[0] == "run")
+            assert m2.resume() == 1
+            # removed + freshly spawned, not adopted
+            assert sum(1 for c in fake.calls if c[0] == "run") == runs_before + 1
+            assert m2.info("cam1").state.running
+        finally:
+            m2.close()
+
+    def test_resume_daemon_blip_attaches_unverified(self, pm):
+        """A container-daemon outage at boot must not drop the camera from
+        supervision for the server's life (r4 review): the entry attaches
+        blind and self-heals when the daemon answers."""
+        manager, bus, storage, launcher = pm
+        fake = launcher.cli._exec
+        manager.start(_rec())
+        manager.detach()
+        fake.daemon_down = True
+        m2 = ProcessManager(storage, bus, launcher=launcher)
+        try:
+            assert m2.resume() == 1          # still supervised
+            assert "cam1" in m2.device_ids()
+            fake.daemon_down = False
+            m2._entries["cam1"].proc._invalidate()
+            assert m2.info("cam1").state.running   # healed, real state
+        finally:
+            m2.close()
+
+    def test_terminate_is_nonblocking(self, fake, launcher):
+        """terminate() must return immediately (Popen semantics): the
+        manager shuts cameras down in a serial loop, and a synchronous
+        `stop -t 10` would make clean shutdown O(10 s x cameras)."""
+        handle, _tail, _rt = launcher.spawn("cam1", {"device_id": "cam1"})
+        slow = {"orig": fake.__call__}
+
+        def delayed(args):
+            if args[1] == "stop":
+                time.sleep(0.5)
+            return slow["orig"](args)
+
+        launcher.cli._exec = delayed
+        t0 = time.monotonic()
+        handle.terminate()
+        assert time.monotonic() - t0 < 0.2
+        assert handle.wait(timeout=5) == 0
+
+    def test_runner_switch_removes_surviving_container(self, pm, tmp_path):
+        """runner.kind container -> subprocess between boots: the previous
+        boot's restart-always container is removed at resume so it cannot
+        publish alongside the new subprocess worker (r4 review)."""
+        manager, bus, storage, launcher = pm
+        fake = launcher.cli._exec
+        manager.start(_rec())
+        manager.detach()
+        assert "vep_cam1" in fake.containers
+        removed = []
+
+        def fake_run(args, **kw):
+            removed.append(args)
+
+            class R:
+                returncode = 0
+            return R()
+
+        import video_edge_ai_proxy_tpu.serve.process_manager as pmod
+        orig = pmod.subprocess.run
+        pmod.subprocess.run = fake_run
+        m2 = ProcessManager(storage, bus)     # subprocess runner now
+        try:
+            m2.resume()
+            assert any(a[:3] == ["docker", "rm", "-f"] for a in removed)
+        finally:
+            pmod.subprocess.run = orig
+            m2.close()
+
 
 @pytest.mark.skipif(
     not (shutil.which("docker") or shutil.which("podman")),

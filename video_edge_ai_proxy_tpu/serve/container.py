@@ -166,8 +166,18 @@ class ContainerHandle:
             self._cached = (0.0, None)
 
     def terminate(self) -> None:
-        self.cli.run(["stop", "-t", "10", self.name])
-        self._invalidate()
+        """Non-blocking, like Popen.terminate: ``stop -t 10`` blocks the
+        CLI for up to the grace period, and the manager's shutdown path
+        terminates every camera in a serial loop before waiting — a
+        synchronous stop would make clean shutdown O(10 s x cameras) and
+        get the server SIGKILLed mid-shutdown by its own supervisor.
+        ``stop`` (not ``kill``) so restart-always does not revive it."""
+        def _stop():
+            self.cli.run(["stop", "-t", "10", self.name])
+            self._invalidate()
+
+        threading.Thread(target=_stop, name=f"stop-{self.name}",
+                         daemon=True).start()
 
     def kill(self) -> None:
         self.cli.run(["kill", self.name])
@@ -298,6 +308,9 @@ class ContainerLauncher:
         return handle, tail, {
             "container": name,
             "container_id": out.strip().splitlines()[-1][:12] if out.strip() else "",
+            # Recorded so a later boot with runner.kind=subprocess can
+            # remove this restart-always survivor with the right CLI.
+            "binary": self.cli.binary,
         }
 
     def adopt(self, device_id: str, want_env: dict) -> Optional[
@@ -332,6 +345,17 @@ class ContainerLauncher:
         handle.poll()
         log.info("re-adopted container %s for %s", name, device_id)
         return handle, ContainerTail(self.cli, name)
+
+    def attach_unverified(self, device_id: str) -> tuple[ContainerHandle,
+                                                         ContainerTail]:
+        """Handle + tail for a container whose state the runtime cannot
+        currently report (daemon blip at boot). No inspect, no contract
+        check — poll() self-heals once the daemon answers: a gone
+        container reads exited and the supervisor respawns. The log tail
+        may stay empty until the camera's next restart (the ``logs
+        --follow`` child exits while the daemon is down)."""
+        name = self.name_of(device_id)
+        return ContainerHandle(self.cli, name), ContainerTail(self.cli, name)
 
     def remove(self, device_id: str) -> None:
         """Stop + delete (reference Stop: stop, remove, prune,

@@ -363,6 +363,7 @@ class ProcessManager:
         nice: int = WORKER_NICE,
         log_dir: str = "",
         launcher=None,  # serve.container.ContainerLauncher | None
+        adopt_workers: Optional[bool] = None,
     ):
         self._storage = storage
         self._bus = bus
@@ -378,6 +379,14 @@ class ProcessManager:
         self._log_dir = log_dir
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
+        # Containers ALWAYS outlive the server (restart-always), so the
+        # container runner needs the adoption intent explicitly — log_dir
+        # is "" there, yet worker_adoption=false must still mean
+        # "resume = respawn" (remove the survivor at boot). Defaults
+        # mirror the config default (worker_adoption: true) for the
+        # container runner and the log_dir convention for subprocess.
+        self._adopt = (adopt_workers if adopt_workers is not None
+                       else (launcher is not None or bool(log_dir)))
         self._bus_backend = bus_backend
         self._redis_addr = redis_addr
         self._redis_password = redis_password
@@ -744,6 +753,29 @@ class ProcessManager:
                     self._entries.pop(device_id, None)
         return count
 
+    def _kill_cross_runner_subprocess(self, device_id: str,
+                                      record: StreamProcess) -> None:
+        """A subprocess worker surviving from a runner.kind=subprocess
+        boot must die before the container runner spawns, or two
+        publishers share one ring. Only a provably-ours pid is touched."""
+        rt = record.runtime or {}
+        pid = rt.get("pid")
+        if not pid:
+            return
+        if self._identify_worker(int(pid), rt.get("starttime"),
+                                 device_id) is None:
+            return
+        log.warning(
+            "killing surviving subprocess worker %s (pid %s): runner is "
+            "now 'container'", device_id, pid,
+        )
+        proc = _AdoptedProc(int(pid), rt.get("starttime"))
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
     def _identify_worker(self, pid: int, starttime,
                          device_id: str) -> Optional[dict]:
         """The environ of the process at ``pid`` IF it is provably this
@@ -781,15 +813,68 @@ class ProcessManager:
         drift, or adoption now disabled) is killed first so the respawn is
         the only publisher on the ring; an unverifiable pid is left alone."""
         if self._launcher is not None:
-            adopted = self._launcher.adopt(
-                device_id, self._contract_env(record)
-            )
+            from .container import RuntimeUnavailable
+
+            # runner.kind switched subprocess -> container between boots:
+            # a surviving subprocess worker would publish alongside the
+            # new container — kill the provably-ours survivor first.
+            self._kill_cross_runner_subprocess(device_id, record)
+            if not self._adopt:
+                # worker_adoption=false: containers survive a crash under
+                # restart-always regardless, so honoring "resume =
+                # respawn" means removing the survivor here.
+                try:
+                    self._launcher.remove(device_id)
+                except Exception:
+                    log.warning("could not remove surviving container for "
+                                "%s; spawn will prune it", device_id)
+                return False
+            try:
+                adopted = self._launcher.adopt(
+                    device_id, self._contract_env(record)
+                )
+            except RuntimeUnavailable as exc:
+                # Daemon blip at boot must not drop the camera from
+                # supervision for the server's whole life (the same
+                # last-known-state stance ContainerHandle.poll takes).
+                # Attach blind: poll() self-heals once the daemon answers
+                # (gone container reads exited -> supervisor respawns);
+                # the env-contract check is skipped this boot — logged
+                # loudly so an operator who changed config knows.
+                log.warning(
+                    "container runtime unreachable adopting %s (%s); "
+                    "attaching unverified — env contract NOT checked",
+                    device_id, exc,
+                )
+                adopted = self._launcher.attach_unverified(device_id)
             if adopted is None:
                 return False
             entry.proc, entry.tail = adopted
             entry.last_spawn = time.monotonic()
             return True
         rt = record.runtime
+        if rt and rt.get("container"):
+            # runner.kind switched container -> subprocess: the previous
+            # boot's restart-always container would publish forever next
+            # to the new subprocess worker. Best-effort removal with the
+            # CLI recorded at its spawn.
+            binary = rt.get("binary") or "docker"
+            try:
+                subprocess.run(
+                    [binary, "rm", "-f", rt["container"]],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    timeout=30,
+                )
+                log.warning(
+                    "removed surviving container %s for %s: runner is now "
+                    "'subprocess'", rt["container"], device_id,
+                )
+            except Exception as exc:
+                log.error(
+                    "could not remove surviving container %s for %s (%s); "
+                    "it may still be publishing — remove it manually",
+                    rt["container"], device_id, exc,
+                )
         if not rt or not rt.get("pid"):
             return False
         pid = int(rt["pid"])
@@ -801,7 +886,7 @@ class ProcessManager:
         # would be adopted "live" yet publish where the new server never
         # looks — every checked key must match current config.
         want = self._contract_env(record)
-        same_contract = self._log_dir and all(
+        same_contract = self._adopt and self._log_dir and all(
             environ.get(k.encode(), b"").decode() == v
             for k, v in want.items()
         )

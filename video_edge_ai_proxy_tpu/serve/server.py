@@ -97,6 +97,10 @@ class Server:
                 if self.cfg.worker_adoption and launcher is None else ""
             ),
             launcher=launcher,
+            # Explicit: containers outlive the server regardless (restart
+            # always), so the container runner can't infer adoption intent
+            # from log_dir the way the subprocess runner does.
+            adopt_workers=self.cfg.worker_adoption,
         )
         ann_kwargs = dict(
             handler=make_batch_handler(
