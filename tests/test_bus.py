@@ -46,6 +46,40 @@ class TestFrameBus:
         assert frame.meta.is_keyframe and frame.meta.frame_type == "I"
         assert frame.meta.packet == 3
 
+    def test_read_latest_into_single_pass(self, buses):
+        """read_latest_into: the serving hot path's one-copy read. Runs
+        on every backend (shm overrides with a true single C-level pass;
+        others use the interface fallback)."""
+        prod, cons = buses
+        prod.create_stream("cam1", 32 * 24 * 3)
+        img = np.arange(32 * 24 * 3, dtype=np.uint8).reshape(24, 32, 3)
+        seq = prod.publish("cam1", img, FrameMeta(
+            width=32, height=24, channels=3, timestamp_ms=5))
+        dst = np.zeros((24, 32, 3), np.uint8)
+        res = cons.read_latest_into("cam1", dst)
+        assert isinstance(res, tuple)
+        got_seq, meta = res
+        assert got_seq == seq and meta.timestamp_ms == 5
+        np.testing.assert_array_equal(dst, img)
+        # cursor semantics identical to read_latest
+        assert cons.read_latest_into("cam1", dst, min_seq=got_seq) is None
+
+    def test_read_latest_into_geometry_mismatch_falls_back(self, buses):
+        from video_edge_ai_proxy_tpu.bus.interface import Frame
+
+        prod, cons = buses
+        prod.create_stream("cam1", 32 * 24 * 3)
+        img = np.full((24, 32, 3), 9, np.uint8)
+        prod.publish("cam1", img, FrameMeta(width=32, height=24, channels=3))
+        wrong = np.zeros((48, 64, 3), np.uint8)     # bigger than the frame
+        res = cons.read_latest_into("cam1", wrong)
+        assert isinstance(res, Frame)               # whole frame returned
+        np.testing.assert_array_equal(res.data, img)
+        smaller = np.zeros((12, 16, 3), np.uint8)   # smaller than the frame
+        res2 = cons.read_latest_into("cam1", smaller, min_seq=0)
+        assert isinstance(res2, Frame)
+        np.testing.assert_array_equal(res2.data, img)
+
     def test_latest_wins_and_cursor(self, buses):
         # Reference semantics: newest XREAD message wins, cursor advances
         # (grpc_api.go:205-222).

@@ -97,7 +97,20 @@ def device_leg(fleet: dict, src_hw, iters: int) -> dict:
                     body, jnp.zeros((), jnp.float32), jnp.arange(iters))
                 return total
 
-            np.asarray(megastep(base_dev))
+            # The dev tunnel's remote-compile RPC can drop mid-compile on
+            # big programs (observed: ~30 min wedge then broken pipe).
+            # One retry; the persistent compile cache (main) makes the
+            # retry cheap and a rerun of the whole tool cheaper still.
+            for attempt in (0, 1):
+                try:
+                    np.asarray(megastep(base_dev))
+                    break
+                except Exception as exc:
+                    if attempt:
+                        raise
+                    print(f"compile for {name} b{bucket} failed "
+                          f"({str(exc)[:120]}); retrying", flush=True)
+                    time.sleep(10)
             elapsed, _, contended = timed_best(
                 lambda m=megastep, b=base_dev: m(b), iters, backend, 50.0,
                 time.monotonic() + 240.0)
@@ -239,6 +252,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     import jax
+
+    # Persistent XLA cache: a tunnel blip mid-run costs a rerun, not a
+    # re-compile of every (model, bucket) program.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.expanduser("~/.cache/vep_tpu/xla_bench"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     src_hw = (args.height, args.width)
     record = {

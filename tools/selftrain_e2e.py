@@ -354,7 +354,7 @@ def _source_hw(ds, device_id):
 def engine_serve_metrics(model_name: str, ckpt: str, images: np.ndarray,
                          gt_boxes: np.ndarray, gt_classes: np.ndarray, *,
                          conf: float = 0.25, iou_thr: float = 0.5,
-                         deadline_s: float = 60.0) -> dict:
+                         deadline_s: float = 300.0) -> dict:
     """Serve ``ckpt`` through the REAL engine loop — frames published on
     the bus, results read off the Inference subscriber fan-out — and score
     detections against ground truth. Returns {"recall", "precision",

@@ -108,6 +108,30 @@ class FrameBus(ABC):
                 return None
             time.sleep(0.002)
 
+    def read_latest_into(
+        self, device_id: str, dst: np.ndarray, min_seq: int = 0
+    ):
+        """Newest frame with seq > min_seq copied INTO ``dst`` (a C-
+        contiguous uint8 [H, W, C] view, e.g. one slot of a pooled device
+        batch). Returns None when there is no new frame; (seq, FrameMeta)
+        after copying into ``dst``; or the whole Frame when its geometry
+        does not match ``dst`` (the caller re-groups with it — nothing is
+        lost).
+
+        The point is ONE memory pass on the serving hot path: at the
+        north-star shape the frame plane moves ~100 MB/tick, and every
+        extra pass (fresh allocations fault ~25k pages/tick) is a
+        measurable slice of the latency budget (tools/bench_latency host
+        leg). The default implementation wraps read_latest (two passes —
+        correct everywhere, fast path only where overridden)."""
+        frame = self.read_latest(device_id, min_seq=min_seq)
+        if frame is None:
+            return None
+        if frame.data.shape != dst.shape or frame.data.dtype != dst.dtype:
+            return frame
+        np.copyto(dst, frame.data)
+        return frame.seq, frame.meta
+
     @abstractmethod
     def streams(self) -> list[str]:
         """Device ids with a live ring."""

@@ -135,6 +135,7 @@ class ShmFrameBus(FrameBus):
         # handle table. Reads serialize on a ~ms memcpy; the reference
         # serialized the same path on a single-threaded Redis server.
         self._buf = np.empty(4 << 20, dtype=np.uint8)
+        self._expected_bytes: dict[str, int] = {}  # read_latest fast path
         self._lock = threading.RLock()
         self._closed = False
 
@@ -279,20 +280,45 @@ class ShmFrameBus(FrameBus):
             h = self._handle(device_id)
             if h is None:
                 return None
-            while True:
+            # Fast path: the C reader writes straight into a fresh exact-
+            # size destination (frame size per stream is stable), so the
+            # returned array IS the read target — one memory pass, not a
+            # persistent-scratch read plus a .copy(). At 16 x 1080p the
+            # frame plane moves ~100 MB per tick; the second pass was
+            # ~half the collector's measured host cost (bench_latency
+            # host leg). Geometry changes fall back to the scratch path
+            # once and re-cache.
+            expected = self._expected_bytes.get(device_id, 0)
+            raw = None
+            if expected:
+                dst = np.empty(expected, dtype=np.uint8)
                 seq = self._lib.vb_ring_read_latest(
-                    h, min_seq, _u8ptr(self._buf), self._buf.nbytes,
+                    h, min_seq, _u8ptr(dst), dst.nbytes,
                     ctypes.byref(out_len), ctypes.byref(cm),
                 )
-                if seq == ctypes.c_uint64(-1).value:  # buffer too small
-                    self._buf = np.empty(int(out_len.value) * 2, dtype=np.uint8)
-                    continue
-                break
+                if seq == ctypes.c_uint64(-1).value:
+                    expected = 0        # grew: take the scratch path
+                elif seq != 0 and int(out_len.value) == expected:
+                    raw = dst           # zero extra copies
+            if raw is None:
+                while True:
+                    seq = self._lib.vb_ring_read_latest(
+                        h, min_seq, _u8ptr(self._buf), self._buf.nbytes,
+                        ctypes.byref(out_len), ctypes.byref(cm),
+                    )
+                    if seq == ctypes.c_uint64(-1).value:  # buffer too small
+                        self._buf = np.empty(
+                            int(out_len.value) * 2, dtype=np.uint8
+                        )
+                        continue
+                    break
+                if seq != 0:
+                    raw = self._buf[: int(out_len.value)].copy()
             if seq == 0:
                 return None
             n = int(out_len.value)
+            self._expected_bytes[device_id] = n
             h_, w_, c_ = int(cm.height), int(cm.width), int(cm.channels)
-            raw = self._buf[:n].copy()
         data = raw.reshape(h_, w_, c_) if h_ * w_ * c_ == n else raw
         meta = FrameMeta(
             width=w_, height=h_, channels=c_,
@@ -303,6 +329,47 @@ class ShmFrameBus(FrameBus):
             time_base=float(cm.time_base),
         )
         return Frame(seq=int(seq), data=data, meta=meta)
+
+    def read_latest_into(self, device_id: str, dst, min_seq: int = 0):
+        """Single-pass override (see interface.py): the C seqlock reader
+        writes straight into ``dst`` — ring to device-batch slot with no
+        intermediate frame buffer. Geometry drift (frame bytes != dst
+        bytes) falls back to read_latest and returns the Frame."""
+        if not dst.flags["C_CONTIGUOUS"] or dst.dtype != np.uint8:
+            raise ValueError("dst must be a C-contiguous uint8 array")
+        out_len = ctypes.c_uint64(0)
+        cm = _CFrameMeta()
+        with self._lock:
+            h = self._handle(device_id)
+            if h is None:
+                return None
+            seq = self._lib.vb_ring_read_latest(
+                h, min_seq, _u8ptr(dst.reshape(-1)), dst.nbytes,
+                ctypes.byref(out_len), ctypes.byref(cm),
+            )
+        if seq == ctypes.c_uint64(-1).value:   # frame larger than dst
+            return self.read_latest(device_id, min_seq)
+        if seq == 0:
+            return None
+        if (int(out_len.value) != dst.nbytes
+                or (int(cm.height), int(cm.width), int(cm.channels))
+                != dst.shape):
+            # smaller frame / geometry change: dst holds a partial write —
+            # re-read the frame whole so nothing serves half-written rows
+            return self.read_latest(device_id, min_seq)
+        self._expected_bytes[device_id] = int(out_len.value)
+        meta = FrameMeta(
+            width=int(cm.width), height=int(cm.height),
+            channels=int(cm.channels),
+            timestamp_ms=int(cm.timestamp_ms), pts=int(cm.pts),
+            dts=int(cm.dts), packet=int(cm.packet),
+            keyframe_cnt=int(cm.keyframe_cnt),
+            is_keyframe=bool(cm.is_keyframe),
+            is_corrupt=bool(cm.is_corrupt),
+            frame_type=FRAME_TYPE_NAMES.get(int(cm.frame_type), ""),
+            time_base=float(cm.time_base),
+        )
+        return int(seq), meta
 
     def streams(self) -> list[str]:
         out = []
@@ -323,6 +390,7 @@ class ShmFrameBus(FrameBus):
             self._writer.discard(device_id)
             self._writer_params.pop(device_id, None)
             self._inodes.pop(device_id, None)
+            self._expected_bytes.pop(device_id, None)
             try:
                 os.unlink(self._ring_path(device_id))
             except FileNotFoundError:
