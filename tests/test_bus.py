@@ -202,6 +202,32 @@ class TestShmSpecific:
         f = cons.read_latest("cam")
         np.testing.assert_array_equal(f.data, img)
 
+    def test_fast_path_frames_never_alias(self, shm_dir):
+        """Consecutive read_latest() calls on the fast path must return
+        Frames backed by DISTINCT buffers: the pre-allocated destination
+        is owned by the bus only until a frame is handed out (ownership
+        transfer), so a later read can never overwrite an earlier
+        caller's pixels. Also: idle fast-path ticks return None without
+        consuming the cached destination."""
+        prod = open_bus("shm", shm_dir)
+        cons = open_bus("shm", shm_dir)
+        prod.create_stream("cam", 32 * 32 * 3)
+        frames = []
+        seq = 0
+        for v in (1, 2, 3):
+            img = np.full((32, 32, 3), v, dtype=np.uint8)
+            prod.publish("cam", img, FrameMeta(timestamp_ms=v))
+            f = cons.read_latest("cam", min_seq=seq)
+            seq = f.seq
+            frames.append(f)
+            # idle read between frames: fast path (after the first read
+            # cached geometry) must return None and keep its cached dst
+            assert cons.read_latest("cam", min_seq=seq) is None
+        for v, f in zip((1, 2, 3), frames):
+            assert (f.data == v).all()     # earlier frames survive later reads
+        assert len({id(f.data.base if f.data.base is not None else f.data)
+                    for f in frames}) == 3
+
     def test_writer_self_heals_replaced_ring_file(self, shm_dir):
         """The ring file vanishes/gets replaced under its producer (wiped
         shm dir, tmpfiles cleaner, or a second supervisor racing for the

@@ -111,6 +111,39 @@ class TestCollector:
         assert g4[0].frames.base is g2[0].frames.base   # pair reused
         assert g4[0].frames[0, 0, 0, 0] in (40, 41, 42)
 
+    def test_three_same_shape_groups_one_tick_distinct_buffers(self, bus):
+        """Three models over same-geometry cameras build three same-shape
+        groups in ONE tick; each must get its own pooled buffer — with a
+        2-buffer rotating pool the 3rd handout aliased the 1st group and
+        overwrote its frames before collect() returned (wrong pixels
+        served under the wrong stream/model)."""
+        models = {"cam0": "m_a", "cam1": "m_b", "cam2": "m_c"}
+        for i in range(3):
+            bus.create_stream(f"cam{i}", 64 * 64 * 3)
+            _publish(bus, f"cam{i}", value=10 + i)
+        col = Collector(bus, buckets=(1, 2, 4),
+                        model_of=lambda d: (models[d], 0))
+        col.collect()                      # first sight: cache geometry
+        for i in range(3):
+            _publish(bus, f"cam{i}", value=50 + i)
+        groups = col.collect()             # fast path: 3 groups, 1 shape
+        assert len(groups) == 3
+        bases = {id(g.frames.base) for g in groups}
+        assert len(bases) == 3             # no aliasing within the tick
+        for g in groups:
+            i = int(g.device_ids[0][-1])
+            assert g.model == models[f"cam{i}"]
+            assert g.frames[0, 0, 0, 0] == 50 + i   # own pixels intact
+        # and the margin still holds ACROSS ticks: next tick's handouts
+        # must not reuse this tick's three buffers
+        for i in range(3):
+            _publish(bus, f"cam{i}", value=70 + i)
+        g2 = col.collect()
+        assert {id(g.frames.base) for g in g2}.isdisjoint(bases)
+        for g in groups:                   # previous tick still readable
+            i = int(g.device_ids[0][-1])
+            assert g.frames[0, 0, 0, 0] == 50 + i
+
     def test_fast_path_geometry_drift_regroups(self, bus):
         """A camera that changes resolution mid-stream must not serve into
         the old-geometry batch: the drifted frame spills to the generic

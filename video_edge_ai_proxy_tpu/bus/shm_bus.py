@@ -136,6 +136,7 @@ class ShmFrameBus(FrameBus):
         # serialized the same path on a single-threaded Redis server.
         self._buf = np.empty(4 << 20, dtype=np.uint8)
         self._expected_bytes: dict[str, int] = {}  # read_latest fast path
+        self._fast_dst: dict[str, np.ndarray] = {}  # pre-alloc'd fast dst
         self._lock = threading.RLock()
         self._closed = False
 
@@ -291,15 +292,25 @@ class ShmFrameBus(FrameBus):
             expected = self._expected_bytes.get(device_id, 0)
             raw = None
             if expected:
-                dst = np.empty(expected, dtype=np.uint8)
+                # The destination is allocated once and kept until a frame
+                # is actually handed to a caller — idle ticks (seq == 0,
+                # the common case) reuse it and return immediately without
+                # a second C read or a multi-MB allocation.
+                dst = self._fast_dst.get(device_id)
+                if dst is None or dst.nbytes != expected:
+                    dst = np.empty(expected, dtype=np.uint8)
+                    self._fast_dst[device_id] = dst
                 seq = self._lib.vb_ring_read_latest(
                     h, min_seq, _u8ptr(dst), dst.nbytes,
                     ctypes.byref(out_len), ctypes.byref(cm),
                 )
+                if seq == 0:            # no new frame: done, one pass
+                    return None
                 if seq == ctypes.c_uint64(-1).value:
                     expected = 0        # grew: take the scratch path
-                elif seq != 0 and int(out_len.value) == expected:
+                elif int(out_len.value) == expected:
                     raw = dst           # zero extra copies
+                    del self._fast_dst[device_id]  # caller owns it now
             if raw is None:
                 while True:
                     seq = self._lib.vb_ring_read_latest(
@@ -391,6 +402,7 @@ class ShmFrameBus(FrameBus):
             self._writer_params.pop(device_id, None)
             self._inodes.pop(device_id, None)
             self._expected_bytes.pop(device_id, None)
+            self._fast_dst.pop(device_id, None)
             try:
                 os.unlink(self._ring_path(device_id))
             except FileNotFoundError:

@@ -83,7 +83,8 @@ class Collector:
         self._cursors: Dict[str, int] = {}
         self._clips: Dict[str, deque] = {}
         self._geom: Dict[str, tuple] = {}   # last-seen (h, w, c) per stream
-        self._pool: Dict[tuple, list] = {}  # pooled batch buffers (_pooled)
+        # shape -> {"bufs": [arr], "prev": set(idx), "cur": [idx]} (_pooled)
+        self._pool: Dict[tuple, dict] = {}
         self._only: Optional[set] = None   # restrict to these ids (None = all)
 
     def _stream_model(self, device_id: str):
@@ -153,27 +154,53 @@ class Collector:
             self._bus.touch_query(device_id, now_ms)
         return ids
 
+    def _begin_tick(self) -> None:
+        """Start a new pool rotation epoch (called at collect() entry).
+        Buffers backing the previous EMITTING tick's groups stay
+        off-limits — the engine's double-buffered dispatch may still be
+        reading them — and the new tick's handouts accumulate so no two
+        same-shape groups within one tick can share a buffer. Idle ticks
+        (cur drained by _unrotate) keep the existing protection window:
+        consumers hold frames from the last tick that emitted, however
+        long ago that was."""
+        for slot in self._pool.values():
+            if slot["cur"]:
+                slot["prev"] = set(slot["cur"])
+                slot["cur"] = []
+
     def _pooled(self, shape: tuple) -> np.ndarray:
-        """Rotating pair of batch buffers per shape. Reuse keeps the pages
-        warm — fresh allocations at the north-star shape fault ~25k pages
-        per tick, which measured as several times the raw memcpy floor
-        (tools/bench_latency host leg). Two buffers give one tick of
-        safety margin over the engine's double-buffered dispatch; a
-        returned BatchGroup's frames are valid until the same-shape
-        buffer has rotated twice."""
+        """Pooled batch buffer per shape. Reuse keeps the pages warm —
+        fresh allocations at the north-star shape fault ~25k pages per
+        tick, which measured as several times the raw memcpy floor
+        (tools/bench_latency host leg). Every call within one tick gets a
+        DISTINCT buffer (3 models on same-geometry cameras build 3+
+        same-shape groups per tick), and nothing handed out the previous
+        tick is reused, so a returned BatchGroup's frames stay valid for
+        one full tick of double-buffered dispatch. The pool grows to the
+        high-water mark of (this tick + last tick) same-shape groups —
+        steady state 2 buffers for the common one-group case."""
         slot = self._pool.get(shape)
         if slot is None:
-            slot = [np.zeros(shape, np.uint8), np.zeros(shape, np.uint8), 0]
+            slot = {"bufs": [], "prev": set(), "cur": []}
             self._pool[shape] = slot
-        slot[2] ^= 1
-        return slot[slot[2]]
+        busy = slot["prev"].union(slot["cur"])
+        idx = next(
+            (i for i in range(len(slot["bufs"])) if i not in busy), None
+        )
+        if idx is None:
+            slot["bufs"].append(np.zeros(shape, np.uint8))
+            idx = len(slot["bufs"]) - 1
+        slot["cur"].append(idx)
+        return slot["bufs"][idx]
 
     def _unrotate(self, shape: tuple) -> None:
-        """No group was emitted from this buffer (every read came back
-        empty): hand the slot back so idle ticks do not burn the pool's
-        one-rotation safety margin for consumers still holding the
-        previous tick's frames."""
-        self._pool[shape][2] ^= 1
+        """No group was emitted from the last-handed-out buffer (every
+        read came back empty): hand it back so idle ticks do not grow the
+        pool or burn the one-tick safety margin for consumers still
+        holding the previous tick's frames."""
+        slot = self._pool[shape]
+        if slot["cur"]:
+            slot["cur"].pop()
 
     def collect(
         self, device_ids: Optional[Sequence[str]] = None
@@ -191,6 +218,7 @@ class Collector:
         tick."""
         if device_ids is None:
             device_ids = self.inference_streams()
+        self._begin_tick()
         max_bucket = self._buckets[-1]
 
         fast_plan: Dict[tuple, list] = {}   # (model, (h,w,c)) -> [ids]
@@ -222,7 +250,10 @@ class Collector:
                         continue
                     if isinstance(res, Frame):   # geometry drifted
                         self._cursors[device_id] = res.seq
-                        self._geom[device_id] = res.data.shape
+                        if res.data.ndim == 3:   # corrupt 1-D frames must
+                            # not poison the geometry cache (generic-path
+                            # guard below applies here too)
+                            self._geom[device_id] = res.data.shape
                         spill.append((device_id, model, res))
                         continue
                     seq, meta = res

@@ -165,15 +165,22 @@ class ContainerHandle:
         with self._lock:
             self._cached = (0.0, None)
 
+    # Graceful-stop grace period. Exposed as an attribute so the process
+    # manager can size its wait() timeout ABOVE it — terminate() is async,
+    # and a container that uses most of its grace must not lose the race
+    # against an identical wait deadline and get kill()-ed at the boundary.
+    STOP_GRACE_S = 10.0
+
     def terminate(self) -> None:
-        """Non-blocking, like Popen.terminate: ``stop -t 10`` blocks the
+        """Non-blocking, like Popen.terminate: ``stop -t`` blocks the
         CLI for up to the grace period, and the manager's shutdown path
         terminates every camera in a serial loop before waiting — a
         synchronous stop would make clean shutdown O(10 s x cameras) and
         get the server SIGKILLed mid-shutdown by its own supervisor.
         ``stop`` (not ``kill``) so restart-always does not revive it."""
         def _stop():
-            self.cli.run(["stop", "-t", "10", self.name])
+            self.cli.run(["stop", "-t", str(int(self.STOP_GRACE_S)),
+                          self.name])
             self._invalidate()
 
         threading.Thread(target=_stop, name=f"stop-{self.name}",

@@ -562,8 +562,14 @@ class ProcessManager:
                 entry.desired = False
                 if entry.proc and entry.proc.poll() is None:
                     entry.proc.terminate()
+                    # Container terminate() is async with a stop grace of
+                    # STOP_GRACE_S; the wait deadline must exceed it or a
+                    # container using most of its grace gets kill()-ed at
+                    # the boundary (subprocess workers keep the plain 10).
+                    grace = getattr(entry.proc, "STOP_GRACE_S", None)
                     try:
-                        entry.proc.wait(timeout=10)
+                        entry.proc.wait(
+                            timeout=10 if grace is None else grace + 5)
                     except subprocess.TimeoutExpired:
                         entry.proc.kill()
                         entry.proc.wait(timeout=5)
@@ -1012,8 +1018,10 @@ class ProcessManager:
                 entry.proc.terminate()
         for entry in entries:
             if entry.proc and entry.proc.poll() is None:
+                grace = getattr(entry.proc, "STOP_GRACE_S", None)
                 try:
-                    entry.proc.wait(timeout=5)
+                    entry.proc.wait(
+                        timeout=5 if grace is None else grace + 5)
                 except subprocess.TimeoutExpired:
                     entry.proc.kill()
             if entry.tail is not None:
