@@ -50,6 +50,71 @@ class TestTimedBest:
         assert calls["n"] == 3 and not contended
 
 
+class TestTimedMin:
+    def test_good_value_no_retry(self):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            return 0.001
+
+        best, contended = bench.timed_min(
+            fn, good_s=1.0, backend="tpu", deadline=time.monotonic() + 60)
+        assert calls["n"] == 3 and not contended and best == 0.001
+
+    def test_contended_flag_at_deadline(self):
+        best, contended = bench.timed_min(
+            lambda: 99.0, good_s=0.1, backend="tpu",
+            deadline=time.monotonic() - 1)
+        assert contended and best == 99.0
+
+
+class TestIntegrity:
+    def test_zero_class_prior_zeroes_only_head_bias(self):
+        import jax
+
+        from video_edge_ai_proxy_tpu.models import registry
+
+        spec = registry.get("tiny_yolov8")
+        _, variables = spec.init_params(jax.random.PRNGKey(0))
+        out = bench.zero_class_prior(variables)
+
+        def find(tree, pred, path=()):
+            hits = []
+            if isinstance(tree, dict):
+                for k, v in tree.items():
+                    hits += find(v, pred, path + (k,))
+            elif pred(path):
+                hits.append((path, tree))
+            return hits
+
+        cls_bias = find(out, lambda p: any(
+            isinstance(s, str) and s.startswith("cls") and s.endswith("_out")
+            for s in p) and p[-1] == "bias")
+        assert cls_bias, "no class-head bias found"
+        for _, arr in cls_bias:
+            assert not np.asarray(arr).any()     # prior neutralized
+        # everything else untouched (e.g. some conv kernel is nonzero)
+        kernels = find(out, lambda p: p[-1] == "kernel")
+        assert any(np.asarray(a).any() for _, a in kernels)
+
+    def test_zero_checksum_fails_loudly(self, monkeypatch):
+        """The r4 failure mode (all scores below the NMS threshold ->
+        checksum 0) must abort the bench, not record a meaningless
+        artifact."""
+        import pytest
+
+        monkeypatch.setattr(
+            bench, "timed_best", lambda *a, **k: (1.0, 0, False))
+        from video_edge_ai_proxy_tpu.models import registry
+
+        real_get = registry.get
+        monkeypatch.setattr(
+            registry, "get", lambda name: real_get("tiny_yolov8"))
+        with pytest.raises(SystemExit, match="integrity"):
+            bench.main()
+
+
 class TestBenchOutputContract:
     def test_main_prints_one_json_line_with_required_keys(self, monkeypatch):
         """The driver parses exactly this contract; run main() end-to-end

@@ -129,6 +129,50 @@ class TestFrameBus:
         prod.drop_stream("a")
         assert cons.streams() == ["b"]
 
+    def test_head_probe(self, buses):
+        prod, cons = buses
+        prod.create_stream("cam1", 16 * 16 * 3)
+        h0 = cons.head("cam1")
+        assert h0 in (None, 0)   # backends without support return None
+        seq = prod.publish("cam1", np.zeros((16, 16, 3), np.uint8),
+                           FrameMeta(timestamp_ms=1))
+        h1 = cons.head("cam1")
+        if h1 is not None:
+            assert h1 == seq
+
+    def test_doorbell_contract(self, buses):
+        """Doorbell-capable backends must wake a waiter on publish and
+        time out quietly when idle; others keep sleep semantics."""
+        import threading
+        import time as _t
+
+        prod, cons = buses
+        prod.create_stream("cam1", 16 * 16 * 3)
+        tok = cons.doorbell_token()
+        t0 = _t.monotonic()
+        cons.doorbell_wait(tok, 0.05)            # idle: ~full timeout
+        assert _t.monotonic() - t0 >= 0.04
+        if not getattr(cons, "doorbell", False):
+            return
+        woke = []
+
+        def waiter():
+            t = cons.doorbell_token()
+            r = cons.doorbell_wait(t, 2.0)
+            woke.append((r, _t.monotonic()))
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        _t.sleep(0.05)
+        t_pub = _t.monotonic()
+        prod.publish("cam1", np.zeros((16, 16, 3), np.uint8),
+                     FrameMeta(timestamp_ms=2))
+        th.join(timeout=2)
+        assert woke, "doorbell waiter never woke"
+        new_tok, t_wake = woke[0]
+        assert new_tok != tok
+        assert t_wake - t_pub < 0.5              # woke on publish, not timeout
+
     def test_kv_contract(self, buses):
         # Control-key contract parity (RedisConstants.go:18-27).
         prod, cons = buses

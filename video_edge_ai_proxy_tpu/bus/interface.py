@@ -132,6 +132,37 @@ class FrameBus(ABC):
         np.copyto(dst, frame.data)
         return frame.seq, frame.meta
 
+    def head(self, device_id: str) -> Optional[int]:
+        """Latest published seq for the stream, or None when unknown /
+        unsupported. MUST be cheap (no frame copy): the incremental
+        assembly sweep probes it per planned stream per doorbell wake to
+        skip idle rings — on the shm backend it is one C load vs the
+        ~10x costlier full read_latest_into call setup."""
+        return None
+
+    # -- publish doorbell (incremental batch assembly) --
+
+    # True when this backend has a cheap publish-wakeup primitive: a
+    # consumer can block on doorbell_wait instead of sleep-polling rings.
+    # Backends without one (e.g. Redis, where a poll is a network round
+    # trip) leave it False and consumers fall back to tick-boundary
+    # collection.
+    doorbell = False
+
+    def doorbell_token(self) -> int:
+        """Current doorbell value; pass to doorbell_wait."""
+        return 0
+
+    def doorbell_wait(self, token: int, timeout_s: float) -> int:
+        """Block until any stream publishes (doorbell moved past
+        ``token``) or ``timeout_s`` elapses; returns the current token.
+        Default: plain sleep (polling semantics for doorbell-less
+        backends)."""
+        import time
+
+        time.sleep(timeout_s)
+        return self.doorbell_token()
+
     @abstractmethod
     def streams(self) -> list[str]:
         """Device ids with a live ring."""

@@ -17,11 +17,15 @@ from .interface import Frame, FrameBus, FrameMeta
 
 
 class MemoryFrameBus(FrameBus):
+    doorbell = True
+
     def __init__(self, shm_dir: str = ""):  # signature-compatible with ShmFrameBus
         self._lock = threading.Lock()
         self._rings: dict[str, deque[Frame]] = {}
         self._seq: dict[str, int] = {}
         self._kv: dict[str, str] = {}
+        self._db = threading.Condition()
+        self._db_value = 0
 
     def create_stream(self, device_id: str, frame_bytes: int, slots: int = 4) -> None:
         with self._lock:
@@ -37,7 +41,24 @@ class MemoryFrameBus(FrameBus):
             self._rings[device_id].append(
                 Frame(seq=seq, data=np.array(data, copy=True), meta=meta)
             )
-            return seq
+        with self._db:
+            self._db_value += 1
+            self._db.notify_all()
+        return seq
+
+    def doorbell_token(self) -> int:
+        with self._db:
+            return self._db_value
+
+    def doorbell_wait(self, token: int, timeout_s: float) -> int:
+        with self._db:
+            if self._db_value == token:
+                self._db.wait(timeout_s)
+            return self._db_value
+
+    def head(self, device_id: str) -> Optional[int]:
+        with self._lock:
+            return self._seq.get(device_id)
 
     def read_latest(self, device_id: str, min_seq: int = 0) -> Optional[Frame]:
         with self._lock:
@@ -75,3 +96,10 @@ class MemoryFrameBus(FrameBus):
     def kv_keys(self) -> list[str]:
         with self._lock:
             return sorted(self._kv)
+
+    def close(self) -> None:
+        # Wake doorbell waiters so nothing sleeps out a timeout against a
+        # closed bus (mirrors ShmFrameBus.close).
+        with self._db:
+            self._db_value += 1
+            self._db.notify_all()

@@ -23,16 +23,22 @@
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
+#include <ctime>
 #include <dirent.h>
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
+#ifdef __linux__
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#endif
 
 namespace {
 
 constexpr uint64_t kRingMagic = 0x56455042'52494e47ULL;  // "VEPBRING"
 constexpr uint64_t kKvMagic = 0x56455042'4b560001ULL;
+constexpr uint64_t kDoorbellMagic = 0x56455042'44420001ULL;  // "VEPB" "DB"
 constexpr uint32_t kVersion = 1;
 constexpr size_t kKeyCap = 96;
 constexpr size_t kValCap = 1024;
@@ -107,6 +113,22 @@ struct KvHeader {
 struct Kv {
   KvHeader* hdr;
   KvEntry* entries;
+  size_t map_len;
+};
+
+// Publish doorbell: one shared 32-bit counter per bus directory. Producers
+// bump it after every ring publish; a consumer assembling batches waits on
+// it (Linux futex, process-shared) instead of polling the rings on a sleep
+// loop — sub-100 µs wakeup with zero idle CPU (the incremental batch
+// assembly path, engine/collector.py assemble_until).
+struct DoorbellShm {
+  uint64_t magic;
+  uint32_t version;
+  std::atomic<uint32_t> value;
+};
+
+struct Doorbell {
+  DoorbellShm* shm;
   size_t map_len;
 };
 
@@ -376,6 +398,70 @@ int32_t vb_kv_del(void* handle, const char* key) {
     return 0;
   }
   return -1;
+}
+
+// ---- Doorbell API ----
+
+// Open (create if missing) the bus-wide publish doorbell at `path`.
+// Idempotent across processes; the init race is benign (a lost bump, and
+// every waiter has a timeout).
+void* vb_doorbell_open(const char* path) {
+  size_t mlen = 0;
+  void* p = map_file(path, sizeof(DoorbellShm), /*create=*/true, &mlen);
+  if (!p) return nullptr;
+  auto* shm = reinterpret_cast<DoorbellShm*>(p);
+  if (shm->magic != kDoorbellMagic) {
+    shm->version = kVersion;
+    shm->value.store(0, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_release);
+    shm->magic = kDoorbellMagic;
+  }
+  return new Doorbell{shm, mlen};
+}
+
+void vb_doorbell_close(void* handle) {
+  if (!handle) return;
+  auto* d = static_cast<Doorbell*>(handle);
+  munmap(d->shm, d->map_len);
+  delete d;
+}
+
+uint32_t vb_doorbell_value(void* handle) {
+  auto* d = static_cast<Doorbell*>(handle);
+  return d ? d->shm->value.load(std::memory_order_acquire) : 0;
+}
+
+// Bump the counter and wake every waiter. Called by producers after each
+// ring publish; a FUTEX_WAKE with no waiters is a ~1 µs syscall.
+void vb_doorbell_ring(void* handle) {
+  auto* d = static_cast<Doorbell*>(handle);
+  if (!d) return;
+  d->shm->value.fetch_add(1, std::memory_order_release);
+#ifdef __linux__
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(&d->shm->value), FUTEX_WAKE,
+          INT32_MAX, nullptr, nullptr, 0);
+#endif
+}
+
+// Block until the counter moves past `last` or `timeout_ms` elapses.
+// Returns the current value either way. Process-shared futex on Linux;
+// sleep-poll fallback elsewhere.
+uint32_t vb_doorbell_wait(void* handle, uint32_t last, uint32_t timeout_ms) {
+  auto* d = static_cast<Doorbell*>(handle);
+  if (!d) return 0;
+  std::atomic<uint32_t>* v = &d->shm->value;
+  uint32_t cur = v->load(std::memory_order_acquire);
+  if (cur != last) return cur;
+#ifdef __linux__
+  struct timespec ts;
+  ts.tv_sec = timeout_ms / 1000;
+  ts.tv_nsec = static_cast<long>(timeout_ms % 1000) * 1000000L;
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(v), FUTEX_WAIT, last, &ts,
+          nullptr, 0);
+#else
+  usleep(static_cast<useconds_t>(timeout_ms) * 1000);
+#endif
+  return v->load(std::memory_order_acquire);
 }
 
 // Enumerate keys (newline-joined) into `out`. Returns bytes written.

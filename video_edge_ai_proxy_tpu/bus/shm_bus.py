@@ -94,6 +94,14 @@ def _load() -> ctypes.CDLL:
     lib.vb_kv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.vb_kv_keys.restype = i64
     lib.vb_kv_keys.argtypes = [ctypes.c_void_p, p8, u64]
+    lib.vb_doorbell_open.restype = ctypes.c_void_p
+    lib.vb_doorbell_open.argtypes = [ctypes.c_char_p]
+    lib.vb_doorbell_close.argtypes = [ctypes.c_void_p]
+    lib.vb_doorbell_value.restype = u32
+    lib.vb_doorbell_value.argtypes = [ctypes.c_void_p]
+    lib.vb_doorbell_ring.argtypes = [ctypes.c_void_p]
+    lib.vb_doorbell_wait.restype = u32
+    lib.vb_doorbell_wait.argtypes = [ctypes.c_void_p, u32, u32]
     _lib = lib
     return lib
 
@@ -122,6 +130,15 @@ class ShmFrameBus(FrameBus):
         )
         if not self._kv:
             raise OSError(f"failed to open control KV in {shm_dir}")
+        # Bus-wide publish doorbell (futex): producers ring it after every
+        # vb_ring_publish; the engine's incremental batch assembler blocks
+        # on it between ticks instead of sleep-polling 16 rings on a
+        # 1-core host (engine/collector.py assemble_until).
+        self._db = self._lib.vb_doorbell_open(
+            os.path.join(shm_dir, "doorbell.db").encode()
+        )
+        if not self._db:
+            raise OSError(f"failed to open doorbell in {shm_dir}")
         # Reusable read buffer, grown on demand. One bus instance is shared
         # by every gRPC worker thread (serve/server.py wires a single bus
         # into the handler pool), so the consumer-side hot path needs a
@@ -243,6 +260,7 @@ class ShmFrameBus(FrameBus):
             raise RingSlotTooSmall(
                 f"publish failed for {device_id} ({arr.nbytes} B > slot)"
             )
+        self._lib.vb_doorbell_ring(self._db)
         return int(seq)
 
     def _writer_revalidate(self, device_id: str, h: int) -> int:
@@ -382,6 +400,34 @@ class ShmFrameBus(FrameBus):
         )
         return int(seq), meta
 
+    def head(self, device_id: str) -> Optional[int]:
+        """Latest published seq (one C load; no copy, no meta) — the
+        assembly sweep's idle-ring skip."""
+        with self._lock:
+            h = self._handle(device_id)
+            if h is None:
+                return None
+            return int(self._lib.vb_ring_head(h))
+
+    # -- doorbell --
+
+    doorbell = True
+
+    def doorbell_token(self) -> int:
+        if self._closed:
+            return 0
+        return int(self._lib.vb_doorbell_value(self._db))
+
+    def doorbell_wait(self, token: int, timeout_s: float) -> int:
+        """Process-shared futex wait: returns as soon as ANY producer
+        publishes (sub-100 µs wake), or after ``timeout_s``. No bus lock —
+        the wait must not serialize against readers, and the C call
+        releases the GIL."""
+        if self._closed:
+            return token
+        ms = max(1, int(timeout_s * 1000))
+        return int(self._lib.vb_doorbell_wait(self._db, token & 0xFFFFFFFF, ms))
+
     def streams(self) -> list[str]:
         out = []
         try:
@@ -459,3 +505,12 @@ class ShmFrameBus(FrameBus):
             if self._kv:
                 self._lib.vb_kv_close(self._kv)
                 self._kv = None
+            if self._db:
+                # Wake any waiter so nothing sleeps out a timeout against
+                # a closed bus. The one-page doorbell mapping is deliberately
+                # NOT unmapped: doorbell_wait runs without the bus lock (it
+                # must not serialize reads), so a concurrent close would
+                # otherwise race a waiter into freed memory. A page per bus
+                # instance leaks until process exit, which is bounded and
+                # harmless; rings/KV (the big mappings) still close.
+                self._lib.vb_doorbell_ring(self._db)
