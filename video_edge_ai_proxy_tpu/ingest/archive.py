@@ -53,26 +53,33 @@ class GopSegment:
 
 @dataclass
 class PacketGopSegment:
-    """One compressed GOP: av.Packet list (payloads included) + the
-    demuxer's StreamInfo for stream-copy muxing."""
+    """One compressed GOP: av.Packet list (payloads included, audio
+    interleaved when the camera has a mic) + the demuxer's StreamInfos
+    for stream-copy muxing."""
 
     device_id: str
     start_ts_ms: int
-    info: object                       # av.StreamInfo
+    info: object                       # av.StreamInfo (video)
     packets: List[object] = field(default_factory=list)  # av.Packet
+    audio_info: object = None          # av.StreamInfo (audio) or None
 
     @property
     def duration_ms(self) -> int:
-        """Packet-duration sum; dts-span fallback for cameras that ship no
-        durations (reference ``python/archive.py:45-72``)."""
+        """VIDEO packet-duration sum; dts-span fallback for cameras that
+        ship no durations (reference ``python/archive.py:45-72``).
+        Deliberate divergence: the reference sums every packet's duration,
+        which would double-count once audio packets join the group (its
+        own demux loop never delivered them); segment duration is a video
+        property, so audio packets are excluded here."""
         num, den = self.info.time_base
         scale = 1000.0 * num / den
-        total = sum(max(p.duration, 0) for p in self.packets)
+        video = [p for p in self.packets if not getattr(p, "is_audio", False)]
+        total = sum(max(p.duration, 0) for p in video)
         if total > 0:
             return int(total * scale)
         # Span over packets that carry a real dts (None = AV_NOPTS —
         # arithmetic on the raw sentinel would wrap int64).
-        valid = [p.dts for p in self.packets if p.dts is not None]
+        valid = [p.dts for p in video if p.dts is not None]
         if len(valid) >= 2:
             span = valid[-1] - valid[0]
             # Span misses the last frame's display time; pro-rate it.
@@ -146,23 +153,33 @@ class SegmentArchiver:
 
     @staticmethod
     def _write_stream_copy(path: str, seg: PacketGopSegment) -> None:
-        """Mux the compressed GOP, pts/dts rebased so the segment starts at
-        0 (reference ``python/archive.py:81-84``). No transcode."""
+        """Mux the compressed GOP, pts/dts rebased so the segment starts
+        at 0 (reference ``python/archive.py:81-84``) — PER STREAM: audio
+        and video run different clocks, so each rebases from its own
+        first timestamp (the reference subtracted one minimum across
+        both, which only worked because its demux loop never delivered
+        audio). Audio muxes into the same MP4 when the camera has a mic
+        (reference ``archive.py:78-79,95-97``). No transcode."""
         from .av import StreamCopyMuxer
 
-        # GOP head may carry no dts (AV_NOPTS -> None): rebase from the
-        # first packet carrying any timestamp (dts, else pts — equal at
-        # a GOP head); if none do, write unrebased and let libav derive.
-        base = next(
-            (p.dts if p.dts is not None else p.pts
-             for p in seg.packets
-             if p.dts is not None or p.pts is not None),
-            0,
-        )
-        mux = StreamCopyMuxer(path, seg.info)
+        def first_ts(pkts):
+            # A stream head may carry no dts (AV_NOPTS -> None): rebase
+            # from the first packet carrying any timestamp (dts, else
+            # pts); if none do, write unrebased and let libav derive.
+            return next(
+                (p.dts if p.dts is not None else p.pts
+                 for p in pkts
+                 if p.dts is not None or p.pts is not None),
+                0,
+            )
+
+        is_audio = lambda p: getattr(p, "is_audio", False)  # noqa: E731
+        base = first_ts([p for p in seg.packets if not is_audio(p)])
+        abase = first_ts([p for p in seg.packets if is_audio(p)])
+        mux = StreamCopyMuxer(path, seg.info, audio_info=seg.audio_info)
         with mux:
             for pkt in seg.packets:
-                mux.write(pkt, ts_offset=base)
+                mux.write(pkt, ts_offset=abase if is_audio(pkt) else base)
 
     @staticmethod
     def _write_mp4(path: str, seg: GopSegment) -> bool:

@@ -46,6 +46,8 @@ class _CStreamInfo(ctypes.Structure):
         ("fps_num", ctypes.c_int32),
         ("fps_den", ctypes.c_int32),
         ("extradata_len", ctypes.c_int32),
+        ("sample_rate", ctypes.c_int32),
+        ("channels", ctypes.c_int32),
         ("codec_name", ctypes.c_char * 32),
     ]
 
@@ -58,7 +60,7 @@ class _CPacketMeta(ctypes.Structure):
         ("size", ctypes.c_int32),
         ("is_keyframe", ctypes.c_int32),
         ("is_corrupt", ctypes.c_int32),
-        ("_pad", ctypes.c_int32),
+        ("is_audio", ctypes.c_int32),
     ]
 
 
@@ -102,7 +104,9 @@ def _load() -> ctypes.CDLL:
             ctypes.c_char_p, i64, ctypes.c_char_p, ctypes.c_char_p, i32,
         ]
         lib.va_stream_info.argtypes = [vp, ctypes.POINTER(_CStreamInfo)]
+        lib.va_audio_info.argtypes = [vp, ctypes.POINTER(_CStreamInfo)]
         lib.va_extradata.argtypes = [vp, p8, i32]
+        lib.va_audio_extradata.argtypes = [vp, p8, i32]
         lib.va_read.argtypes = [vp, ctypes.POINTER(_CPacketMeta)]
         lib.va_pkt_data.argtypes = [vp, p8, i32]
         lib.va_decode.argtypes = [vp, p8, i64, ctypes.POINTER(_CFrameMeta)]
@@ -111,10 +115,22 @@ def _load() -> ctypes.CDLL:
         lib.vm_open.restype = vp
         lib.vm_open.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(_CStreamInfo),
-            p8, i32, ctypes.c_char_p, ctypes.c_char_p, i32,
+            p8, i32, ctypes.POINTER(_CStreamInfo), p8, i32,
+            ctypes.c_char_p, ctypes.c_char_p, i32,
         ]
         lib.vm_write.argtypes = [vp, p8, i32, i64, i64, i64, i32]
+        lib.vm_write_audio.argtypes = [vp, p8, i32, i64, i64, i64]
         lib.vm_close.argtypes = [vp]
+        lib.vca_open.restype = vp
+        lib.vca_open.argtypes = [
+            ctypes.c_char_p, i32, i32, ctypes.c_char_p, i32,
+        ]
+        lib.vca_frame_size.argtypes = [vp]
+        lib.vca_info.argtypes = [vp, ctypes.POINTER(_CStreamInfo)]
+        lib.vca_extradata.argtypes = [vp, p8, i32]
+        lib.vca_send.argtypes = [vp, ctypes.POINTER(ctypes.c_float), i64]
+        lib.vca_receive.argtypes = [vp, ctypes.POINTER(_CPacketMeta), p8, i32]
+        lib.vca_close.argtypes = [vp]
         lib.vc_open.restype = vp
         lib.vc_open.argtypes = [
             ctypes.c_char_p, i32, i32, i32, i32, i32, i64, i32,
@@ -167,6 +183,8 @@ class StreamInfo:
     time_base: Tuple[int, int]     # (num, den) of pts/dts units
     fps: float
     extradata: bytes = b""
+    sample_rate: int = 0           # audio streams only
+    channels: int = 0              # audio streams only
 
     @classmethod
     def _from_c(cls, c: _CStreamInfo, extradata: bytes = b"") -> "StreamInfo":
@@ -178,6 +196,8 @@ class StreamInfo:
             time_base=(int(c.tb_num), int(c.tb_den) or 1),
             fps=(c.fps_num / den) if c.fps_num else 0.0,
             extradata=extradata,
+            sample_rate=int(c.sample_rate),
+            channels=int(c.channels),
         )
 
     def _to_c(self) -> _CStreamInfo:
@@ -188,6 +208,8 @@ class StreamInfo:
         fps = self.fps or 30.0
         c.fps_num, c.fps_den = int(round(fps * 1000)), 1000
         c.extradata_len = len(self.extradata)
+        c.sample_rate = self.sample_rate
+        c.channels = self.channels
         c.codec_name = self.codec_name.encode()[:31]
         return c
 
@@ -207,9 +229,11 @@ def _ts(v: int) -> Optional[int]:
 
 @dataclass
 class Packet:
-    """One demuxed compressed packet (timestamps in stream time_base).
-    ``pts``/``dts`` are None when the source supplied no timestamp
-    (libav AV_NOPTS_VALUE)."""
+    """One demuxed compressed packet (timestamps in its OWN stream's
+    time_base — audio and video run different clocks). ``pts``/``dts``
+    are None when the source supplied no timestamp (libav
+    AV_NOPTS_VALUE); ``is_audio`` marks packets of the demuxed audio
+    stream (stream-copy consumers only — never decoded here)."""
 
     pts: Optional[int]
     dts: Optional[int]
@@ -217,6 +241,7 @@ class Packet:
     is_keyframe: bool
     is_corrupt: bool
     data: bytes
+    is_audio: bool = False
 
 
 class PacketDemuxer:
@@ -244,6 +269,17 @@ class PacketDemuxer:
             n = lib.va_extradata(self._h, _u8(buf), buf.nbytes)
             extradata = bytes(buf[:n]) if n > 0 else b""
         self.info = StreamInfo._from_c(c, extradata)
+        # Audio stream (camera mic), when present: stream-copy consumers
+        # (archive mux, RTMP relay) carry it through; None otherwise.
+        self.audio_info: Optional[StreamInfo] = None
+        ca = _CStreamInfo()
+        if lib.va_audio_info(self._h, ctypes.byref(ca)) == 0:
+            a_extra = b""
+            if ca.extradata_len > 0:
+                buf = np.empty(int(ca.extradata_len), np.uint8)
+                n = lib.va_audio_extradata(self._h, _u8(buf), buf.nbytes)
+                a_extra = bytes(buf[:n]) if n > 0 else b""
+            self.audio_info = StreamInfo._from_c(ca, a_extra)
         self._meta = _CPacketMeta()
         self._fmeta = _CFrameMeta()
         w = max(self.info.width, 16)
@@ -271,7 +307,7 @@ class PacketDemuxer:
         return Packet(
             pts=_ts(m.pts), dts=_ts(m.dts), duration=int(m.duration),
             is_keyframe=bool(m.is_keyframe), is_corrupt=bool(m.is_corrupt),
-            data=data,
+            data=data, is_audio=bool(m.is_audio),
         )
 
     def packet_data(self) -> bytes:
@@ -341,10 +377,13 @@ class PacketDemuxer:
 class StreamCopyMuxer:
     """Writes compressed packets into MP4/FLV/RTMP without transcoding —
     bit-exact, ~zero CPU (reference ``python/archive.py:75-100`` and
-    ``rtsp_to_rtmp.py:163-182``)."""
+    ``rtsp_to_rtmp.py:163-182``). With ``audio_info`` the container
+    carries the camera's audio stream too (reference audio carry-through,
+    ``archive.py:78-79``, ``rtsp_to_rtmp.py:87-89``); audio packets route
+    by ``Packet.is_audio`` and rebase in THEIR stream's time base."""
 
     def __init__(self, url: str, info: StreamInfo, format: str = "",
-                 options: str = ""):
+                 options: str = "", audio_info: Optional[StreamInfo] = None):
         """``options`` is a "k=v:k=v" AVOption string for the muxer/protocol
         (e.g. ``rtsp_flags=listen`` makes the RTSP muxer serve one client —
         the tests' stand-in for a real camera)."""
@@ -353,9 +392,17 @@ class StreamCopyMuxer:
         c = info._to_c()
         extra = np.frombuffer(info.extradata, np.uint8).copy() if info.extradata \
             else np.empty(0, np.uint8)
+        ca = audio_info._to_c() if audio_info is not None else None
+        a_extra = (
+            np.frombuffer(audio_info.extradata, np.uint8).copy()
+            if audio_info is not None and audio_info.extradata
+            else np.empty(0, np.uint8)
+        )
         self._h = lib.vm_open(
             url.encode(), format.encode(), ctypes.byref(c),
             _u8(extra) if extra.size else None, extra.size,
+            ctypes.byref(ca) if ca is not None else None,
+            _u8(a_extra) if a_extra.size else None, a_extra.size,
             options.encode(), err, _ERRCAP,
         )
         if not self._h:
@@ -364,15 +411,33 @@ class StreamCopyMuxer:
                 f"{err.value.decode(errors='replace')}"
             )
         self._lib = lib
+        self.has_audio = audio_info is not None
         self.packets = 0
+        self.audio_packets = 0
 
     def write(self, pkt: Packet, ts_offset: int = 0) -> None:
-        """Write one packet; ``ts_offset`` rebases pts/dts (the archive
-        rebases each segment to 0 like the reference, archive.py:81-84).
-        A None pts/dts goes through as AV_NOPTS_VALUE unrebased —
-        av_packet_rescale_ts preserves the sentinel and the muxer derives
-        what it can."""
+        """Write one packet; ``ts_offset`` rebases pts/dts in the PACKET's
+        own stream time base (the archive rebases each segment to 0 like
+        the reference, archive.py:81-84 — but per stream, since audio and
+        video clocks differ). A None pts/dts goes through as
+        AV_NOPTS_VALUE unrebased — av_packet_rescale_ts preserves the
+        sentinel and the muxer derives what it can. Audio packets on a
+        video-only muxer are dropped silently (reference behavior when no
+        audio output stream exists, rtsp_to_rtmp.py:174-180)."""
         data = np.frombuffer(pkt.data, np.uint8)
+        if pkt.is_audio:
+            if not self.has_audio:
+                return
+            rc = self._lib.vm_write_audio(
+                self._h, _u8(data), data.size,
+                AV_NOPTS_VALUE if pkt.pts is None else pkt.pts - ts_offset,
+                AV_NOPTS_VALUE if pkt.dts is None else pkt.dts - ts_offset,
+                max(pkt.duration, 0),
+            )
+            if rc < 0:
+                raise IOError(f"mux audio write error: {_strerror(rc)}")
+            self.audio_packets += 1
+            return
         rc = self._lib.vm_write(
             self._h, _u8(data), data.size,
             AV_NOPTS_VALUE if pkt.pts is None else pkt.pts - ts_offset,
@@ -468,16 +533,106 @@ class Encoder:
         self.close()
 
 
+class AudioEncoder:
+    """Interleaved float PCM -> compressed audio packets (AAC by default).
+    Exists for audio-bearing test fixtures (no ffmpeg CLI in this image)
+    and re-encode fallbacks; camera audio itself is always stream copy."""
+
+    def __init__(self, sample_rate: int = 48000, channels: int = 1,
+                 codec: str = "aac"):
+        lib = _load()
+        err = ctypes.create_string_buffer(_ERRCAP)
+        self._h = lib.vca_open(
+            codec.encode(), sample_rate, channels, err, _ERRCAP
+        )
+        if not self._h:
+            raise IOError(
+                f"failed to open audio encoder {codec!r}: "
+                f"{err.value.decode(errors='replace')}"
+            )
+        self._lib = lib
+        self.frame_size = int(lib.vca_frame_size(self._h))
+        self.channels = channels
+        c = _CStreamInfo()
+        lib.vca_info(self._h, ctypes.byref(c))
+        extradata = b""
+        if c.extradata_len > 0:
+            buf = np.empty(int(c.extradata_len), np.uint8)
+            n = lib.vca_extradata(self._h, _u8(buf), buf.nbytes)
+            extradata = bytes(buf[:n]) if n > 0 else b""
+        self.info = StreamInfo._from_c(c, extradata)
+        self._meta = _CPacketMeta()
+        self._buf = np.empty(1 << 16, np.uint8)
+
+    def _receive_all(self) -> list[Packet]:
+        out = []
+        while True:
+            n = self._lib.vca_receive(
+                self._h, ctypes.byref(self._meta), _u8(self._buf),
+                self._buf.nbytes,
+            )
+            if n in (0, VA_EOF):
+                return out
+            if n < 0:
+                raise IOError(f"audio encode error: {_strerror(n)}")
+            m = self._meta
+            out.append(Packet(
+                pts=_ts(m.pts), dts=_ts(m.dts), duration=int(m.duration),
+                is_keyframe=True, is_corrupt=False,
+                data=bytes(self._buf[:n]), is_audio=True,
+            ))
+
+    def encode(self, pcm: np.ndarray, pts: int = -1) -> list[Packet]:
+        """``pcm``: float32 [frame_size * channels] interleaved samples."""
+        arr = np.ascontiguousarray(pcm, dtype=np.float32)
+        if arr.size != self.frame_size * self.channels:
+            raise ValueError(
+                f"need exactly {self.frame_size * self.channels} samples, "
+                f"got {arr.size}"
+            )
+        rc = self._lib.vca_send(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), pts
+        )
+        if rc < 0:
+            raise IOError(f"audio encode send error: {_strerror(rc)}")
+        return self._receive_all()
+
+    def flush(self) -> list[Packet]:
+        self._lib.vca_send(self._h, None, -1)
+        return self._receive_all()
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.vca_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        self.close()
+
+
 def write_test_video(path: str, width: int = 320, height: int = 240,
                      frames: int = 60, fps: float = 30.0, gop: int = 10,
-                     codec: str = "libx264") -> StreamInfo:
+                     codec: str = "libx264", audio: bool = False,
+                     sample_rate: int = 48000) -> StreamInfo:
     """Encode a deterministic moving pattern to ``path`` (container guessed
     from the extension). The synthetic *encoded* fixture SURVEY.md §4 calls
-    for — real GOP structure, real keyframe flags, no cameras needed."""
+    for — real GOP structure, real keyframe flags, no cameras needed.
+    ``audio=True`` interleaves a 440 Hz AAC sine track (mono) covering the
+    same duration — the audio-bearing camera fixture for the carry-through
+    tests."""
     enc = Encoder(width, height, fps=fps, gop=gop, codec=codec)
+    aenc = AudioEncoder(sample_rate=sample_rate, channels=1) if audio else None
     with enc:
-        mux = StreamCopyMuxer(path, enc.info)
+        mux = StreamCopyMuxer(
+            path, enc.info,
+            audio_info=aenc.info if aenc is not None else None,
+        )
         with mux:
+            apts = 0
+            total_samples = int(frames / fps * sample_rate) if audio else 0
             yy = np.mgrid[0:height, 0:width][0]
             for i in range(frames):
                 frame = np.empty((height, width, 3), np.uint8)
@@ -489,6 +644,20 @@ def write_test_video(path: str, width: int = 320, height: int = 240,
                 frame[height // 4 : height // 4 + size, x : x + size] = 255
                 for pkt in enc.encode(frame, pts=i):
                     mux.write(pkt)
+                # Keep the audio clock abreast of the video clock so the
+                # muxer interleaves naturally.
+                while aenc is not None and apts < total_samples \
+                        and apts <= i / fps * sample_rate:
+                    t = (np.arange(aenc.frame_size) + apts) / sample_rate
+                    tone = (0.25 * np.sin(2 * np.pi * 440.0 * t)).astype(
+                        np.float32)
+                    for pkt in aenc.encode(tone, pts=apts):
+                        mux.write(pkt)
+                    apts += aenc.frame_size
             for pkt in enc.flush():
                 mux.write(pkt)
+            if aenc is not None:
+                for pkt in aenc.flush():
+                    mux.write(pkt)
+                aenc.close()
         return enc.info

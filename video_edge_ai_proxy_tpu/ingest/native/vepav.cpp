@@ -47,6 +47,8 @@ struct VAStreamInfo {
   int32_t fps_num;    // best-effort frame rate
   int32_t fps_den;
   int32_t extradata_len;
+  int32_t sample_rate;  // audio streams only (0 for video)
+  int32_t channels;     // audio streams only (0 for video)
   char codec_name[32];
 };
 
@@ -57,7 +59,7 @@ struct VAPacketMeta {
   int32_t size;
   int32_t is_keyframe;
   int32_t is_corrupt;
-  int32_t _pad;
+  int32_t is_audio;   // 1 = packet belongs to the demuxed audio stream
 };
 
 struct VAFrameMeta {
@@ -93,6 +95,7 @@ void set_averr(char* buf, int cap, int err) {
 struct Demux {
   AVFormatContext* fmt = nullptr;
   int vstream = -1;
+  int astream = -1;              // best audio stream, -1 when none
   AVPacket* pkt = nullptr;       // current demuxed packet
   bool pkt_valid = false;
   bool pkt_sent = false;         // current packet already fed to decoder
@@ -112,7 +115,9 @@ struct Demux {
 struct Mux {
   AVFormatContext* fmt = nullptr;
   AVStream* st = nullptr;
+  AVStream* ast = nullptr;       // optional audio stream
   AVRational in_tb{1, 90000};   // time base of pts/dts handed to vm_write
+  AVRational in_atb{1, 48000};  // time base of pts/dts handed to vm_write_audio
   bool header = false;
 };
 
@@ -295,6 +300,12 @@ void* va_open(const char* url, int64_t timeout_us, const char* options,
     delete d;
     return nullptr;
   }
+  // Audio rides along when the camera has a mic (reference intent:
+  // rtsp_to_rtmp.py:66-68 detects streams.audio[0] and threads it into
+  // the RTMP relay and MP4 archive). Absent audio is the common case.
+  d->astream =
+      av_find_best_stream(d->fmt, AVMEDIA_TYPE_AUDIO, -1, -1, nullptr, 0);
+  if (d->astream < 0) d->astream = -1;
   d->pkt = av_packet_alloc();
   return d;
 }
@@ -312,6 +323,31 @@ int va_stream_info(void* h, VAStreamInfo* out) {
   out->fps_num = fr.num;
   out->fps_den = fr.den ? fr.den : 1;
   out->extradata_len = par->extradata_size;
+  out->sample_rate = 0;
+  out->channels = 0;
+  const char* name = avcodec_get_name(par->codec_id);
+  std::snprintf(out->codec_name, sizeof out->codec_name, "%s", name);
+  return 0;
+}
+
+// Audio stream parameters, when the source has one. Returns 0 and fills
+// `out`, or -1 when there is no audio stream.
+int va_audio_info(void* h, VAStreamInfo* out) {
+  Demux* d = (Demux*)h;
+  if (d->astream < 0) return -1;
+  const AVStream* st = d->fmt->streams[d->astream];
+  const AVCodecParameters* par = st->codecpar;
+  std::memset(out, 0, sizeof *out);
+  out->codec_id = (int32_t)par->codec_id;
+  out->tb_num = st->time_base.num;
+  out->tb_den = st->time_base.den;
+  out->extradata_len = par->extradata_size;
+  out->sample_rate = par->sample_rate;
+#if LIBAVUTIL_VERSION_INT >= AV_VERSION_INT(57, 28, 100)
+  out->channels = par->ch_layout.nb_channels;
+#else
+  out->channels = par->channels;
+#endif
   const char* name = avcodec_get_name(par->codec_id);
   std::snprintf(out->codec_name, sizeof out->codec_name, "%s", name);
   return 0;
@@ -326,9 +362,22 @@ int va_extradata(void* h, uint8_t* buf, int cap) {
   return par->extradata_size;
 }
 
-// Demux the next packet of the video stream. NO codec work happens here —
-// this is the cheap phase of the reference's lazy-decode split
-// (rtsp_to_rtmp.py:141-153). 0 = packet ready, VA_EOF = end, <0 = error.
+// Audio extradata (e.g. AAC AudioSpecificConfig) for stream-copy muxing.
+int va_audio_extradata(void* h, uint8_t* buf, int cap) {
+  Demux* d = (Demux*)h;
+  if (d->astream < 0) return -1;
+  const AVCodecParameters* par = d->fmt->streams[d->astream]->codecpar;
+  if (par->extradata_size > cap) return AVERROR(ENOSPC);
+  if (par->extradata_size > 0) std::memcpy(buf, par->extradata, par->extradata_size);
+  return par->extradata_size;
+}
+
+// Demux the next packet of the video OR audio stream. NO codec work
+// happens here — this is the cheap phase of the reference's lazy-decode
+// split (rtsp_to_rtmp.py:141-153); audio packets (meta->is_audio) exist
+// only for the stream-copy consumers (archive mux, RTMP relay — the
+// audio carry-through of rtsp_to_rtmp.py:87-89,170-180 and
+// archive.py:78-96). 0 = packet ready, VA_EOF = end, <0 = error.
 int va_read(void* h, VAPacketMeta* meta) {
   Demux* d = (Demux*)h;
   while (true) {
@@ -337,7 +386,9 @@ int va_read(void* h, VAPacketMeta* meta) {
     int rc = av_read_frame(d->fmt, d->pkt);
     if (rc == AVERROR_EOF) return VA_EOF;
     if (rc < 0) return rc;
-    if (d->pkt->stream_index != d->vstream) continue;
+    if (d->pkt->stream_index != d->vstream &&
+        d->pkt->stream_index != d->astream)
+      continue;
     d->pkt_valid = true;
     d->pkt_sent = false;
     if (meta) {
@@ -347,6 +398,7 @@ int va_read(void* h, VAPacketMeta* meta) {
       meta->size = d->pkt->size;
       meta->is_keyframe = (d->pkt->flags & AV_PKT_FLAG_KEY) ? 1 : 0;
       meta->is_corrupt = (d->pkt->flags & AV_PKT_FLAG_CORRUPT) ? 1 : 0;
+      meta->is_audio = d->pkt->stream_index == d->astream ? 1 : 0;
     }
     return 0;
   }
@@ -377,7 +429,11 @@ int va_decode(void* h, uint8_t* out, int64_t cap, VAFrameMeta* fm) {
   if (d->frame_pending) {  // retry after ENOSPC: frame already dequeued
     return frame_to_bgr(d, out, cap, fm);
   }
-  if (d->pkt_valid && !d->pkt_sent) {
+  // Only VIDEO packets feed the decoder; a current audio packet behaves
+  // like "no packet" (drain any delayed frames, else 0).
+  bool feedable = d->pkt_valid && !d->pkt_sent &&
+                  d->pkt->stream_index == d->vstream;
+  if (feedable) {
     int rc = avcodec_send_packet(d->dec, d->pkt);
     if (rc == 0 || rc == AVERROR_INVALIDDATA) {
       d->pkt_sent = true;
@@ -391,7 +447,7 @@ int va_decode(void* h, uint8_t* out, int64_t cap, VAFrameMeta* fm) {
   int rc = avcodec_receive_frame(d->dec, d->frame);
   if (rc == AVERROR(EAGAIN) || rc == AVERROR_EOF) return 0;
   if (rc < 0) return rc;
-  if (d->pkt_valid && !d->pkt_sent) {
+  if (feedable && !d->pkt_sent) {
     int rc2 = avcodec_send_packet(d->dec, d->pkt);
     if (rc2 == 0 || rc2 == AVERROR_INVALIDDATA) d->pkt_sent = true;
   }
@@ -429,12 +485,16 @@ void va_close(void* h) {
 // python/archive.py:75-100) or FLV/RTMP relay (rtsp_to_rtmp.py:163-182).
 // `si` describes the *input* packets (codec, geometry, and the time base
 // pts/dts handed to vm_write are in); format is guessed from url when
-// null. `options` is an optional "k=v:k=v" AVOption string (e.g.
-// "rtsp_flags=listen" turns the RTSP muxer into a one-client server —
-// how the tests stand up a real rtsp:// camera).
+// null. `asi` (nullable) adds an audio stream — the reference's audio
+// carry-through (archive.py:78-79, rtsp_to_rtmp.py:87-89); its packets go
+// through vm_write_audio in the `asi` time base. `options` is an optional
+// "k=v:k=v" AVOption string (e.g. "rtsp_flags=listen" turns the RTSP
+// muxer into a one-client server — how the tests stand up a real rtsp://
+// camera).
 void* vm_open(const char* url, const char* format, const VAStreamInfo* si,
-              const uint8_t* extradata, int extralen, const char* options,
-              char* err, int errcap) {
+              const uint8_t* extradata, int extralen,
+              const VAStreamInfo* asi, const uint8_t* a_extradata,
+              int a_extralen, const char* options, char* err, int errcap) {
   net_init();
   Mux* m = new Mux();
   int rc = avformat_alloc_output_context2(&m->fmt, nullptr,
@@ -464,6 +524,34 @@ void* vm_open(const char* url, const char* format, const VAStreamInfo* si,
   }
   m->in_tb = {si->tb_num, si->tb_den ? si->tb_den : 90000};
   m->st->time_base = m->in_tb;  // muxer may override in write_header
+  if (asi) {
+    m->ast = avformat_new_stream(m->fmt, nullptr);
+    if (!m->ast) {
+      set_err(err, errcap, "failed to allocate audio stream");
+      avformat_free_context(m->fmt);
+      delete m;
+      return nullptr;
+    }
+    AVCodecParameters* apar = m->ast->codecpar;
+    apar->codec_type = AVMEDIA_TYPE_AUDIO;
+    apar->codec_id = (AVCodecID)asi->codec_id;
+    apar->sample_rate = asi->sample_rate;
+#if LIBAVUTIL_VERSION_INT >= AV_VERSION_INT(57, 28, 100)
+    av_channel_layout_default(&apar->ch_layout,
+                              asi->channels > 0 ? asi->channels : 2);
+#else
+    apar->channels = asi->channels > 0 ? asi->channels : 2;
+    apar->channel_layout = av_get_default_channel_layout(apar->channels);
+#endif
+    if (a_extralen > 0) {
+      apar->extradata =
+          (uint8_t*)av_mallocz(a_extralen + AV_INPUT_BUFFER_PADDING_SIZE);
+      std::memcpy(apar->extradata, a_extradata, a_extralen);
+      apar->extradata_size = a_extralen;
+    }
+    m->in_atb = {asi->tb_num, asi->tb_den ? asi->tb_den : 48000};
+    m->ast->time_base = m->in_atb;
+  }
   AVDictionary* opts = nullptr;
   if (options && *options) {
     int prc = av_dict_parse_string(&opts, options, "=", ":", 0);
@@ -505,11 +593,11 @@ void* vm_open(const char* url, const char* format, const VAStreamInfo* si,
   return m;
 }
 
-// Write one compressed packet (pts/dts/duration in the time base given at
-// vm_open). Stream copy: no codec work.
-int vm_write(void* h, const uint8_t* data, int size, int64_t pts, int64_t dts,
-             int64_t duration, int keyframe) {
-  Mux* m = (Mux*)h;
+namespace {
+
+int mux_write_stream(Mux* m, AVStream* st, AVRational in_tb,
+                     const uint8_t* data, int size, int64_t pts, int64_t dts,
+                     int64_t duration, int keyframe) {
   AVPacket* pkt = av_packet_alloc();
   if (!pkt) return AVERROR(ENOMEM);
   uint8_t* buf = (uint8_t*)av_malloc(size + AV_INPUT_BUFFER_PADDING_SIZE);
@@ -528,12 +616,35 @@ int vm_write(void* h, const uint8_t* data, int size, int64_t pts, int64_t dts,
   pkt->pts = pts;
   pkt->dts = dts;
   pkt->duration = duration;
-  pkt->stream_index = 0;
+  pkt->stream_index = st->index;
   if (keyframe) pkt->flags |= AV_PKT_FLAG_KEY;
-  av_packet_rescale_ts(pkt, m->in_tb, m->st->time_base);
+  av_packet_rescale_ts(pkt, in_tb, st->time_base);
   rc = av_interleaved_write_frame(m->fmt, pkt);
   av_packet_free(&pkt);
   return rc;
+}
+
+}  // namespace
+
+// Write one compressed VIDEO packet (pts/dts/duration in the time base
+// given at vm_open). Stream copy: no codec work.
+int vm_write(void* h, const uint8_t* data, int size, int64_t pts, int64_t dts,
+             int64_t duration, int keyframe) {
+  Mux* m = (Mux*)h;
+  return mux_write_stream(m, m->st, m->in_tb, data, size, pts, dts, duration,
+                          keyframe);
+}
+
+// Write one compressed AUDIO packet (pts/dts/duration in the `asi` time
+// base given at vm_open). Returns EINVAL when the muxer has no audio
+// stream.
+int vm_write_audio(void* h, const uint8_t* data, int size, int64_t pts,
+                   int64_t dts, int64_t duration) {
+  Mux* m = (Mux*)h;
+  if (!m->ast) return AVERROR(EINVAL);
+  // Audio packets are all sync points; KEY keeps downstream demuxers happy.
+  return mux_write_stream(m, m->ast, m->in_atb, data, size, pts, dts,
+                          duration, /*keyframe=*/1);
 }
 
 int vm_close(void* h) {
@@ -671,6 +782,151 @@ void vc_close(void* h) {
   Enc* e = (Enc*)h;
   if (!e) return;
   if (e->sws) sws_freeContext(e->sws);
+  if (e->frame) av_frame_free(&e->frame);
+  if (e->pkt) av_packet_free(&e->pkt);
+  if (e->ctx) avcodec_free_context(&e->ctx);
+  delete e;
+}
+
+// ---------------------------------------------------------- audio encode --
+
+// Audio encoder (AAC by default): interleaved float PCM in, compressed
+// packets out. Exists for the audio-bearing test fixtures (no ffmpeg CLI
+// in this image) and re-encode fallbacks — the camera path itself is
+// always stream copy.
+
+struct AEnc {
+  AVCodecContext* ctx = nullptr;
+  AVFrame* frame = nullptr;
+  AVPacket* pkt = nullptr;
+  int64_t next_pts = 0;
+};
+
+void* vca_open(const char* codec_name, int sample_rate, int channels,
+               char* err, int errcap) {
+  const AVCodec* codec = avcodec_find_encoder_by_name(codec_name);
+  if (!codec) {
+    set_err(err, errcap, "audio encoder not found");
+    return nullptr;
+  }
+  AEnc* e = new AEnc();
+  e->ctx = avcodec_alloc_context3(codec);
+  e->ctx->sample_rate = sample_rate;
+  e->ctx->sample_fmt = AV_SAMPLE_FMT_FLTP;  // ffmpeg native aac format
+  e->ctx->time_base = {1, sample_rate};
+  e->ctx->flags |= AV_CODEC_FLAG_GLOBAL_HEADER;  // extradata for MP4/FLV
+#if LIBAVUTIL_VERSION_INT >= AV_VERSION_INT(57, 28, 100)
+  av_channel_layout_default(&e->ctx->ch_layout, channels);
+#else
+  e->ctx->channels = channels;
+  e->ctx->channel_layout = av_get_default_channel_layout(channels);
+#endif
+  int rc = avcodec_open2(e->ctx, codec, nullptr);
+  if (rc < 0) {
+    set_averr(err, errcap, rc);
+    avcodec_free_context(&e->ctx);
+    delete e;
+    return nullptr;
+  }
+  e->frame = av_frame_alloc();
+  e->frame->format = AV_SAMPLE_FMT_FLTP;
+  e->frame->nb_samples = e->ctx->frame_size ? e->ctx->frame_size : 1024;
+  e->frame->sample_rate = sample_rate;
+#if LIBAVUTIL_VERSION_INT >= AV_VERSION_INT(57, 28, 100)
+  av_channel_layout_copy(&e->frame->ch_layout, &e->ctx->ch_layout);
+#else
+  e->frame->channels = channels;
+  e->frame->channel_layout = e->ctx->channel_layout;
+#endif
+  av_frame_get_buffer(e->frame, 0);
+  e->pkt = av_packet_alloc();
+  return e;
+}
+
+// Samples per frame the encoder expects in each vca_send (AAC: 1024).
+int vca_frame_size(void* h) {
+  AEnc* e = (AEnc*)h;
+  return e->ctx->frame_size ? e->ctx->frame_size : 1024;
+}
+
+int vca_info(void* h, VAStreamInfo* out) {
+  AEnc* e = (AEnc*)h;
+  std::memset(out, 0, sizeof *out);
+  out->codec_id = (int32_t)e->ctx->codec_id;
+  out->tb_num = 1;
+  out->tb_den = e->ctx->sample_rate;
+  out->sample_rate = e->ctx->sample_rate;
+#if LIBAVUTIL_VERSION_INT >= AV_VERSION_INT(57, 28, 100)
+  out->channels = e->ctx->ch_layout.nb_channels;
+#else
+  out->channels = e->ctx->channels;
+#endif
+  out->extradata_len = e->ctx->extradata_size;
+  std::snprintf(out->codec_name, sizeof out->codec_name, "%s",
+                avcodec_get_name(e->ctx->codec_id));
+  return 0;
+}
+
+int vca_extradata(void* h, uint8_t* buf, int cap) {
+  AEnc* e = (AEnc*)h;
+  if (e->ctx->extradata_size > cap) return AVERROR(ENOSPC);
+  if (e->ctx->extradata_size > 0)
+    std::memcpy(buf, e->ctx->extradata, e->ctx->extradata_size);
+  return e->ctx->extradata_size;
+}
+
+// Send vca_frame_size() samples of interleaved float PCM (null = begin
+// flush). pts < 0 auto-increments in samples.
+int vca_send(void* h, const float* interleaved, int64_t pts) {
+  AEnc* e = (AEnc*)h;
+  if (!interleaved) return avcodec_send_frame(e->ctx, nullptr);
+  int rc = av_frame_make_writable(e->frame);
+  if (rc < 0) return rc;
+#if LIBAVUTIL_VERSION_INT >= AV_VERSION_INT(57, 28, 100)
+  const int ch = e->ctx->ch_layout.nb_channels;
+#else
+  const int ch = e->ctx->channels;
+#endif
+  const int n = e->frame->nb_samples;
+  for (int c = 0; c < ch; ++c) {
+    float* plane = (float*)e->frame->data[c];
+    for (int i = 0; i < n; ++i) plane[i] = interleaved[i * ch + c];
+  }
+  e->frame->pts = pts >= 0 ? pts : e->next_pts;
+  e->next_pts = e->frame->pts + n;
+  return avcodec_send_frame(e->ctx, e->frame);
+}
+
+// Receive one encoded packet: size on success, 0 when the encoder needs
+// more input, VA_EOF when fully flushed, <0 on error.
+int vca_receive(void* h, VAPacketMeta* meta, uint8_t* buf, int cap) {
+  AEnc* e = (AEnc*)h;
+  int rc = avcodec_receive_packet(e->ctx, e->pkt);
+  if (rc == AVERROR(EAGAIN)) return 0;
+  if (rc == AVERROR_EOF) return VA_EOF;
+  if (rc < 0) return rc;
+  if (e->pkt->size > cap) {
+    av_packet_unref(e->pkt);
+    return AVERROR(ENOSPC);
+  }
+  std::memcpy(buf, e->pkt->data, e->pkt->size);
+  if (meta) {
+    meta->pts = e->pkt->pts;
+    meta->dts = e->pkt->dts;
+    meta->duration = e->pkt->duration;
+    meta->size = e->pkt->size;
+    meta->is_keyframe = 1;
+    meta->is_corrupt = 0;
+    meta->is_audio = 1;
+  }
+  int size = e->pkt->size;
+  av_packet_unref(e->pkt);
+  return size;
+}
+
+void vca_close(void* h) {
+  AEnc* e = (AEnc*)h;
+  if (!e) return;
   if (e->frame) av_frame_free(&e->frame);
   if (e->pkt) av_packet_free(&e->pkt);
   if (e->ctx) avcodec_free_context(&e->ctx);

@@ -46,14 +46,20 @@ class PacketPassthroughWriter:
     # timeout.
     RETRY_COOLDOWN_S = 2.0
 
-    def __init__(self, endpoint: str, info, max_buffer_bytes: int = 16 << 20):
+    def __init__(self, endpoint: str, info, audio_info=None,
+                 max_buffer_bytes: int = 16 << 20):
         self.endpoint = endpoint
         self.info = info                     # av.StreamInfo of the source
+        # Camera-mic audio rides the relay when present (reference
+        # rtsp_to_rtmp.py:87-89,170-180); audio packets buffer in the GOP
+        # alongside video and rebase on their own stream clock.
+        self.audio_info = audio_info
         self._gop: Deque = deque()           # av.Packet of the current GOP
         self._gop_bytes = 0
         self._max_buffer_bytes = max_buffer_bytes
         self._mux = None
         self._base_ts: Optional[int] = None  # first valid relayed dts -> 0
+        self._base_ats: Optional[int] = None  # audio clock's own base
         self._started = False                # keyframe seen on this sink
         self._failed = False
         self._failed_at = 0.0
@@ -68,9 +74,11 @@ class PacketPassthroughWriter:
         return ""            # local file sinks: guess from extension
 
     def feed(self, pkt) -> None:
-        """One demuxed packet (with payload). Buffers the GOP; relays live
-        when active."""
-        if pkt.is_keyframe:
+        """One demuxed packet (with payload; video or audio). Buffers the
+        GOP; relays live when active. Only VIDEO keyframes reset the
+        buffer — AAC marks every packet KEY, and clearing on those would
+        drop the buffered GOP head."""
+        if pkt.is_keyframe and not getattr(pkt, "is_audio", False):
             self._gop.clear()
             self._gop_bytes = 0
         self._gop.append(pkt)
@@ -85,7 +93,7 @@ class PacketPassthroughWriter:
         if self.active:
             self._write(pkt)
 
-    def reset(self, info) -> None:
+    def reset(self, info, audio_info=None) -> None:
         """Source reconnected: new demuxer, new timestamps, possibly new
         codec parameters. Buffered packets from the dead stream must not be
         flushed into a sink built from the new info, and a live relay must
@@ -93,6 +101,7 @@ class PacketPassthroughWriter:
         (otherwise the first post-reconnect write produces wildly
         non-monotonic timestamps and kills the sink)."""
         self.info = info
+        self.audio_info = audio_info
         self._gop.clear()
         self._gop_bytes = 0
         if self.requested:
@@ -154,26 +163,31 @@ class PacketPassthroughWriter:
             os.makedirs(os.path.dirname(self.endpoint) or ".", exist_ok=True)
         try:
             self._mux = StreamCopyMuxer(
-                self.endpoint, self.info, format=self._format_for(self.endpoint)
+                self.endpoint, self.info,
+                format=self._format_for(self.endpoint),
+                audio_info=self.audio_info,
             )
         except IOError as exc:
             self._fail(str(exc))
             return False
         self._base_ts = None
+        self._base_ats = None
         self._started = False
         return True
 
     def _write(self, pkt) -> None:
         if self._mux is None:
             return
+        is_audio = getattr(pkt, "is_audio", False)
         if not self._started:
-            if not pkt.is_keyframe:
+            if is_audio or not pkt.is_keyframe:
                 # Fresh sink with nothing flushed yet (oversized-GOP drop,
                 # or a reconnect resume): the remote stream must begin at a
-                # keyframe to be decodable — hold until the next GOP head.
+                # VIDEO keyframe to be decodable — hold until the next GOP
+                # head (audio joins right after it).
                 return
             self._started = True
-        if self._base_ts is None:
+        if self._base_ts is None and not is_audio:
             # RTSP sources emit AV_NOPTS (None here) on early packets;
             # rebase from the first packet carrying any real timestamp
             # (dts, else pts — equal at a GOP head) so a head with pts
@@ -183,8 +197,17 @@ class PacketPassthroughWriter:
             ts = pkt.dts if pkt.dts is not None else pkt.pts
             if ts is not None:
                 self._base_ts = ts
+        if self._base_ats is None and is_audio:
+            # The audio stream runs its own clock; rebase it separately.
+            ts = pkt.dts if pkt.dts is not None else pkt.pts
+            if ts is not None:
+                self._base_ats = ts
         try:
-            self._mux.write(pkt, ts_offset=self._base_ts or 0)
+            self._mux.write(
+                pkt,
+                ts_offset=(self._base_ats if is_audio else self._base_ts)
+                or 0,
+            )
             self.written += 1
         except IOError as exc:
             self._fail(str(exc))

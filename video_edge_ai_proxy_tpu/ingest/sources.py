@@ -42,6 +42,10 @@ class PacketInfo:
     # Demuxer-flagged corruption, shipped through VideoFrame.is_corrupt
     # (reference ``read_image.py:111``: vf.is_corrupt = packet.is_corrupt).
     is_corrupt: bool = False
+    # Camera-mic audio packet (packet sources only): consumed by the
+    # stream-copy archive/relay, never decoded/published (reference audio
+    # carry-through, rtsp_to_rtmp.py:87-89,170-180 + archive.py:78-96).
+    is_audio: bool = False
 
 
 class VideoSource(ABC):
@@ -243,6 +247,12 @@ class PacketSource(VideoSource):
         """av.StreamInfo of the open demuxer (muxer construction)."""
         return self._d.info if self._d is not None else None
 
+    @property
+    def audio_info(self):
+        """av.StreamInfo of the camera's audio stream, or None — feeds
+        the archive/relay muxers' audio track (carry-through)."""
+        return self._d.audio_info if self._d is not None else None
+
     def grab(self) -> Optional[PacketInfo]:
         if self._d is None:
             return None
@@ -253,6 +263,19 @@ class PacketSource(VideoSource):
         if pkt is None:
             return None
         self._pkt = pkt
+        if pkt.is_audio:
+            ainfo = self._d.audio_info
+            num, den = ainfo.time_base if ainfo else (1, 48000)
+            return PacketInfo(
+                packet=self._n,
+                is_keyframe=False,   # audio KEY flags are not GOP heads
+                pts=pkt.pts,
+                dts=pkt.dts,
+                timestamp_ms=int(time.time() * 1000),
+                time_base=num / den,
+                is_corrupt=pkt.is_corrupt,
+                is_audio=True,
+            )
         self._n += 1
         num, den = self._d.info.time_base
         return PacketInfo(

@@ -149,6 +149,8 @@ class IngestWorker:
         self._gop_packets: list = []
         self._gop_bytes = 0
         self._gop_info = None  # StreamInfo captured at GOP open
+        self._gop_audio_info = None  # audio StreamInfo captured at GOP open
+        self._audio_packets = 0
 
     # -- control-plane reads (per packet; shm KV, nanosecond-cheap) --
 
@@ -182,6 +184,7 @@ class IngestWorker:
             "pid": os.getpid(),
             "running": not self._stop.is_set(),
             "packets": self._packets,
+            "audio_packets": self._audio_packets,
             "keyframes": self._keyframes,
             "decoded": self._decoded,
             "published": self._published,
@@ -241,14 +244,19 @@ class IngestWorker:
                     start_ts_ms=self._gop_start_ms,
                     info=self._gop_info,
                     packets=self._gop_packets,
+                    audio_info=self._gop_audio_info,
                 )
             )
         self._gop_packets = []
 
     def _archive_packet(self, pkt, is_keyframe: bool, now_ms: int) -> None:
-        """Compressed-GOP archiving (packet mode): keyframe closes the
-        previous GOP and opens a new one — same grouping as the reference's
-        demux loop (rtsp_to_rtmp.py:97-110), but with real packets."""
+        """Compressed-GOP archiving (packet mode): a VIDEO keyframe closes
+        the previous GOP and opens a new one — same grouping as the
+        reference's demux loop (rtsp_to_rtmp.py:97-110), but with real
+        packets. Audio packets (camera mic) interleave into whatever GOP
+        is open (``is_keyframe=False`` for them: AAC KEY flags are not GOP
+        heads) and mux into the segment's audio track
+        (reference archive.py:78-96)."""
         if self._archiver is None:
             return
         if self._gop_packets and (
@@ -263,6 +271,8 @@ class IngestWorker:
                 # Captured at GOP open: the source may be closed (EOF) or
                 # re-opened with new params by the time the GOP is flushed.
                 self._gop_info = self.source.stream_info
+                self._gop_audio_info = getattr(
+                    self.source, "audio_info", None)
             self._gop_packets.append(pkt)
             self._gop_bytes += len(pkt.data)
 
@@ -300,7 +310,8 @@ class IngestWorker:
                 from .passthrough import PacketPassthroughWriter
 
                 self._passthrough = PacketPassthroughWriter(
-                    cfg.rtmp_endpoint, self.source.stream_info
+                    cfg.rtmp_endpoint, self.source.stream_info,
+                    audio_info=getattr(self.source, "audio_info", None),
                 )
             else:
                 from .passthrough import PassthroughWriter
@@ -341,9 +352,34 @@ class IngestWorker:
                             # params. Stale GOP buffer and mux must go; an
                             # operator-requested relay resumes on the new
                             # stream's next keyframe.
-                            self._passthrough.reset(self.source.stream_info)
+                            self._passthrough.reset(
+                                self.source.stream_info,
+                                getattr(self.source, "audio_info", None),
+                            )
                     except ConnectionError:
                         pass
+                    continue
+
+                if getattr(pkt, "is_audio", False):
+                    # Camera-mic packet: carry through to the stream-copy
+                    # consumers (archive audio track + RTMP relay —
+                    # reference rtsp_to_rtmp.py:170-180, archive.py:78-96)
+                    # and nothing else: no decode, no frame publish, no
+                    # keyframe/fps accounting.
+                    self._audio_packets += 1
+                    self._maybe_passthrough()
+                    if self._packet_mode and (
+                        self._archiver is not None
+                        or self._passthrough is not None
+                    ):
+                        full = self.source.packet_with_data()
+                        if self._passthrough is not None:
+                            self._passthrough.feed(full)
+                        self._archive_packet(
+                            full, False, pkt.timestamp_ms)
+                    self._publish_status(time.monotonic())
+                    if cfg.max_frames and self._packets >= cfg.max_frames:
+                        break
                     continue
 
                 self._packets += 1
