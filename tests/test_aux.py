@@ -33,6 +33,32 @@ class TestCheckpoint:
         np.testing.assert_array_equal(out["a"], tree["a"])
         np.testing.assert_array_equal(out["b"]["c"], tree["b"]["c"])
 
+    def test_msgpack_meta_roundtrip_and_backcompat(self, tmp_path):
+        """Checkpoint metadata (calibrated conf_threshold) rides the same
+        file; legacy checkpoints (no meta) and meta-bearing ones both
+        restore params cleanly, and set_msgpack_meta stamps an existing
+        file without touching the tree."""
+        from video_edge_ai_proxy_tpu.utils.checkpoint import (
+            load_msgpack_meta, set_msgpack_meta,
+        )
+
+        tree = {"a": np.arange(4, dtype=np.float32)}
+        tmpl = jax.tree.map(np.zeros_like, tree)
+        legacy = str(tmp_path / "legacy.msgpack")
+        save_msgpack(legacy, tree)
+        assert load_msgpack_meta(legacy) is None
+        np.testing.assert_array_equal(load_msgpack(legacy, tmpl)["a"], tree["a"])
+        with_meta = str(tmp_path / "meta.msgpack")
+        save_msgpack(with_meta, tree, meta={"conf_threshold": 0.45})
+        assert load_msgpack_meta(with_meta) == {"conf_threshold": 0.45}
+        np.testing.assert_array_equal(
+            load_msgpack(with_meta, tmpl)["a"], tree["a"])
+        # Stamp after the fact (the calibration flow on a trained ckpt).
+        set_msgpack_meta(legacy, {"conf_threshold": 0.6, "policy": "max_f1"})
+        meta = load_msgpack_meta(legacy)
+        assert meta["conf_threshold"] == 0.6 and meta["policy"] == "max_f1"
+        np.testing.assert_array_equal(load_msgpack(legacy, tmpl)["a"], tree["a"])
+
     def test_engine_checkpoint_roundtrip(self, tmp_path):
         ckpt = str(tmp_path / "eng.msgpack")
         bus = MemoryFrameBus()

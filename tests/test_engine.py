@@ -363,6 +363,62 @@ def _engine(bus, model, annotations="auto", **cfg_kw):
     return eng
 
 
+class TestCalibratedThreshold:
+    def test_warmup_reads_conf_threshold_from_ckpt_meta(self, bus, tmp_path):
+        """The calibrated operating point rides checkpoint metadata and
+        the engine applies it: detections under the threshold never leave
+        _to_detections for the default model; per-stream extra models
+        keep the NMS floor."""
+        import jax
+
+        from video_edge_ai_proxy_tpu.models import registry
+        from video_edge_ai_proxy_tpu.parallel.sharding import unbox
+        from video_edge_ai_proxy_tpu.utils.checkpoint import save_msgpack
+
+        spec = registry.get("tiny_yolov8")
+        _, variables = spec.init_params(jax.random.PRNGKey(0))
+        ckpt = str(tmp_path / "cal.msgpack")
+        save_msgpack(
+            ckpt, jax.tree.map(np.asarray, unbox(variables)),
+            meta={"conf_threshold": 0.6},
+        )
+        eng = _engine(bus, "tiny_yolov8", checkpoint_path=ckpt)
+        assert eng._conf_threshold == 0.6
+        host = {
+            "valid": np.array([[True, True, True]]),
+            "scores": np.array([[0.9, 0.59, 0.61]], np.float32),
+            "boxes": np.array(
+                [[[0, 0, 10, 10], [5, 5, 20, 20], [8, 8, 30, 30]]],
+                np.float32),
+            "classes": np.array([[0, 1, 2]], np.int64),
+        }
+        dets = eng._to_detections(host, 0, eng._spec)
+        assert [round(d.confidence, 2) for d in dets] == [0.9, 0.61]
+        # An extra (non-default) model is NOT governed by this ckpt's
+        # calibration: same host rows all pass.
+        class _FakeSpec:
+            kind = "detect"
+            name = "other_model"
+
+        eng._models["other_model"] = (_FakeSpec(), None, None)
+        dets2 = eng._to_detections(host, 0, _FakeSpec())
+        assert len(dets2) == 3
+
+    def test_legacy_ckpt_without_meta_keeps_floor(self, bus, tmp_path):
+        import jax
+
+        from video_edge_ai_proxy_tpu.models import registry
+        from video_edge_ai_proxy_tpu.parallel.sharding import unbox
+        from video_edge_ai_proxy_tpu.utils.checkpoint import save_msgpack
+
+        spec = registry.get("tiny_yolov8")
+        _, variables = spec.init_params(jax.random.PRNGKey(0))
+        ckpt = str(tmp_path / "legacy.msgpack")
+        save_msgpack(ckpt, jax.tree.map(np.asarray, unbox(variables)))
+        eng = _engine(bus, "tiny_yolov8", checkpoint_path=ckpt)
+        assert eng._conf_threshold == 0.0
+
+
 class TestServingStep:
     def test_serving_decode_matches_decoded_path(self):
         """decode="serving" (logit-space reduction, the engine's detect

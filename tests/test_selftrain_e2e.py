@@ -63,3 +63,24 @@ def test_engine_serves_the_tuned_model_better(chain):
     recall (and should usually win)."""
     assert chain["engine_post"]["images_served"] > 0
     assert chain["engine_post"]["recall"] >= chain["engine_pre"]["recall"]
+
+
+def test_calibration_picks_and_persists_operating_point(chain):
+    """VERDICT r4 next #5: the loop sweeps the confidence threshold on
+    held-out data, picks an operating point (max-F1 with a precision
+    floor), and stamps it into checkpoint metadata that the engine
+    actually reads at warmup."""
+    from video_edge_ai_proxy_tpu.utils.checkpoint import load_msgpack_meta
+
+    cal = chain["calibration"]
+    assert 0.25 <= cal["conf_threshold"] <= 0.95
+    assert cal["policy"] in ("max_f1_with_precision_floor", "max_precision")
+    meta = load_msgpack_meta(chain["checkpoint"])
+    assert meta is not None
+    assert meta["conf_threshold"] == cal["conf_threshold"]
+    # The engine leg served WITH the calibrated threshold applied (the
+    # scorer counted raw engine output, conf=0): its precision must be at
+    # least the calibrated point's neighborhood rather than the
+    # uncalibrated firehose.
+    if cal["policy"] == "max_f1_with_precision_floor":
+        assert chain["engine_post"]["precision"] >= 0.4

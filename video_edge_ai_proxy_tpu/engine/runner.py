@@ -177,6 +177,7 @@ class InferenceEngine:
         # that keeps failing backs off harder instead of starving every
         # healthy stream with multi-second re-init attempts per tick.
         self._bad_models: Dict[str, dict] = {}
+        self._conf_threshold = 0.0   # calibrated at warmup from ckpt meta
         self._step_cache: Dict[tuple, Any] = {}
         self._collector: Optional[Collector] = None
         self._subscribers: List[tuple] = []   # (queue, device_id filter set|None)
@@ -268,10 +269,14 @@ class InferenceEngine:
         self._model, self._variables = self._spec.init_params(
             jax.random.PRNGKey(0)
         )
+        # Calibrated per-checkpoint serving threshold (selftrain loop
+        # writes it into checkpoint metadata): detections below it never
+        # leave the engine. 0.0 = no calibration -> NMS's own floor only.
+        self._conf_threshold = 0.0
         ckpt = self._cfg.checkpoint_path
         if ckpt:
             from ..parallel.sharding import unbox
-            from ..utils.checkpoint import load_msgpack
+            from ..utils.checkpoint import load_msgpack_with_meta
 
             if os.path.exists(ckpt):
                 # Checkpoints are UNBOXED raw trees (the canonical format
@@ -281,7 +286,7 @@ class InferenceEngine:
                 # mesh serving.
                 from ..models.import_weights import pad_stem_on_load
 
-                raw = load_msgpack(
+                raw, meta = load_msgpack_with_meta(
                     ckpt, jax.tree.map(np.asarray, unbox(self._variables))
                 )
                 # Pre-stem_pad_c checkpoints: zero-pad the stem kernel
@@ -296,6 +301,13 @@ class InferenceEngine:
                 # exactly what sharded serving of big models must avoid.
                 self._variables = _rebox(self._variables, raw)
                 log.info("loaded engine params from %s", ckpt)
+                thr = (meta or {}).get("conf_threshold")
+                if thr is not None:
+                    self._conf_threshold = float(thr)
+                    log.info(
+                        "serving at calibrated conf_threshold=%.3f "
+                        "(checkpoint metadata)", self._conf_threshold,
+                    )
             else:
                 log.warning("checkpoint %s missing; using random init", ckpt)
         self._variables = self._maybe_quantize(self._variables)
@@ -994,7 +1006,17 @@ class InferenceEngine:
         out: List[pb.Detection] = []
         if spec.kind == "detect":
             valid = host["valid"][i]
+            # The calibrated operating point rides the DEFAULT model's
+            # checkpoint; per-stream extra models start from init and
+            # keep the NMS floor.
+            thr = (
+                self._conf_threshold
+                if self._spec is not None and spec.name == self._spec.name
+                else 0.0
+            )
             for j in np.nonzero(valid)[0]:
+                if float(host["scores"][i, j]) < thr:
+                    continue
                 # BoundingBox carries int32 pixel coords (proto parity with
                 # the reference's AnnotateRequest consumers).
                 x1, y1, x2, y2 = (int(round(float(v))) for v in host["boxes"][i, j])

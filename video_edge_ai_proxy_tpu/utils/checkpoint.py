@@ -20,12 +20,25 @@ from typing import Any, Optional
 from flax import serialization
 
 
-def save_msgpack(path: str, tree: Any) -> None:
+# Reserved top-level key carrying checkpoint metadata (not model state):
+# calibration results (conf_threshold), provenance. Stripped before the
+# params restore, so old checkpoints (no key) and old readers (template
+# without it) both keep working.
+META_KEY = "__vep_meta__"
+
+
+def save_msgpack(path: str, tree: Any, meta: Optional[dict] = None) -> None:
     """Atomic single-file save (write temp + rename, so a crash mid-write
     never leaves a torn checkpoint — same durability stance as the
-    reference's BadgerDB registry)."""
+    reference's BadgerDB registry). ``meta``: small JSON-like dict stored
+    under META_KEY alongside the params — e.g. the calibrated serving
+    confidence threshold the engine applies per checkpoint."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    data = serialization.to_bytes(tree)
+    state = serialization.to_state_dict(tree)
+    if meta is not None:
+        state = dict(state)
+        state[META_KEY] = meta
+    data = serialization.msgpack_serialize(state)
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
     try:
         with os.fdopen(fd, "wb") as fh:
@@ -39,9 +52,56 @@ def save_msgpack(path: str, tree: Any) -> None:
 
 def load_msgpack(path: str, template: Any) -> Any:
     """Restore into the structure of ``template`` (shape/dtype validated by
-    flax deserialization)."""
+    flax deserialization). Checkpoint metadata (META_KEY), when present,
+    is stripped — read it with ``load_msgpack_meta``, or in one pass with
+    ``load_msgpack_with_meta``."""
+    return load_msgpack_with_meta(path, template)[0]
+
+
+def load_msgpack_with_meta(path: str, template: Any):
+    """(params restored into ``template``, meta dict or None) in ONE file
+    read/parse — a big checkpoint (ViT-B f32 is ~344 MB) must not be
+    decoded twice just to fetch one calibration float."""
     with open(path, "rb") as fh:
-        return serialization.from_bytes(template, fh.read())
+        raw = serialization.msgpack_restore(fh.read())
+    meta = None
+    if isinstance(raw, dict):
+        meta = raw.pop(META_KEY, None)
+        if not isinstance(meta, dict):
+            meta = None
+    return serialization.from_state_dict(template, raw), meta
+
+
+def set_msgpack_meta(path: str, meta: dict) -> None:
+    """Attach/replace metadata on an existing msgpack checkpoint without
+    touching its params (atomic rewrite) — how the calibration step stamps
+    the operating point onto an already-trained checkpoint."""
+    with open(path, "rb") as fh:
+        raw = serialization.msgpack_restore(fh.read())
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: not a dict-rooted msgpack checkpoint")
+    raw[META_KEY] = meta
+    data = serialization.msgpack_serialize(raw)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_msgpack_meta(path: str) -> Optional[dict]:
+    """Checkpoint metadata dict, or None (absent / legacy checkpoint)."""
+    with open(path, "rb") as fh:
+        raw = serialization.msgpack_restore(fh.read())
+    if isinstance(raw, dict):
+        meta = raw.get(META_KEY)
+        if isinstance(meta, dict):
+            return meta
+    return None
 
 
 def save_train_state(ckpt_dir: str, state: Any, step: Optional[int] = None) -> str:
