@@ -84,11 +84,16 @@ def device_leg(fleet: dict, src_hw, iters: int) -> dict:
                 shape = (bucket,) + tuple(src_hw) + (3,)
             base_dev = jax.device_put(
                 rng.integers(0, 256, shape, dtype=np.uint8))
+            # Params go in as an ARGUMENT, not a closure: closed-over
+            # trees bake into the program as constants, and the dev
+            # tunnel's remote-compile RPC rejects the resulting payload
+            # for big models (ViT-B/16 f32 is ~344 MB -> HTTP 413).
+            v_dev = jax.device_put(variables)
 
             @jax.jit
-            def megastep(base_u8, _step=step, _v=variables):
+            def megastep(v, base_u8, _step=step):
                 def body(carry, i):
-                    out = _step(_v, base_u8 + i.astype(jnp.uint8))
+                    out = _step(v, base_u8 + i.astype(jnp.uint8))
                     leaf = out.get("valid",
                                    next(iter(out.values())))
                     return carry + jnp.sum(leaf).astype(jnp.float32), None
@@ -103,7 +108,7 @@ def device_leg(fleet: dict, src_hw, iters: int) -> dict:
             # retry cheap and a rerun of the whole tool cheaper still.
             for attempt in (0, 1):
                 try:
-                    np.asarray(megastep(base_dev))
+                    np.asarray(megastep(v_dev, base_dev))
                     break
                 except Exception as exc:
                     if attempt:
@@ -112,8 +117,8 @@ def device_leg(fleet: dict, src_hw, iters: int) -> dict:
                           f"({str(exc)[:120]}); retrying", flush=True)
                     time.sleep(10)
             elapsed, _, contended = timed_best(
-                lambda m=megastep, b=base_dev: m(b), iters, backend, 50.0,
-                time.monotonic() + 240.0)
+                lambda m=megastep, v=v_dev, b=base_dev: m(v, b), iters,
+                backend, 50.0, time.monotonic() + 240.0)
             bucket_ms[bucket] = elapsed / iters * 1000.0
             contended_any |= contended
         for bucket in buckets:

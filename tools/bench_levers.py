@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bench import timed_best
+from bench import timed_best, zero_class_prior
 
 STREAMS = 16
 SRC_H, SRC_W = 1080, 1920
@@ -76,6 +76,7 @@ def build_variant(name: str):
             jax.random.PRNGKey(0),
             jnp.zeros((1, spec.input_size, spec.input_size, 3), jnp.bfloat16),
         )
+        variables = zero_class_prior(variables)
         if name == "int8":
             base = build_serving_step(model, spec)
             return (
@@ -84,6 +85,7 @@ def build_variant(name: str):
             )
         return build_serving_step(model, spec), variables
     model, variables = spec.init_params(jax.random.PRNGKey(0))
+    variables = zero_class_prior(variables)
     raw = build_serving_step(model, spec)
     if name.endswith("int8"):
         variables = quantize_tree(variables)
