@@ -115,6 +115,28 @@ class TestIntegrity:
             bench.main()
 
 
+class TestProfileMfu:
+    def test_tiny_config_decomposes(self):
+        """profile_mfu's prefix-timing machinery (capture_intermediates +
+        DCE) on the CPU twin: every milestone resolves, stage rows carry
+        the contract fields, and FLOPs grow monotonically with prefix
+        depth (times are too noisy to assert on a shared CPU)."""
+        from tools.profile_mfu import run_config
+
+        out = run_config("tiny_resnet_x2")
+        assert out["config"] == "tiny_resnet_x2"
+        stages = out["stages"]
+        assert [s["stage"] for s in stages] == [
+            "preprocess", "stem", "stage1", "head"]
+        for s in stages:
+            for key in ("prefix_ms", "prefix_gflop", "stage_ms",
+                        "stage_gflop"):
+                assert key in s
+        gf = [s["prefix_gflop"] for s in stages]
+        assert gf == sorted(gf)          # DCE prefixes: flops accumulate
+        assert out["total_ms"] > 0
+
+
 class TestBenchOutputContract:
     def test_main_prints_one_json_line_with_required_keys(self, monkeypatch):
         """The driver parses exactly this contract; run main() end-to-end

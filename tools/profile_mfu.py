@@ -1,0 +1,261 @@
+"""Per-stage MFU decomposition for the serving configs (VERDICT r4 #6).
+
+The round-3 MFU table proves the harness reaches 50 % on ViT-B/16 but
+records ResNet-50x16 at 31.3 % and VideoMAE x8x8 at 25.9 % with no
+breakdown. This tool decomposes a config's serving step into measured
+stages — preprocess, stem/tubelet embed, trunk stages / encoder depth,
+head — so each percentage is justified by numbers, not guesses.
+
+Method: PREFIX TIMING through XLA dead-code elimination. For each
+milestone (a named flax submodule), a jitted program runs the model with
+``capture_intermediates`` and returns ONLY that intermediate's sum — XLA
+prunes everything downstream, so the program measures the prefix ending
+at the milestone. Stage cost = difference of adjacent prefixes. Each
+prefix is scan-folded and timed exactly like bench.py (per-iteration
+input perturbation, best-of-3, contention retry), and each prefix's FLOPs
+come from the SAME compiled program's cost analysis — so stage MFU =
+dFLOPs / dTime / peak is internally consistent.
+
+    python tools/profile_mfu.py --config resnet50x16 --record MFU_resnet.json
+    python tools/profile_mfu.py --config videomae_b_x8 --record MFU_vmae.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import timed_best
+
+PEAK_TFLOPS = 197.0      # v5e bf16 (BASELINE.md MFU accounting)
+SRC_H, SRC_W = 1080, 1920
+
+# config -> (model name, batch, milestones). A milestone is
+# (label, module-path suffix) matched against the flax intermediates
+# tree; "__preprocess__" and "__full__" are synthetic endpoints.
+CONFIGS = {
+    "resnet50x16": ("resnet50", 16, [
+        ("preprocess(1080p->224)", "__preprocess__"),
+        ("stem 7x7 s2 + pool", "stem"),
+        ("stage1 (C256 56^2 x3)", "stage0_block2"),
+        ("stage2 (C512 28^2 x4)", "stage1_block3"),
+        ("stage3 (C1024 14^2 x6)", "stage2_block5"),
+        ("stage4 (C2048 7^2 x3)", "stage3_block2"),
+        ("pool+head", "__full__"),
+    ]),
+    "videomae_b_x8": ("videomae_b", 8, [
+        ("preprocess(8f 1080p->224)", "__preprocess__"),
+        ("tubelet embed", "tubelet"),
+        ("encoder blocks 0-2", "block2"),
+        ("encoder blocks 3-5", "block5"),
+        ("encoder blocks 6-8", "block8"),
+        ("encoder blocks 9-11", "block11"),
+        ("mean+head", "__full__"),
+    ]),
+    "vit_b16_x32": ("vit_b16", 32, [
+        ("preprocess(1080p->224)", "__preprocess__"),
+        ("patchify", "patch_embed"),
+        ("encoder blocks 0-5", "block5"),
+        ("encoder blocks 6-11", "block11"),
+        ("head", "__full__"),
+    ]),
+    # CPU-backend smoke twin (tests): tiny model, the same machinery.
+    "tiny_resnet_x2": ("tiny_resnet", 2, [
+        ("preprocess", "__preprocess__"),
+        ("stem", "stem"),
+        ("stage1", "stage0_block0"),
+        ("head", "__full__"),
+    ]),
+}
+
+
+def _find_leaf(tree, suffix, path=()):
+    """Depth-first: the first intermediates leaf whose module path ends
+    with ``suffix``. Returns (joined path, array) or None."""
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            hit = _find_leaf(v, suffix, path + (k,))
+            if hit is not None:
+                return hit
+        return None
+    if isinstance(tree, (tuple, list)):
+        arr = tree[0] if tree else None
+        if arr is None:
+            return None
+        mods = [p for p in path if p != "__call__"]
+        if mods and mods[-1] == suffix:
+            return "/".join(mods), arr
+        return None
+    return None
+
+
+def build_prefix(spec, model, variables, milestone, batch, clip_len):
+    """Jitted scan-folded program measuring the serving prefix up to
+    ``milestone``; returns (fn, args, flops) with flops from the compiled
+    program's own cost analysis."""
+    from video_edge_ai_proxy_tpu.ops.preprocess import (
+        preprocess_classify, preprocess_clip,
+    )
+
+    size = spec.input_size
+    pre = preprocess_clip if clip_len else preprocess_classify
+
+    def prefix_once(v, frames_u8):
+        x = pre(frames_u8, (size, size))
+        if milestone == "__preprocess__":
+            return jnp.sum(x.astype(jnp.float32))
+        if milestone == "__full__":
+            out = model.apply(v, x)
+            return jnp.sum(out.astype(jnp.float32))
+        out, state = model.apply(
+            v, x, capture_intermediates=True, mutable=["intermediates"]
+        )
+        hit = _find_leaf(state["intermediates"], milestone)
+        if hit is None:
+            raise KeyError(
+                f"milestone {milestone!r} not found in intermediates"
+            )
+        return jnp.sum(hit[1].astype(jnp.float32))
+
+    iters = 30
+
+    @jax.jit
+    def megastep(v, base_u8):
+        def body(carry, i):
+            s = prefix_once(v, base_u8 + i.astype(jnp.uint8))
+            return carry + s, None
+
+        total, _ = jax.lax.scan(
+            body, jnp.zeros((), jnp.float32), jnp.arange(iters))
+        return total
+
+    shape = ((batch,) + ((clip_len,) if clip_len else ())
+             + (SRC_H, SRC_W, 3))
+    rng = np.random.default_rng(0)
+    base = jax.device_put(rng.integers(0, 256, shape, dtype=np.uint8))
+    v_dev = jax.device_put(variables)
+    lowered = megastep.lower(v_dev, base)
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    # XLA's HLO cost analysis counts a while/scan BODY once (not body x
+    # trip count), so the reported flops are already per-iteration —
+    # verified against bench_configs' recorded per-step GFLOP (ViT-B/16
+    # x32: 1237.1 both ways).
+    flops = float((cost or {}).get("flops", 0.0))
+    return megastep, (v_dev, base), flops, iters
+
+
+def run_config(config: str, rounds: int = 4) -> dict:
+    from video_edge_ai_proxy_tpu.models import registry
+
+    model_name, batch, milestones = CONFIGS[config]
+    spec = registry.get(model_name)
+    model, variables = spec.init_params(jax.random.PRNGKey(0))
+    backend = jax.default_backend()
+
+    # Compile every prefix first, then measure them ROUND-ROBIN across
+    # several rounds and keep each prefix's minimum: on a co-tenanted
+    # chip, timing each prefix in its own window lets window drift land
+    # entirely in the differences (a -13 ms "stage" was recorded that
+    # way); interleaving puts every prefix through the same windows.
+    built = []
+    for label, milestone in milestones:
+        print(f"  compile -> {label} ...", flush=True)
+        fn, args, flops, iters = build_prefix(
+            spec, model, variables, milestone, batch, spec.clip_len)
+        np.asarray(fn(*args))          # compile + warm
+        built.append((label, fn, args, flops, iters))
+    round_ms = [[] for _ in built]
+    for r in range(rounds):
+        print(f"  measuring (round {r + 1}/{rounds}) ...", flush=True)
+        for bi, (label, fn, args, flops, iters) in enumerate(built):
+            # Best-of-3 inside timed_best; no absolute good_ms gate is
+            # possible here (prefix costs span 100x), so window stability
+            # is judged from the cross-round spread below instead.
+            elapsed, _, _ = timed_best(
+                lambda fn=fn, args=args: fn(*args), iters, backend, 1e9,
+                time.monotonic() + 60.0)
+            round_ms[bi].append(elapsed / iters * 1e3)
+    best_ms = [min(r) for r in round_ms]
+    # Honest stability signal (there is no absolute contention gate for
+    # arbitrary prefixes): how far the per-round minima spread. A clean
+    # set of windows keeps every prefix's median within ~20% of its min;
+    # co-tenant windows show 1.5-3x.
+    spread = max(
+        (float(np.median(r)) / m) for r, m in zip(round_ms, best_ms)
+        if m > 0.05
+    )
+    windows_stable = spread < 1.3
+    # A prefix is a superset of every earlier one, so its true time is
+    # monotone non-decreasing; enforce that (cumulative max) so residual
+    # window noise cannot produce negative stage costs.
+    iso_ms = np.maximum.accumulate(np.asarray(best_ms))
+    rows = []
+    prev_ms = 0.0
+    prev_gf = 0.0
+    for bi, (label, fn, args, flops, iters) in enumerate(built):
+        pref_ms = float(iso_ms[bi])
+        pref_gf = flops / 1e9
+        d_ms = pref_ms - prev_ms
+        d_gf = pref_gf - prev_gf
+        rows.append({
+            "stage": label,
+            "prefix_ms": round(pref_ms, 3),
+            "prefix_gflop": round(pref_gf, 2),
+            "stage_ms": round(d_ms, 3),
+            "stage_gflop": round(d_gf, 2),
+            "stage_tflops": round(d_gf / d_ms, 1) if d_ms > 0.05 else None,
+            "stage_mfu_pct": round(100 * d_gf / d_ms / PEAK_TFLOPS, 1)
+            if d_ms > 0.05 else None,
+        })
+        prev_ms, prev_gf = pref_ms, pref_gf
+    total_ms, total_gf = prev_ms, prev_gf
+    return {
+        "config": config,
+        "model": model_name,
+        "batch": batch,
+        "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "peak_tflops": PEAK_TFLOPS,
+        "stages": rows,
+        "total_ms": round(total_ms, 3),
+        "total_gflop": round(total_gf, 2),
+        "total_mfu_pct": round(100 * total_gf / total_ms / PEAK_TFLOPS, 1),
+        "rounds": rounds,
+        "window_spread": round(float(spread), 3),
+        "windows_stable": bool(windows_stable),
+        "note": "prefix timing via capture_intermediates + XLA DCE; "
+                "stage = difference of adjacent prefixes; FLOPs from each "
+                "compiled prefix's cost analysis (internally consistent); "
+                "window_spread = worst median/min across measurement "
+                "rounds (no absolute contention gate exists for "
+                "arbitrary prefixes)",
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--config", required=True, choices=sorted(CONFIGS))
+    ap.add_argument("--record", default="")
+    args = ap.parse_args(argv)
+    out = run_config(args.config)
+    print(json.dumps(out))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
