@@ -584,6 +584,45 @@ class TestAudioCarryThrough:
                 first = d.read()
             assert first is not None and first.is_keyframe
 
+    def test_relay_reset_resumes_with_audio(self, fixture_audio_mp4, tmp_path):
+        """Reconnect mid-relay on an audio-bearing camera: reset() carries
+        the NEW audio info, the resumed sink still contains an AAC track,
+        and the relay re-anchors on the new stream's video keyframe."""
+        from video_edge_ai_proxy_tpu.ingest.passthrough import (
+            PacketPassthroughWriter,
+        )
+
+        with av.PacketDemuxer(fixture_audio_mp4) as d:
+            pkts = []
+            while (pkt := d.read(want_data=True)) is not None:
+                pkts.append(pkt)
+            info, ainfo = d.info, d.audio_info
+        sink = str(tmp_path / "resume_audio.flv")
+        pw = PacketPassthroughWriter(sink, info, audio_info=ainfo)
+        aud = [p for p in pkts if p.is_audio]
+        for pkt in pkts[: 2 * GOP]:
+            pw.feed(pkt)
+        pw.set_active(True)
+        before = pw.written
+        assert before > 0
+        # "Reconnect" with a DISTINCT audio-info object (a fresh demuxer
+        # would produce one): reset must adopt it, not keep the stale ref.
+        import dataclasses
+
+        new_ainfo = dataclasses.replace(ainfo)
+        pw.reset(info, new_ainfo)
+        assert pw.audio_info is new_ainfo
+        assert pw.active and len(pw._gop) == 0
+        pw.feed(aud[0])                        # audio before the keyframe:
+        assert pw.written == before            # held (sink must re-anchor)
+        for pkt in pkts[2 * GOP:]:
+            pw.feed(pkt)
+        assert pw.written > before
+        pw.close()
+        nv, na, sink_ainfo = _count_packets(sink)
+        assert sink_ainfo is not None and sink_ainfo.codec_name == "aac"
+        assert na > 0 and nv >= GOP
+
     def test_audio_over_real_rtsp_socket_reaches_archive(
         self, fixture_audio_mp4, tmp_path
     ):
