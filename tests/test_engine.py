@@ -303,6 +303,21 @@ class TestIncrementalAssembly:
         groups = col.collect()
         assert groups and groups[0].frames[0, 0, 0, 0] == 99
 
+    def test_doorbell_less_bus_falls_back_to_plain_wait(self, bus):
+        """A bus without a doorbell (Redis: every poll is a network round
+        trip) must NOT get a polling window: assemble_until sleeps to the
+        deadline, plans nothing, and collect() takes the classic path."""
+        col = Collector(bus, buckets=(1, 2))
+        self._warm(bus, col, n=1)
+        bus.doorbell = False                  # simulate a network bus
+        t0 = time.monotonic()
+        col.assemble_until(t0 + 0.08)
+        assert time.monotonic() - t0 >= 0.07  # actually waited
+        assert col._window is None            # nothing planned
+        _publish(bus, "cam0", value=33)
+        groups = col.collect()                # classic fast path still works
+        assert groups and groups[0].frames[0, 0, 0, 0] == 33
+
     def test_strict_lease_blocks_reuse_until_release(self, bus):
         col = Collector(bus, buckets=(1,), strict_lease=True)
         bus.create_stream("cam0", 64 * 64 * 3)
