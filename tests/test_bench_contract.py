@@ -136,6 +136,20 @@ class TestProfileMfu:
         assert gf == sorted(gf)          # DCE prefixes: flops accumulate
         assert out["total_ms"] > 0
 
+    def test_tiny_detect_config_decomposes(self):
+        """The detect route: letterbox preprocess, backbone milestone,
+        decode ("__model__") and the exact serving step with NMS
+        ("__full__") all resolve and accumulate FLOPs."""
+        from tools.profile_mfu import run_config
+
+        out = run_config("tiny_yolo_x2", rounds=2)
+        stages = out["stages"]
+        assert [s["stage"] for s in stages] == [
+            "preprocess", "P3", "decode", "nms"]
+        gf = [s["prefix_gflop"] for s in stages]
+        assert gf == sorted(gf)
+        assert out["total_ms"] > 0
+
 
 class TestBenchOutputContract:
     def test_main_prints_one_json_line_with_required_keys(self, monkeypatch):

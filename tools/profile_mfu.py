@@ -68,12 +68,29 @@ CONFIGS = {
         ("encoder blocks 6-11", "block11"),
         ("head", "__full__"),
     ]),
-    # CPU-backend smoke twin (tests): tiny model, the same machinery.
+    # North star: the detect path decomposes through the letterbox, the
+    # backbone pyramid, decode, and NMS endpoints.
+    "yolov8n_x16": ("yolov8n", 16, [
+        ("preprocess(letterbox 1080p->640)", "__preprocess__"),
+        ("stem+P2 (C<=32, 320^2)", "c2f_2"),
+        ("P3 (C64, 80^2)", "c2f_3"),
+        ("P4 (C128, 40^2)", "c2f_4"),
+        ("P5+SPPF (C256, 20^2)", "sppf"),
+        ("neck+heads+DFL decode", "__model__"),
+        ("NMS + unletterbox", "__full__"),
+    ]),
+    # CPU-backend smoke twins (tests): tiny models, the same machinery.
     "tiny_resnet_x2": ("tiny_resnet", 2, [
         ("preprocess", "__preprocess__"),
         ("stem", "stem"),
         ("stage1", "stage0_block0"),
         ("head", "__full__"),
+    ]),
+    "tiny_yolo_x2": ("tiny_yolov8", 2, [
+        ("preprocess", "__preprocess__"),
+        ("P3", "c2f_3"),
+        ("decode", "__model__"),
+        ("nms", "__full__"),
     ]),
 }
 
@@ -101,24 +118,51 @@ def _find_leaf(tree, suffix, path=()):
 def build_prefix(spec, model, variables, milestone, batch, clip_len):
     """Jitted scan-folded program measuring the serving prefix up to
     ``milestone``; returns (fn, args, flops) with flops from the compiled
-    program's own cost analysis."""
+    program's own cost analysis. Detect models route through the real
+    letterbox/decode/NMS endpoints ("__model__" = decode done, no NMS;
+    "__full__" = the exact serving step)."""
+    from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
     from video_edge_ai_proxy_tpu.ops.preprocess import (
-        preprocess_classify, preprocess_clip,
+        preprocess_classify, preprocess_clip, preprocess_letterbox,
     )
 
     size = spec.input_size
+    detect = spec.kind == "detect"
+    serving = build_serving_step(model, spec) if detect else None
     pre = preprocess_clip if clip_len else preprocess_classify
 
     def prefix_once(v, frames_u8):
-        x = pre(frames_u8, (size, size))
-        if milestone == "__preprocess__":
-            return jnp.sum(x.astype(jnp.float32))
-        if milestone == "__full__":
-            out = model.apply(v, x)
-            return jnp.sum(out.astype(jnp.float32))
-        out, state = model.apply(
-            v, x, capture_intermediates=True, mutable=["intermediates"]
-        )
+        if detect:
+            if milestone == "__full__":
+                out = serving(v, frames_u8)
+                # Every output feeds the scalar, or XLA DCE would prune
+                # unletterbox_boxes and the kept-box/class gathers and
+                # this would NOT be the exact serving step.
+                return (jnp.sum(out["boxes"].astype(jnp.float32))
+                        + jnp.sum(out["scores"].astype(jnp.float32))
+                        + jnp.sum(out["classes"].astype(jnp.float32))
+                        + jnp.sum(out["valid"].astype(jnp.float32)))
+            x, _lb = preprocess_letterbox(frames_u8, size)
+            if milestone == "__preprocess__":
+                return jnp.sum(x.astype(jnp.float32))
+            if milestone == "__model__":
+                boxes, max_logit, _ids = model.apply(v, x, decode="serving")
+                return (jnp.sum(boxes.astype(jnp.float32))
+                        + jnp.sum(max_logit.astype(jnp.float32)))
+            out, state = model.apply(
+                v, x, decode="serving",
+                capture_intermediates=True, mutable=["intermediates"],
+            )
+        else:
+            x = pre(frames_u8, (size, size))
+            if milestone == "__preprocess__":
+                return jnp.sum(x.astype(jnp.float32))
+            if milestone == "__full__":
+                out = model.apply(v, x)
+                return jnp.sum(out.astype(jnp.float32))
+            out, state = model.apply(
+                v, x, capture_intermediates=True, mutable=["intermediates"]
+            )
         hit = _find_leaf(state["intermediates"], milestone)
         if hit is None:
             raise KeyError(
@@ -247,8 +291,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--config", required=True, choices=sorted(CONFIGS))
     ap.add_argument("--record", default="")
+    ap.add_argument("--rounds", type=int, default=4,
+                    help="measurement rounds per prefix (more rounds let "
+                         "the per-prefix minimum converge through choppy "
+                         "co-tenant windows)")
     args = ap.parse_args(argv)
-    out = run_config(args.config)
+    out = run_config(args.config, rounds=args.rounds)
     print(json.dumps(out))
     if args.record:
         with open(args.record, "w") as f:
