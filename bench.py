@@ -76,37 +76,15 @@ def timed_min(fn, good_s, backend, deadline, sleep_s=25.0):
         time.sleep(sleep_s)
 
 
-def zero_class_prior(variables):
-    """Zero the detection head's class-prior biases for the BENCH program.
-
-    The from-scratch-trainability prior (models/yolov8.py: cls{i}_out bias
-    = log(5/nc/(640/stride)^2) ~= -11.5) puts every random-init score at
-    ~1e-5 — below the NMS score threshold — so the r4 bench's checksum
-    silently died (valid.sum() == 0 across all batches) and its NMS loop
-    ran over empty candidate sets (VERDICT r4 weak #2). Zeroing ONLY these
-    bias vectors restores the r1-r3 measured regime: sigmoid(~0) ~= 0.5 >
-    0.25 threshold, candidate sets saturate, the suppression loop does
-    real work, and the checksum is a meaningful nonzero integrity signal.
-    The compute graph is unchanged (same bias add, different constants) —
-    a production engine with an imported checkpoint overwrites these
-    values anyway."""
-    def walk(node, in_cls_out=False):
-        if isinstance(node, dict):
-            return {
-                k: walk(
-                    v,
-                    in_cls_out or (
-                        isinstance(k, str)
-                        and k.startswith("cls") and k.endswith("_out")
-                    ),
-                )
-                for k, v in node.items()
-            }
-        if in_cls_out and getattr(node, "ndim", None) == 1:
-            return jnp.zeros_like(node)
-        return node
-
-    return walk(variables)
+# zero_class_prior moved to replay/checksum.py (the replay harness needs
+# the identical program transform for its deterministic checksums);
+# re-exported here because it is part of the bench methodology and tests
+# exercise it as bench.zero_class_prior.
+from video_edge_ai_proxy_tpu.replay.checksum import (  # noqa: E402
+    check_golden,
+    fold_checksum,
+    zero_class_prior,
+)
 
 
 def main() -> None:
@@ -135,11 +113,14 @@ def main() -> None:
     def megastep(base_u8):
         """scan ITERS serving ticks; per-tick input perturbed on-device so
         every iteration does real, distinct work. One definition serves
-        every batch size benched below."""
+        every batch size benched below. The carry is the content-derived
+        result checksum (replay/checksum.py): a hash of the actual winning
+        boxes/classes/scores, not the r4/r5 shape constant ``valid.sum()``
+        — a box-decode bug now trips the golden gate."""
         def body(carry, i):
             frames = base_u8 + i.astype(jnp.uint8)      # wraps mod 256
-            _, _, _, valid = one_batch(frames)
-            return carry + valid.sum(), None
+            out = serving_step(variables, frames)
+            return fold_checksum(carry, out), None
 
         total, _ = jax.lax.scan(
             body, jnp.zeros((), jnp.int32), jnp.arange(iters)
@@ -225,6 +206,13 @@ def main() -> None:
             "not production-shaped (VERDICT r4 weak #2)"
         )
 
+    # Golden gate: pinned inputs + pinned weights must reproduce the
+    # committed content checksum bit-exactly (replay/goldens.json). A
+    # missing golden records the fresh value in the artifact instead of
+    # failing (first run on a new backend/config).
+    golden_key = f"bench:{spec.name}:{backend}:{streams}x{iters}"
+    golden = check_golden(golden_key, int(total), tool="bench")
+
     out = {
         "metric": f"yolov8n_640_detect_fps_{streams}x1080p_{backend}",
         "value": round(fps, 1),
@@ -236,6 +224,8 @@ def main() -> None:
         "e2e_tunnel_ms": round(e2e_ms, 1),
         "fps_64stream_bucket": round(fps64, 1) if fps64 else None,
         "checksum": total,
+        "checksum_key": golden_key,
+        "checksum_golden": golden,
     }
     if contended:
         # Retries never found an uncontended window: the number below is a

@@ -358,6 +358,32 @@ class TestIncrementalAssembly:
             assert col.collect()
         assert len(col._pool[shape]["bufs"]) <= Collector.MAX_POOL_BUFFERS
 
+    def test_failsafe_one_off_buffer_never_steals_live_lease(self, bus):
+        """At the pool cap the failsafe hands out a ONE-OFF buffer
+        (lease None, release a no-op) instead of stealing the oldest
+        lease — in-flight batches must never see their frames rewritten
+        under them (torn-frame hazard the failsafe exists to avoid)."""
+        col = Collector(bus, buckets=(1,), strict_lease=True)
+        bus.create_stream("cam0", 64 * 64 * 3)
+        _publish(bus, "cam0", value=1)
+        col.collect()                            # generic path (first sight)
+        held = []
+        for v in range(Collector.MAX_POOL_BUFFERS):
+            _publish(bus, "cam0", value=10 + v)
+            g = col.collect()[0]
+            assert g.lease is not None
+            held.append(g)                       # pool now fully leased
+        _publish(bus, "cam0", value=200)
+        extra = col.collect()[0]
+        assert extra.lease is None               # one-off, not pooled
+        assert extra.frames[0, 0, 0, 0] == 200
+        # every live lease still holds ITS frame — nothing was stolen
+        for v, g in enumerate(held):
+            assert g.frames[0, 0, 0, 0] == 10 + v
+        n_bufs = len(col._pool[(1, 64, 64, 3)]["bufs"])
+        col.release(extra)                       # no-op by contract
+        assert len(col._pool[(1, 64, 64, 3)]["bufs"]) == n_bufs
+
 
 def _sink():
     """Standing interest for tests that drive the collector directly

@@ -39,6 +39,9 @@ def main() -> None:
 
     from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
     from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.replay.checksum import (
+        check_golden, fold_checksum, zero_class_prior,
+    )
 
     backend = jax.default_backend()
     rng = np.random.default_rng(0)
@@ -47,6 +50,11 @@ def main() -> None:
             streams, iters = min(streams, 2), 2
         spec = registry.get(model_name)
         model, variables = spec.init_params(jax.random.PRNGKey(0))
+        if spec.kind == "detect":
+            # Same bench.py methodology: random-init class priors suppress
+            # every score below the NMS threshold, which zeroes the content
+            # checksum and removes the NMS work from the measured program.
+            variables = zero_class_prior(variables)
         step = build_serving_step(model, spec)
         shape = (streams,) + ((spec.clip_len,) if spec.clip_len else ()) + \
             (SRC_H if backend == "tpu" else 270,
@@ -61,11 +69,12 @@ def main() -> None:
             # limit (HTTP 413).
             def body(carry, i):
                 out = step(params, u8 + i.astype(jnp.uint8))
-                s = sum(jnp.sum(l).astype(jnp.float32)
-                        for l in jax.tree.leaves(out))
-                return carry + s, None
+                # Content-derived checksum (replay/checksum.py) — covers
+                # all three output families; replaces the float leaf-sum,
+                # which drowned small numeric drift in big-tensor noise.
+                return fold_checksum(carry, out), None
 
-            tot, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+            tot, _ = jax.lax.scan(body, jnp.zeros((), jnp.int32),
                                   jnp.arange(iters))
             return tot
 
@@ -74,11 +83,13 @@ def main() -> None:
         t0 = time.perf_counter()
         np.asarray(mega(var_dev, dev))
         compile_s = time.perf_counter() - t0
-        best, _, contended = timed_best(
+        best, total, contended = timed_best(
             lambda: mega(var_dev, dev), iters, backend, good_ms,
             time.monotonic() + 120.0)
         frames_per_iter = streams * (spec.clip_len or 1)
         batch_ms = best / iters * 1e3
+        key = f"configs:{name}:{backend}:{streams}x{iters}"
+        check_golden(key, int(total), tool="bench_configs")
         rec = {
             "config": name,
             "model": model_name,
@@ -86,6 +97,8 @@ def main() -> None:
             "fps": round(frames_per_iter * iters / best, 1),
             "batch_ms": round(batch_ms, 2),
             "compile_s": round(compile_s, 1),
+            "checksum": int(total),
+            "checksum_key": key,
         }
         # MFU bookkeeping (VERDICT r2 #7): XLA's own FLOP count for ONE
         # serving step / measured step time / chip peak. Peak is the v5e
@@ -98,6 +111,8 @@ def main() -> None:
             # is the price of the FLOP count.
             cost = jax.jit(step).lower(var_dev, dev).compile() \
                 .cost_analysis() or {}
+            if isinstance(cost, list):      # CPU backend returns [dict]
+                cost = cost[0] if cost else {}
             flops = float(cost.get("flops", 0.0))
             if flops > 0:
                 achieved = flops / (batch_ms / 1e3)

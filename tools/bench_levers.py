@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bench import timed_best, zero_class_prior
+from video_edge_ai_proxy_tpu.replay.checksum import check_golden, fold_checksum
 
 STREAMS = 16
 SRC_H, SRC_W = 1080, 1920
@@ -109,7 +110,10 @@ def bench_variant(name: str, base_dev, iters: int, backend: str) -> dict:
         def body(carry, i):
             frames = base_u8 + i.astype(jnp.uint8)  # perturb: defeats LICM
             out = step(vs, frames)
-            return carry + out["valid"].sum(), None
+            # Content-derived fold (replay/checksum.py), not valid.sum():
+            # a variant whose boxes decode differently now shows a
+            # DIFFERENT checksum instead of the same shape constant.
+            return fold_checksum(carry, out), None
 
         total, _ = jax.lax.scan(
             body, jnp.zeros((), jnp.int32), jnp.arange(iters)
@@ -123,12 +127,15 @@ def bench_variant(name: str, base_dev, iters: int, backend: str) -> dict:
         time.monotonic() + 240.0,
     )
     batch_ms = elapsed / iters * 1000.0
+    key = f"levers:{name}:{backend}:{base_dev.shape[0]}x{iters}"
+    check_golden(key, int(total), tool="bench_levers")
     out = {
         "variant": name,
         "batch_ms": round(batch_ms, 2),
         "fps": round(STREAMS * iters / elapsed, 1)
         if base_dev.shape[0] == STREAMS else None,
         "checksum": int(total),
+        "checksum_key": key,
         # Measurement-window metadata: co-tenant contention is the one
         # confound on this chip (BASELINE.md); epoch bounds let any later
         # reader align windows across artifacts.

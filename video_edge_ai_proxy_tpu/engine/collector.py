@@ -176,9 +176,10 @@ class Collector:
 
     # Failsafe: a caller that leases (collect() under strict_lease) but
     # never releases would grow a shape's pool without bound; past this
-    # many live buffers per shape the oldest lease is force-released.
-    # The engine's drain queue is depth-2, so steady state is 3-4; hitting
-    # the cap means a leak and is logged.
+    # many live buffers per shape new handouts become one-off non-pooled
+    # allocations (idx None — never tracked, never reused). The engine's
+    # drain queue is depth-2, so steady state is 3-4; hitting the cap
+    # means a leak and is logged.
     MAX_POOL_BUFFERS = 8
 
     def _begin_tick(self) -> None:
@@ -221,18 +222,23 @@ class Collector:
             if idx is None:
                 if len(slot["bufs"]) >= self.MAX_POOL_BUFFERS \
                         and slot["leased"]:
-                    idx = slot["leased"].pop(0)   # failsafe: leak recovery
+                    # Failsafe: leak containment. Stealing the oldest lease
+                    # here would hand the SAME pages to a new batch while an
+                    # in-flight dispatch may still be reading them (torn
+                    # frames). A one-off non-pooled buffer costs the page
+                    # faults the pool exists to avoid, but only on the
+                    # already-broken leak path — correctness over speed.
                     import logging
 
                     logging.getLogger("vep.engine.collector").warning(
-                        "batch pool for shape %s hit %d buffers; force-"
-                        "releasing the oldest lease (a consumer is not "
-                        "calling Collector.release)", shape,
+                        "batch pool for shape %s hit %d buffers; handing "
+                        "out a one-off non-pooled buffer (a consumer is "
+                        "not calling Collector.release)", shape,
                         self.MAX_POOL_BUFFERS,
                     )
-                else:
-                    slot["bufs"].append(np.zeros(shape, np.uint8))
-                    idx = len(slot["bufs"]) - 1
+                    return np.zeros(shape, np.uint8), None
+                slot["bufs"].append(np.zeros(shape, np.uint8))
+                idx = len(slot["bufs"]) - 1
             slot["cur"].append(idx)
             return slot["bufs"][idx], idx
 
@@ -246,10 +252,12 @@ class Collector:
             if slot["cur"]:
                 slot["cur"].pop()
 
-    def _lease(self, group: BatchGroup, shape: tuple, idx: int) -> None:
+    def _lease(self, group: BatchGroup, shape: tuple, idx) -> None:
         """Under strict leasing, tie the group to its pooled buffer: the
-        pool will not reuse it until release(group)."""
-        if not self._strict_lease:
+        pool will not reuse it until release(group). ``idx`` None = the
+        failsafe handed out a one-off non-pooled buffer — nothing to
+        lease, release(group) stays a no-op."""
+        if not self._strict_lease or idx is None:
             return
         with self._pool_lock:
             self._pool[shape]["leased"].append(idx)
@@ -270,7 +278,7 @@ class Collector:
                 try:
                     slot["leased"].remove(idx)
                 except ValueError:
-                    pass   # force-released by the failsafe
+                    pass   # double release / unknown lease: stay robust
 
     # -- incremental batch assembly (between ticks) --
 
@@ -480,7 +488,10 @@ class Collector:
                     metas.append(meta)
                 n = len(ids)
                 if not n:
-                    self._unrotate((alloc,) + geom)
+                    if bidx is not None:
+                        # One-off failsafe buffers never entered "cur";
+                        # unrotating would pop a legitimate same-tick entry.
+                        self._unrotate((alloc,) + geom)
                     continue
                 bucket = next(b for b in self._buckets if b >= n)
                 view = batch[:bucket]

@@ -182,6 +182,10 @@ class InferenceEngine:
         self._collector: Optional[Collector] = None
         self._subscribers: List[tuple] = []   # (queue, device_id filter set|None)
         self._sub_lock = threading.Lock()
+        # Set by stop() BEFORE the subscriber end-sentinels go out: a
+        # wedged drain thread that wakes up later must not emit results
+        # after a subscriber already saw its None (ADVICE r5 #5).
+        self._fanout_closed = False
         self._stats: Dict[str, StreamStats] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -616,6 +620,11 @@ class InferenceEngine:
                 )
             self._drain_thread.join(timeout=10)
         with self._sub_lock:
+            # Close the fan-out before the end-sentinels: an abandoned
+            # (wedged) drain thread that later finishes its fetch would
+            # otherwise _publish into queues whose consumers already saw
+            # None — a post-sentinel result a client can never attribute.
+            self._fanout_closed = True
             for q, _ in self._subscribers:
                 q.put(None)
             self._subscribers.clear()
@@ -1048,6 +1057,8 @@ class InferenceEngine:
 
     def _publish(self, result: pb.InferenceResult) -> None:
         with self._sub_lock:
+            if self._fanout_closed:
+                return
             subs = list(self._subscribers)
         for q, ids in subs:
             if ids is not None and result.device_id not in ids:
@@ -1110,22 +1121,7 @@ class InferenceEngine:
             return True
         if policy == "keyframe":
             return bool(meta.is_keyframe)
-        with self._state_lock:
-            st = self._ann_state.setdefault(device_id, {})
-        if policy == "min_interval":
-            if not eligible:
-                # Nothing to emit: must NOT consume the interval slot, or
-                # sparse scenes (mostly empty frames) would starve real
-                # detections quasi-indefinitely.
-                return True
-            now = meta.timestamp_ms or int(time.time() * 1000)
-            last = st.get("last_ms")
-            if last is not None and now - last < \
-                    self._cfg.annotation_min_interval_ms:
-                return False
-            st["last_ms"] = now
-            return True
-        if policy != "on_change":
+        if policy not in ("min_interval", "on_change"):
             if (device_id, policy) not in self._ann_policy_warned:
                 self._ann_policy_warned.add((device_id, policy))
                 log.warning(
@@ -1133,18 +1129,38 @@ class InferenceEngine:
                     policy, device_id,
                 )
             return True
-        # on_change: the tracked object set changed, or some object's
-        # confidence moved more than the configured delta. Track ids when
-        # the tracker runs, per-class max-confidence otherwise.
-        cur: Dict[str, float] = {}
-        for det in eligible:
-            key = det.track_id or f"class{det.class_id}"
-            cur[key] = max(cur.get(key, 0.0), det.confidence)
-        prev = st.get("sig")
-        delta = self._cfg.annotation_confidence_delta
-        changed = prev is None or set(cur) != set(prev) or any(
-            abs(cur[k] - prev[k]) > delta for k in cur
-        )
-        if changed:
-            st["sig"] = cur
-        return changed and bool(eligible)
+        # The whole policy-state read/update runs under _state_lock: the
+        # engine-thread GC deletes _ann_state entries for dropped streams
+        # under the same lock, and a setdefault-then-mutate-unlocked here
+        # would keep writing an orphaned dict (state silently lost, a
+        # re-added stream's first frames mis-gated).
+        with self._state_lock:
+            st = self._ann_state.setdefault(device_id, {})
+            if policy == "min_interval":
+                if not eligible:
+                    # Nothing to emit: must NOT consume the interval slot, or
+                    # sparse scenes (mostly empty frames) would starve real
+                    # detections quasi-indefinitely.
+                    return True
+                now = meta.timestamp_ms or int(time.time() * 1000)
+                last = st.get("last_ms")
+                if last is not None and now - last < \
+                        self._cfg.annotation_min_interval_ms:
+                    return False
+                st["last_ms"] = now
+                return True
+            # on_change: the tracked object set changed, or some object's
+            # confidence moved more than the configured delta. Track ids when
+            # the tracker runs, per-class max-confidence otherwise.
+            cur: Dict[str, float] = {}
+            for det in eligible:
+                key = det.track_id or f"class{det.class_id}"
+                cur[key] = max(cur.get(key, 0.0), det.confidence)
+            prev = st.get("sig")
+            delta = self._cfg.annotation_confidence_delta
+            changed = prev is None or set(cur) != set(prev) or any(
+                abs(cur[k] - prev[k]) > delta for k in cur
+            )
+            if changed:
+                st["sig"] = cur
+            return changed and bool(eligible)

@@ -519,6 +519,12 @@ void* vm_open(const char* url, const char* format, const VAStreamInfo* si,
   par->height = si->height;
   if (extralen > 0) {
     par->extradata = (uint8_t*)av_mallocz(extralen + AV_INPUT_BUFFER_PADDING_SIZE);
+    if (!par->extradata) {
+      set_err(err, errcap, "failed to allocate video extradata");
+      avformat_free_context(m->fmt);
+      delete m;
+      return nullptr;
+    }
     std::memcpy(par->extradata, extradata, extralen);
     par->extradata_size = extralen;
   }
@@ -546,6 +552,12 @@ void* vm_open(const char* url, const char* format, const VAStreamInfo* si,
     if (a_extralen > 0) {
       apar->extradata =
           (uint8_t*)av_mallocz(a_extralen + AV_INPUT_BUFFER_PADDING_SIZE);
+      if (!apar->extradata) {
+        set_err(err, errcap, "failed to allocate audio extradata");
+        avformat_free_context(m->fmt);
+        delete m;
+        return nullptr;
+      }
       std::memcpy(apar->extradata, a_extradata, a_extralen);
       apar->extradata_size = a_extralen;
     }
@@ -701,10 +713,24 @@ void* vc_open(const char* codec_name, int w, int h, int fps_num, int fps_den,
     return nullptr;
   }
   e->frame = av_frame_alloc();
+  if (!e->frame) {
+    set_averr(err, errcap, AVERROR(ENOMEM));
+    avcodec_free_context(&e->ctx);
+    delete e;
+    return nullptr;
+  }
   e->frame->format = AV_PIX_FMT_YUV420P;
   e->frame->width = w;
   e->frame->height = h;
-  av_frame_get_buffer(e->frame, 0);
+  rc = av_frame_get_buffer(e->frame, 0);
+  if (rc < 0) {
+    // Unchecked, vc_send would memcpy into null data planes (ADVICE r5 #4).
+    set_averr(err, errcap, rc);
+    av_frame_free(&e->frame);
+    avcodec_free_context(&e->ctx);
+    delete e;
+    return nullptr;
+  }
   e->pkt = av_packet_alloc();
   return e;
 }
@@ -829,6 +855,12 @@ void* vca_open(const char* codec_name, int sample_rate, int channels,
     return nullptr;
   }
   e->frame = av_frame_alloc();
+  if (!e->frame) {
+    set_averr(err, errcap, AVERROR(ENOMEM));
+    avcodec_free_context(&e->ctx);
+    delete e;
+    return nullptr;
+  }
   e->frame->format = AV_SAMPLE_FMT_FLTP;
   e->frame->nb_samples = e->ctx->frame_size ? e->ctx->frame_size : 1024;
   e->frame->sample_rate = sample_rate;
@@ -838,7 +870,14 @@ void* vca_open(const char* codec_name, int sample_rate, int channels,
   e->frame->channels = channels;
   e->frame->channel_layout = e->ctx->channel_layout;
 #endif
-  av_frame_get_buffer(e->frame, 0);
+  rc = av_frame_get_buffer(e->frame, 0);
+  if (rc < 0) {
+    set_averr(err, errcap, rc);
+    av_frame_free(&e->frame);
+    avcodec_free_context(&e->ctx);
+    delete e;
+    return nullptr;
+  }
   e->pkt = av_packet_alloc();
   return e;
 }

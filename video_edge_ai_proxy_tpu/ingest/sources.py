@@ -6,8 +6,9 @@ always demuxed, pixels are only produced when a client asked recently
 two-phase contract is ``grab()`` (advance the stream, cheap — no pixel
 decode) and ``retrieve()`` (produce the BGR24 frame, expensive).
 
-URL routing (``open_source``): ``test://...`` -> SyntheticSource; everything
-else -> PacketSource (native libav shim: true demux-only grab, real
+URL routing (``open_source``): ``test://...`` -> SyntheticSource;
+``replay://...`` -> ReplaySource (deterministic trace re-delivery,
+replay/player.py); everything else -> PacketSource (native libav shim: true demux-only grab, real
 ``packet.is_keyframe``/pts/dts/time_base, compressed payload access for
 stream-copy archive/relay) with OpenCVSource as the fallback when the shim
 can't build on a host. Only PacketSource realizes the reference's lazy-decode
@@ -137,18 +138,32 @@ class SyntheticSource(VideoSource):
             time_base=1.0 / 90000.0,
         )
 
-    def retrieve(self) -> Optional[np.ndarray]:
-        n = self._n
-        frame = np.empty((self.height, self.width, 3), dtype=np.uint8)
-        frame[:, :, 0] = self._bg
-        frame[:, :, 1] = ((self._yy + 2 * n) & 0xFF).astype(np.uint8)
+    @staticmethod
+    def render(height: int, width: int, n: int,
+               bg: Optional[np.ndarray] = None,
+               yy: Optional[np.ndarray] = None) -> np.ndarray:
+        """Frame ``n`` of the pattern, as a pure function of (h, w, n) —
+        the single source of truth the replay plane regenerates from
+        (replay/trace.py ``synth`` events): a trace records just the seed
+        and replay is byte-identical by construction. ``bg``/``yy`` are
+        optional precomputed planes (the live source caches them)."""
+        if bg is None or yy is None:
+            yy, xx = np.mgrid[0:height, 0:width]
+            bg = ((xx * 255 // max(1, width - 1)) & 0xFF).astype(np.uint8)
+        frame = np.empty((height, width, 3), dtype=np.uint8)
+        frame[:, :, 0] = bg
+        frame[:, :, 1] = ((yy + 2 * n) & 0xFF).astype(np.uint8)
         frame[:, :, 2] = (n * 3) & 0xFF
         # A moving square so motion/tracking tests have a target.
-        size = max(8, self.height // 8)
-        x = (n * 7) % max(1, self.width - size)
-        y = (n * 5) % max(1, self.height - size)
+        size = max(8, height // 8)
+        x = (n * 7) % max(1, width - size)
+        y = (n * 5) % max(1, height - size)
         frame[y : y + size, x : x + size] = (255, 255, 255)
         return frame
+
+    def retrieve(self) -> Optional[np.ndarray]:
+        return self.render(
+            self.height, self.width, self._n, bg=self._bg, yy=self._yy)
 
     def close(self) -> None:
         self._open = False
@@ -336,8 +351,16 @@ def open_source(url: str, prefer: str = "") -> VideoSource:
     ``opencv`` / ``packet`` for A/B and fallback testing."""
     import os
 
-    if urlparse(url).scheme == "test":
+    scheme = urlparse(url).scheme
+    if scheme == "test":
         return SyntheticSource(url)
+    if scheme == "replay":
+        # Deterministic re-delivery of a recorded trace (replay/player.py):
+        # replay://<trace-path>?device=<id>&pace=1|0. Lazy import — the
+        # replay plane must not load for live-camera workers.
+        from ..replay.player import ReplaySource
+
+        return ReplaySource(url)
     prefer = prefer or os.environ.get("vep_source", "")
     if prefer == "opencv":
         return OpenCVSource(url)

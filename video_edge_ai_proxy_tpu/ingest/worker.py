@@ -91,6 +91,11 @@ class WorkerConfig:
     redis_password: str = ""
     redis_db: int = 0
     max_frames: int = 0  # 0 = endless; tests set a bound
+    # Flight recorder (replay/recorder.py): non-empty = write
+    # <trace_dir>/<device_id>.vtrace capturing every published frame
+    # (packet timing + pixels, or the pattern seed for synthetic sources)
+    # for deterministic replay via replay://.
+    trace_dir: str = ""
 
     @classmethod
     def from_env(cls) -> "WorkerConfig":
@@ -110,6 +115,7 @@ class WorkerConfig:
             redis_password=env.get("vep_redis_password", ""),
             redis_db=int(env.get("vep_redis_db", "0") or 0),
             max_frames=int(env.get("vep_max_frames", "0") or 0),
+            trace_dir=env.get("vep_trace_dir", ""),
         )
 
 
@@ -151,6 +157,7 @@ class IngestWorker:
         self._gop_info = None  # StreamInfo captured at GOP open
         self._gop_audio_info = None  # audio StreamInfo captured at GOP open
         self._audio_packets = 0
+        self._recorder = None  # flight recorder (cfg.trace_dir), built in run()
 
     # -- control-plane reads (per packet; shm KV, nanosecond-cheap) --
 
@@ -302,6 +309,21 @@ class IngestWorker:
         self.bus.create_stream(
             cfg.device_id, frame_bytes, slots=max(2, cfg.in_memory_buffer + 1)
         )
+        if cfg.trace_dir:
+            # Flight recorder (replay/): one trace per camera, opened once
+            # geometry is known. Lazy import keeps live-camera workers free
+            # of the replay plane.
+            from ..replay.recorder import TraceRecorder
+
+            os.makedirs(cfg.trace_dir, exist_ok=True)
+            self._recorder = TraceRecorder(
+                os.path.join(cfg.trace_dir, f"{cfg.device_id}.vtrace"))
+            self._recorder.record_stream(
+                cfg.device_id,
+                width=self.source.width, height=self.source.height,
+                fps=self.source.fps, gop=getattr(self.source, "gop", 0),
+                kind=getattr(self.source, "kind", ""),
+            )
         if cfg.disk_buffer_path:
             self._archiver = SegmentArchiver(cfg.disk_buffer_path)
             self._archiver.start()
@@ -449,6 +471,16 @@ class IngestWorker:
                         )
                         self.bus.publish(cfg.device_id, frame, meta)
                     self._published += 1
+                    if self._recorder is not None:
+                        # Record what was published: synthetic frames are
+                        # fully determined by (w, h, n), so the trace keeps
+                        # the seed, not the pixels.
+                        synth = None
+                        if getattr(self.source, "kind", "") == "synthetic":
+                            synth = {"w": frame.shape[1],
+                                     "h": frame.shape[0], "n": pkt.packet}
+                        self._recorder.record_frame(
+                            cfg.device_id, frame, meta, synth=synth)
                     self._fps_window.append(time.monotonic())
                     self._archive_frame(frame, meta)
                     if self._passthrough is not None and not self._packet_mode:
@@ -478,6 +510,8 @@ class IngestWorker:
                 _safe("archiver", self._archiver.stop)
             if self._passthrough is not None:
                 _safe("passthrough", self._passthrough.close)
+            if self._recorder is not None:
+                _safe("trace recorder", self._recorder.close)
             _safe("source", self.source.close)
             log.info(
                 "ingest worker down: device=%s packets=%d decoded=%d",
@@ -510,6 +544,8 @@ def main(argv: Optional[list[str]] = None) -> None:
     # like the reference's env-var spawn interface.
     p.add_argument("--redis_db", type=int, default=env_cfg.redis_db)
     p.add_argument("--max_frames", type=int, default=env_cfg.max_frames)
+    p.add_argument("--trace_dir", default=env_cfg.trace_dir,
+                   help="flight-recorder output dir (replay/)")
     args = p.parse_args(argv)
     if not args.rtsp or not args.device_id:
         p.error("--rtsp and --device_id are required (or env contract)")
@@ -525,6 +561,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         redis_password=env_cfg.redis_password,  # env-only (see above)
         redis_db=args.redis_db,
         max_frames=args.max_frames,
+        trace_dir=args.trace_dir,
     )
     worker = IngestWorker(cfg)
 

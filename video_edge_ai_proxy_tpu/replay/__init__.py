@@ -1,0 +1,54 @@
+"""Deterministic record/replay + chaos-soak subsystem (ISSUE r6 tentpole).
+
+A flight recorder for the whole pipeline: the trace format (`trace.py`)
+captures per-camera packet/frame events from the ingest worker and the bus
+publish path (`recorder.py`); the player (`player.py` + the ``replay://``
+URL scheme in ``ingest/sources.py``) re-delivers them deterministically —
+byte-identical frames across runs — so the SAME traffic can drive the full
+pipeline ingest→bus→collector→engine→serve. `faults.py`/`harness.py` layer
+scripted chaos (camera kill/re-add, frame gaps, bus stall, slow
+subscribers) on top for fleet soaks, and `checksum.py` is the shared
+content-derived result checksum (quantized winning boxes+classes mod 2^31)
+used by the harness, bench.py, tools/bench_levers.py and
+tools/bench_configs.py.
+
+The reference repo has no counterpart: its integration story was manual
+docker-compose driving (``README.md:109-136``) and every perf/robustness
+claim was unreproducible. MOSAIC (arxiv 2305.03222) argues end-to-end
+benchmarking of edge video pipelines needs exactly this replay plane.
+
+No jax imports at module scope anywhere in this package: recording runs
+inside ingest workers whose control plane must stay importable without
+initializing a backend (CLAUDE.md conventions).
+"""
+
+from .checksum import (
+    CHECKSUM_MASK,
+    device_checksum,
+    fold_checksum,
+    golden_lookup,
+    zero_class_prior,
+)
+from .faults import FaultEvent, FaultPlan
+from .player import ReplaySource, TracePlayer
+from .recorder import RecordingBus, TraceRecorder
+from .trace import TRACE_MAGIC, TRACE_VERSION, TraceError, TraceWriter, read_trace
+
+__all__ = [
+    "CHECKSUM_MASK",
+    "device_checksum",
+    "fold_checksum",
+    "golden_lookup",
+    "zero_class_prior",
+    "FaultEvent",
+    "FaultPlan",
+    "ReplaySource",
+    "TracePlayer",
+    "RecordingBus",
+    "TraceRecorder",
+    "TRACE_MAGIC",
+    "TRACE_VERSION",
+    "TraceError",
+    "TraceWriter",
+    "read_trace",
+]

@@ -1,0 +1,159 @@
+"""Content-derived result checksum (ISSUE r6 tentpole part 4).
+
+The r4/r5 bench "checksum" was ``valid.sum()`` — a SHAPE constant: with the
+class prior zeroed the candidate set saturates and every run of a correct
+OR box-broken program returns exactly ``max_det * batch * iters`` (VERDICT
+r5 weak #1: "a box-decode bug cannot trip it"). This module replaces it
+with an integer hash of the actual numerics, shared by every recorder of
+numbers (bench.py, tools/bench_levers.py, tools/bench_configs.py, the
+replay harness):
+
+    detect:   sum over valid detections of
+                  1*x1 + 3*y1 + 5*x2 + 7*y2          (boxes quantized to px)
+                + 11*class_id + 13*round(score*1000)
+    embed:    sum of round(embedding * 100)
+    classify: sum of top_ids + round(top_probs * 1000)
+
+accumulated in int32 (wraparound is two's-complement, deterministic) and
+masked to mod 2^31 — so the value fits every JSON consumer and matches
+across hosts. A one-element weight perturbation moves scores -> moves the
+hash (tests/test_replay.py proves it); identical traffic + identical
+weights reproduce it bit-exactly, which is what the golden table pins.
+
+Goldens live in ``replay/goldens.json`` keyed ``<tool>:<program>:<backend>``.
+Missing golden = record-only (the artifact carries the value to commit);
+present + mismatch = the caller fails loudly (bench.py integrity gate).
+
+jax imports stay inside functions (CLAUDE.md: control-plane code must
+import without initializing a backend).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+CHECKSUM_MASK = 0x7FFFFFFF  # mod 2^31
+GOLDENS_PATH = os.path.join(os.path.dirname(__file__), "goldens.json")
+
+_BOX_W = (1, 3, 5, 7)
+_CLS_W = 11
+_SCORE_W = 13
+
+
+def device_checksum(out: dict):
+    """Serving-step output tree -> int32 scalar (jax; scan-foldable).
+
+    Handles all three serving families by their output signature
+    (engine/runner.py build_serving_step contract): detect
+    {boxes, scores, classes, valid}, embed {embedding}, classify/video
+    {top_probs, top_ids}.
+    """
+    import jax.numpy as jnp
+
+    if "boxes" in out:
+        v = out["valid"].astype(jnp.int32)
+        q = jnp.round(out["boxes"].astype(jnp.float32)).astype(jnp.int32)
+        w = jnp.asarray(_BOX_W, jnp.int32)
+        s = jnp.sum(q * w * v[..., None], dtype=jnp.int32)
+        s = s + jnp.sum(
+            (_CLS_W * out["classes"].astype(jnp.int32)
+             + _SCORE_W * jnp.round(
+                 out["scores"].astype(jnp.float32) * 1000.0
+             ).astype(jnp.int32)) * v,
+            dtype=jnp.int32,
+        )
+        return s
+    if "embedding" in out:
+        return jnp.sum(
+            jnp.round(out["embedding"].astype(jnp.float32) * 100.0
+                      ).astype(jnp.int32),
+            dtype=jnp.int32,
+        )
+    return jnp.sum(out["top_ids"].astype(jnp.int32), dtype=jnp.int32) + \
+        jnp.sum(jnp.round(out["top_probs"].astype(jnp.float32) * 1000.0
+                          ).astype(jnp.int32), dtype=jnp.int32)
+
+
+def fold_checksum(carry, out: dict):
+    """Scan-body accumulator: carry (int32 scalar) -> new masked carry.
+    Masking every fold keeps the value in [0, 2^31) at all times."""
+    import jax.numpy as jnp
+
+    return (carry + device_checksum(out)) & jnp.int32(CHECKSUM_MASK)
+
+
+def finalize_checksum(total) -> int:
+    """Device/host accumulator -> committed int in [0, 2^31)."""
+    return int(total) & CHECKSUM_MASK
+
+
+def zero_class_prior(variables):
+    """Zero the detection head's class-prior biases for BENCH programs.
+
+    The from-scratch-trainability prior (models/yolov8.py: cls{i}_out bias
+    = log(5/nc/(640/stride)^2) ~= -11.5) puts every random-init score at
+    ~1e-5 — below the NMS score threshold — so a random-init benchmark's
+    NMS loop would run over empty candidate sets and its checksum would be
+    0 (the r4 failure mode, VERDICT r4 weak #2). Zeroing ONLY these bias
+    vectors restores the measured regime: sigmoid(~0) ~= 0.5 > 0.25
+    threshold, candidate sets saturate, the suppression loop does real
+    work. The compute graph is unchanged (same bias add, different
+    constants) — a production engine with an imported checkpoint
+    overwrites these values anyway. Lives here (not bench.py) because the
+    replay harness needs the identical program transform for its
+    deterministic checksums."""
+    import jax.numpy as jnp
+
+    def walk(node, in_cls_out=False):
+        if isinstance(node, dict):
+            return {
+                k: walk(
+                    v,
+                    in_cls_out or (
+                        isinstance(k, str)
+                        and k.startswith("cls") and k.endswith("_out")
+                    ),
+                )
+                for k, v in node.items()
+            }
+        if in_cls_out and getattr(node, "ndim", None) == 1:
+            return jnp.zeros_like(node)
+        return node
+
+    return walk(variables)
+
+
+def load_goldens(path: Optional[str] = None) -> dict:
+    path = path or GOLDENS_PATH
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    return data if isinstance(data, dict) else {}
+
+
+def golden_lookup(key: str, path: Optional[str] = None) -> Optional[int]:
+    """Committed golden for ``key`` (e.g. "bench:yolov8n:cpu:2x2"), or
+    None when no golden exists for this program/backend yet — callers
+    record the fresh value instead of failing."""
+    val = load_goldens(path).get(key)
+    return int(val) if isinstance(val, int) else None
+
+
+def check_golden(
+    key: str, value: int, *, tool: str, path: Optional[str] = None,
+) -> Optional[int]:
+    """Compare ``value`` against the committed golden. Returns the golden
+    (None = not committed). Raises SystemExit on drift — numeric drift in
+    a program whose inputs and weights are pinned is a correctness bug,
+    not noise, and must never be silently committed into an artifact."""
+    golden = golden_lookup(key, path)
+    if golden is not None and golden != value:
+        raise SystemExit(
+            f"{tool} checksum drift: {key} produced {value}, golden is "
+            f"{golden} — the program's numerics changed "
+            f"(replay/goldens.json)")
+    return golden

@@ -1,0 +1,102 @@
+"""Scripted fault plans for chaos soaks (ISSUE r6 tentpole part 3).
+
+A FaultPlan is a deterministic, time-ordered script of pipeline faults —
+the chaos in a soak run is part of the experiment's inputs, not a random
+draw, so a failing soak replays exactly. Fault kinds and what injects
+them (replay/harness.py):
+
+- ``camera_kill`` / ``camera_restore`` — stop a camera's publisher and
+  drop its bus stream mid-run / re-add it (collector churn: cursors,
+  geometry cache, tracker + _ann_state GC must all survive).
+- ``frame_gap`` — suppress one camera's publishes for ``duration_s``
+  (burst loss: the latest-wins collector must idle the stream, not stall
+  the batch).
+- ``bus_stall`` — delay EVERY publish for ``duration_s`` (a wedged shm
+  writer / slow Redis: the engine tick must degrade, not deadlock).
+- ``slow_subscriber`` — stop draining the result subscription for
+  ``duration_s`` (backpressure: the engine must drop-and-count via
+  subscriber_drops, never block the drain thread).
+
+JSON round-trip so plans can be committed next to artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+KINDS = (
+    "camera_kill", "camera_restore", "frame_gap", "bus_stall",
+    "slow_subscriber",
+)
+
+
+@dataclass(order=True)
+class FaultEvent:
+    at_s: float                 # seconds from soak start
+    kind: str = field(compare=False)
+    device_id: str = field(default="", compare=False)
+    duration_s: float = field(default=0.0, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+class FaultPlan:
+    """Time-ordered fault script with a cursor (pop_due)."""
+
+    def __init__(self, events=()):
+        self.events = sorted(events)
+        self._i = 0
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def pop_due(self, now_s: float) -> list[FaultEvent]:
+        """Events whose time has come since the last call (monotone)."""
+        due = []
+        while self._i < len(self.events) and \
+                self.events[self._i].at_s <= now_s:
+            due.append(self.events[self._i])
+            self._i += 1
+        return due
+
+    def to_json(self) -> str:
+        return json.dumps([asdict(e) for e in self.events], indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls([FaultEvent(**e) for e in json.loads(text)])
+
+    @classmethod
+    def default_churn(
+        cls, device_ids, duration_s: float,
+    ) -> "FaultPlan":
+        """The acceptance-run script, scaled to the soak window: one
+        camera killed at 25% and re-added at 55% (churn across a long gap
+        — its collector/tracker state must GC and rebuild), a frame-gap
+        burst on a second camera, one global bus stall, and one
+        slow-subscriber window — each in its own quiet period so the
+        artifact attributes effects to causes."""
+        devs = sorted(device_ids)
+        ev = []
+        if devs:
+            ev += [
+                FaultEvent(at_s=duration_s * 0.25, kind="camera_kill",
+                           device_id=devs[0]),
+                FaultEvent(at_s=duration_s * 0.55, kind="camera_restore",
+                           device_id=devs[0]),
+            ]
+        if len(devs) > 1:
+            ev.append(FaultEvent(
+                at_s=duration_s * 0.35, kind="frame_gap",
+                device_id=devs[-1],
+                duration_s=max(2.0, duration_s * 0.05)))
+        ev.append(FaultEvent(
+            at_s=duration_s * 0.70, kind="bus_stall",
+            duration_s=max(1.0, duration_s * 0.02)))
+        ev.append(FaultEvent(
+            at_s=duration_s * 0.85, kind="slow_subscriber",
+            duration_s=max(2.0, duration_s * 0.05)))
+        return cls(ev)

@@ -470,6 +470,8 @@ class ProcessManager:
             env = self._contract_env(record)
             if "vep_max_frames" in os.environ:  # test lever rides along
                 env["vep_max_frames"] = os.environ["vep_max_frames"]
+            if "vep_trace_dir" in os.environ:  # flight recorder rides along
+                env["vep_trace_dir"] = os.environ["vep_trace_dir"]
             handle, tail, rt = self._launcher.spawn(record.name, env)
             entry.proc = handle
             entry.tail = tail
