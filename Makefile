@@ -1,7 +1,8 @@
 # Build / codegen targets (reference Makefile parity: proto codegen was its
 # whole build; ours adds the native bus lib and test/bench shortcuts).
 
-.PHONY: all proto native install test bench graft clean redis-conformance
+.PHONY: all proto native install test bench graft clean redis-conformance \
+	obs-smoke
 
 all: proto native
 
@@ -46,6 +47,18 @@ test:
 
 bench:
 	python bench.py
+
+# Observability smoke: a short instrumented replay soak (CPU backend,
+# tiny twins), exporting the sampled frame-lineage spans as Chrome trace
+# JSON and schema-validating the export. Proves one replay run yields the
+# stage-segmented latency breakdown + a loadable trace (ISSUE obs
+# acceptance). ~1 min.
+obs-smoke:
+	python tools/soak_replay.py --duration 15 --no-e2e \
+		--out /tmp/vep_obs_smoke.json --trace-out /tmp/vep_obs_trace.json
+	python tools/obs_export.py /tmp/vep_obs_trace.json --check
+	@python -c "import json; d=json.load(open('/tmp/vep_obs_smoke.json')); \
+		print(json.dumps(d['soak']['obs']['stage_breakdown'], indent=2))"
 
 # One-command genuine-Redis conformance run (VERDICT r3 #8): on any host
 # with redis-server on PATH, re-runs every Redis-plane test against the

@@ -289,6 +289,12 @@ class TestRestAux:
                 fam = line.split()[2]
             elif line and not line.startswith("#"):
                 assert fam is not None and line.startswith(fam), line
+        # Full text-format lint (valid TYPE tokens, label escaping,
+        # numeric values, no duplicate samples, histogram suffixes).
+        from video_edge_ai_proxy_tpu.obs.metrics import lint_exposition
+
+        assert lint_exposition(text) == []
+        assert lint_exposition(body2.decode()) == []
 
     def test_portal_served_at_root(self, server):
         status, body = self._get(server, "/")

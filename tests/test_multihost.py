@@ -25,7 +25,7 @@ WORKER = textwrap.dedent("""
     import numpy as np
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from video_edge_ai_proxy_tpu.parallel.compat import shard_map
 
     from video_edge_ai_proxy_tpu import parallel
 

@@ -1,0 +1,351 @@
+"""Observability tests: metrics registry + exposition lint, frame-lineage
+spans (sampling, stage breakdown, Chrome trace export), the once-per-
+episode watchdog, and the engine satellite regressions (stats() snapshot
+isolation, EMA zero-sentinel fix)."""
+
+import dataclasses
+import json
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.obs.metrics import (
+    BUCKET_BOUNDS,
+    N_BUCKETS,
+    Registry,
+    bucket_index,
+    lint_exposition,
+)
+from video_edge_ai_proxy_tpu.obs.spans import (
+    SpanRecorder,
+    stage_breakdown,
+    to_chrome_trace,
+    validate_chrome_trace,
+)
+from video_edge_ai_proxy_tpu.obs.watch import Watchdog
+
+
+class TestBuckets:
+    def test_bucket_index_boundaries(self):
+        # <= 0 counts in bucket 0 (a 0.0 ms latency is a legitimate
+        # observation — the EMA-sentinel bug this layer replaces).
+        assert bucket_index(0.0) == 0
+        assert bucket_index(-1.0) == 0
+        # Exact powers of two land on their own le= bound (value <= le).
+        for i, bound in enumerate(BUCKET_BOUNDS):
+            assert bucket_index(bound) == i, bound
+        # Just above a bound spills to the next bucket; huge -> overflow.
+        assert bucket_index(BUCKET_BOUNDS[3] * 1.001) == 4
+        assert bucket_index(BUCKET_BOUNDS[-1] * 2) == N_BUCKETS - 1
+
+
+class TestRegistry:
+    def test_counter_gauge_basics(self):
+        reg = Registry()
+        c = reg.counter("t_frames_total", "frames")
+        c.inc()
+        c.inc(2.0)
+        assert c.value == 3.0
+        g = reg.gauge("t_depth", "depth")
+        g.set(5.0)
+        g.inc()
+        g.dec(2.0)
+        assert g.value == 4.0
+        # get-or-create returns the same family; kind/labels conflict raises
+        assert reg.counter("t_frames_total", "frames") is c
+        with pytest.raises(ValueError):
+            reg.gauge("t_frames_total", "frames")
+        with pytest.raises(ValueError):
+            reg.counter("t_frames_total", "frames", ("stream",))
+
+    def test_histogram_percentiles_without_samples(self):
+        reg = Registry()
+        h = reg.histogram("t_lat_ms", "lat").labels()
+        assert h.percentile(50) is None
+        for v in [1.0] * 50 + [100.0] * 50:
+            h.observe(v)
+        assert h.count == 100
+        assert h.sum == pytest.approx(5050.0)
+        # p50 interpolates to the top of the bucket holding 1.0
+        assert h.percentile(50) == pytest.approx(1.0)
+        # p90 lands inside 100.0's (64, 128] bucket
+        assert 64.0 < h.percentile(90) <= 128.0
+        snap = h.snapshot()
+        assert snap["count"] == 100
+        assert snap["avg"] == pytest.approx(50.5)
+        # overflow observations clamp to the largest finite bound
+        h.observe(1e9)
+        assert h.percentile(99.9) == BUCKET_BOUNDS[-1]
+
+    def test_render_lints_clean_and_escapes_labels(self):
+        reg = Registry()
+        reg.counter("t_esc_total", 'weird "help"\nline', ("stream",)).labels(
+            'cam"\\\nx').inc()
+        reg.gauge("t_g", "g").set(1.5)
+        reg.histogram("t_h_ms", "h", ("model",)).labels("m1").observe(3.0)
+        text = reg.render()
+        assert lint_exposition(text) == []
+        assert r'stream="cam\"\\\nx"' in text
+        # snapshot() is JSON-able as-is (artifact embedding)
+        json.dumps(reg.snapshot())
+
+    def test_lint_catches_malformed_exposition(self):
+        bad = "\n".join([
+            "vep_orphan 1",                  # sample with no TYPE
+            "# TYPE vep_bogus flavor",       # invalid TYPE token
+            "# TYPE vep_dup counter",
+            'vep_dup{a="1"} 1',
+            'vep_dup{a="1"} 2',              # duplicate sample
+            "vep_dup nope",                  # non-numeric value
+        ])
+        assert lint_exposition(bad) != []
+
+    def test_family_clear_drops_children(self):
+        reg = Registry()
+        fam = reg.gauge("t_per_worker", "w", ("stream",))
+        fam.labels("cam1").set(1)
+        assert "cam1" in reg.render()
+        fam.clear()
+        assert "t_per_worker" not in reg.render()
+
+
+class TestSpans:
+    def test_sampling_deterministic_and_gated(self):
+        rec = SpanRecorder(sample_every=4, enabled=True)
+        assert [fid for fid in range(12) if rec.sampled(fid)] == [0, 4, 8]
+        rec.configure(enabled=False)
+        assert not rec.sampled(0)
+
+    def test_ring_bound(self):
+        rec = SpanRecorder(enabled=True, sample_every=1, ring=4)
+        for i in range(10):
+            rec.record("cam1", "collect", i)
+        evs = rec.events("cam1")
+        assert len(evs) == 4
+        assert evs[-1]["frame"] == 9
+
+    def test_stage_breakdown_legs(self):
+        # One complete lineage with known leg durations: publish at t0
+        # (pub_ms carried by the collect span — the subprocess-worker
+        # case), collect +5 ms, submit +2 ms, device 4 ms, emit +0.5 ms.
+        rec = SpanRecorder(enabled=True, sample_every=1)
+        t0 = 1000.0
+        rec.record("cam1", "collect", 7, ts=t0 + 0.005, pub_ms=t0 * 1000.0)
+        rec.record("cam1", "submit", 7, ts=t0 + 0.007)
+        rec.record("cam1", "device", 7, ts=t0 + 0.011, dur_ms=4.0)
+        rec.record("cam1", "emit", 7, ts=t0 + 0.0115)
+        br = stage_breakdown(rec.events())
+        assert br["ingest_bus"]["avg"] == pytest.approx(5.0, abs=0.01)
+        assert br["batch"]["avg"] == pytest.approx(2.0, abs=0.01)
+        assert br["device"]["avg"] == pytest.approx(4.0, abs=0.01)
+        assert br["emit"]["avg"] == pytest.approx(0.5, abs=0.01)
+        assert br["total"]["avg"] == pytest.approx(11.5, abs=0.01)
+        assert br["total"]["count"] == 1
+
+    def test_partial_lineage_contributes_partial_legs(self):
+        rec = SpanRecorder(enabled=True, sample_every=1)
+        rec.record("cam1", "device", 3, ts=2.0, dur_ms=4.0)
+        br = stage_breakdown(rec.events())
+        assert br["device"]["count"] == 1
+        assert br["total"]["count"] == 0
+
+    def test_chrome_trace_export_validates_and_roundtrips(self):
+        rec = SpanRecorder(enabled=True, sample_every=1)
+        rec.record("cam1", "device", 3, ts=2.0, dur_ms=4.0, bucket=2)
+        rec.record("cam1", "emit", 3, ts=2.001)
+        obj = to_chrome_trace(rec.events())
+        assert validate_chrome_trace(obj) == []
+        obj = json.loads(json.dumps(obj))          # JSON-able as-is
+        complete = [e for e in obj["traceEvents"] if e.get("ph") == "X"]
+        assert len(complete) == 1
+        # ph "X" carries start ts (end - dur) in microseconds
+        assert complete[0]["dur"] == pytest.approx(4000.0)
+        assert complete[0]["ts"] == pytest.approx(2.0e6 - 4000.0)
+        assert complete[0]["args"]["bucket"] == 2
+        # the validator actually rejects malformed traces
+        assert validate_chrome_trace({"traceEvents": [{"ph": "X"}]}) != []
+        assert validate_chrome_trace([]) != []
+
+
+class TestWatchdog:
+    def test_once_per_episode(self, caplog):
+        wd = Watchdog()
+        with caplog.at_level(logging.INFO, logger="vep.obs.watch"):
+            assert wd.check("depth", 5, above=2) is True   # opens: WARNING
+            assert wd.check("depth", 9, above=2) is True   # silent
+            assert wd.check("depth", 1, above=2) is False  # closes: INFO
+            assert wd.check("depth", 7, above=2) is True   # new episode
+        warns = [r for r in caplog.records if r.levelno == logging.WARNING]
+        infos = [r for r in caplog.records if r.levelno == logging.INFO]
+        assert len(warns) == 2
+        assert len(infos) == 1
+        snap = wd.snapshot()
+        assert snap["episodes"]["depth"] == 2
+        assert snap["active"]["depth"]["peak"] == 7
+
+    def test_below_direction_and_validation(self):
+        wd = Watchdog()
+        with pytest.raises(ValueError):
+            wd.check("x", 1.0)
+        with pytest.raises(ValueError):
+            wd.check("x", 1.0, above=1.0, below=2.0)
+        assert wd.check("occupancy", 10.0, below=25.0) is True
+        assert wd.check("occupancy", 50.0, below=25.0) is False
+        assert wd.snapshot()["episodes"]["occupancy"] == 1
+        assert wd.active() == {}
+
+
+# ---------------------------------------------------------------------------
+# Engine satellite regressions (need the tiny models / CPU backend)
+# ---------------------------------------------------------------------------
+
+
+def _meta(w=32, h=32):
+    from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+
+    return FrameMeta(
+        width=w, height=h, channels=3,
+        timestamp_ms=int(time.time() * 1000), is_keyframe=True,
+    )
+
+
+def _publish(bus, device_id, w=32, h=32, value=128):
+    frame = np.full((h, w, 3), value, np.uint8)
+    return bus.publish(device_id, frame, _meta(w, h))
+
+
+@pytest.fixture()
+def bus():
+    from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+
+    b = MemoryFrameBus()
+    yield b
+    b.close()
+
+
+def _engine(bus, model="tiny_mobilenet_v2"):
+    from video_edge_ai_proxy_tpu.engine import InferenceEngine
+    from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+    from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+    cfg = EngineConfig(model=model, batch_buckets=(1, 2, 4), tick_ms=5)
+    eng = InferenceEngine(
+        bus, cfg, annotations=AnnotationQueue(handler=lambda batch: True))
+    eng.warmup()
+    return eng
+
+
+class TestEngineObsSatellites:
+    def test_ema_zero_first_latency_does_not_reseed(self):
+        """Regression: the old ``ema == 0.0`` sentinel re-seeded the EMA
+        forever for a stream whose first latency measured a legitimate
+        0.0 ms; the explicit flag blends from the second sample on."""
+        from video_edge_ai_proxy_tpu.engine.runner import StreamStats
+
+        st = StreamStats()
+        st.note_latency(0.0)
+        assert st.ema_initialized
+        assert st.ema_latency_ms == 0.0
+        st.note_latency(10.0)
+        assert st.ema_latency_ms == pytest.approx(1.0)   # sentinel gave 10.0
+        st.note_latency(10.0)
+        assert st.ema_latency_ms == pytest.approx(1.9)
+
+    def test_stats_returns_immutable_snapshots(self, bus):
+        """Regression: stats() used to hand out the LIVE StreamStats
+        objects the drain thread mutates — callers could read torn state
+        or mutate engine internals through them."""
+        from video_edge_ai_proxy_tpu.engine.runner import StreamStatsView
+
+        bus.create_stream("cam1", 32 * 32 * 3)
+        eng = _engine(bus)
+        eng.start()
+        try:
+            deadline = time.time() + 30
+            while not eng.stats().get("cam1") and time.time() < deadline:
+                _publish(bus, "cam1")
+                time.sleep(0.05)
+        finally:
+            eng.stop()
+        view = eng.stats()["cam1"]
+        assert isinstance(view, StreamStatsView)
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            view.frames = 999
+        # later engine-side mutation must not leak into an existing view
+        live = eng._stats["cam1"]
+        before = view.frames
+        live.frames += 100
+        assert view.frames == before
+        assert eng.stats()["cam1"].frames == live.frames
+
+    def test_engine_populates_registry_and_renders_clean(self, bus):
+        from video_edge_ai_proxy_tpu.obs import registry
+
+        bus.create_stream("cam1", 32 * 32 * 3)
+        eng = _engine(bus)
+        eng.start()
+        try:
+            deadline = time.time() + 30
+            while not eng.stats().get("cam1") and time.time() < deadline:
+                _publish(bus, "cam1")
+                time.sleep(0.05)
+        finally:
+            eng.stop()
+        fam = {f.name: f for f in registry.families()}
+        assert fam["vep_engine_ticks_total"].value >= 1
+        assert fam["vep_stream_frames_total"].labels("cam1").value >= 1
+        assert fam["vep_stream_latency_ms"].labels("cam1").count >= 1
+        text = registry.render()
+        assert 'vep_stream_frames_total{stream="cam1"}' in text
+        assert lint_exposition(text) == []
+
+    def test_collector_counts_superseded_frames(self, bus):
+        """Two frames published before one collect: latest wins, the
+        cursor jump is accounted as a skipped frame."""
+        from video_edge_ai_proxy_tpu.engine.collector import Collector
+        from video_edge_ai_proxy_tpu.obs import registry
+
+        fam = registry.counter(
+            "vep_frames_skipped_total",
+            "Frames superseded before read (latest-wins drops)", ("stream",))
+        base = fam.labels("skipcam").value
+        bus.create_stream("skipcam", 32 * 32 * 3)
+        col = Collector(bus, buckets=(1, 2, 4))
+        _publish(bus, "skipcam", value=1)
+        col.collect()                      # seeds the cursor at seq 1
+        for v in (2, 3, 4):
+            _publish(bus, "skipcam", value=v)
+        groups = col.collect()
+        assert groups and groups[0].frames[0, 0, 0, 0] == 4
+        assert fam.labels("skipcam").value == base + 2
+
+    def test_engine_emits_sampled_lineage_spans(self, bus):
+        """With tracing on and sample_every=1, a served frame leaves
+        collect/submit/device/emit spans that fold into a breakdown."""
+        from video_edge_ai_proxy_tpu.obs import tracer
+
+        bus.create_stream("cam1", 32 * 32 * 3)
+        eng = _engine(bus)
+        prev = (tracer.enabled, tracer.sample_every)
+        tracer.configure(enabled=True, sample_every=1)
+        tracer.clear()
+        eng.start()
+        try:
+            deadline = time.time() + 30
+            while not eng.stats().get("cam1") and time.time() < deadline:
+                _publish(bus, "cam1")
+                time.sleep(0.05)
+        finally:
+            eng.stop()
+            tracer.configure(enabled=prev[0], sample_every=prev[1])
+        events = tracer.events("cam1")
+        stages = {ev["stage"] for ev in events}
+        assert {"collect", "submit", "device", "emit"} <= stages
+        br = stage_breakdown(events)
+        assert br["total"]["count"] >= 1
+        assert br["device"]["count"] >= 1
+        obj = to_chrome_trace(events)
+        assert validate_chrome_trace(obj) == []
+        tracer.clear()

@@ -1,0 +1,104 @@
+"""Export frame-lineage span events as Chrome trace-event JSON.
+
+Takes span events from any of the places the obs layer surfaces them —
+the live ``/api/v1/trace`` endpoint, a soak run's ``--trace-out`` file,
+or a raw event list — and produces a file loadable in chrome://tracing /
+Perfetto. Input shape is auto-detected:
+
+- ``{"events": [...]}``        — /api/v1/trace response
+- ``[{...}, ...]``             — bare span-event list
+- ``{"traceEvents": [...]}``   — already a Chrome trace (pass-through)
+
+Modes::
+
+  python tools/obs_export.py spans.json -o trace.json    # convert
+  python tools/obs_export.py trace.json --check          # validate only
+  python tools/obs_export.py spans.json --breakdown      # per-leg table
+  curl -s :8080/api/v1/trace | python tools/obs_export.py - -o trace.json
+
+``--check`` schema-validates the (converted) trace and exits nonzero on
+problems — ``make obs-smoke`` gates on it. Pure Python, no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from video_edge_ai_proxy_tpu.obs.spans import (  # noqa: E402
+    stage_breakdown,
+    to_chrome_trace,
+    validate_chrome_trace,
+)
+
+
+def load_events(obj):
+    """Auto-detect input shape -> (span_events or None, chrome_trace or
+    None). Exactly one of the pair is non-None."""
+    if isinstance(obj, list):
+        return obj, None
+    if isinstance(obj, dict):
+        if "traceEvents" in obj:
+            return None, obj
+        if isinstance(obj.get("events"), list):
+            return obj["events"], None
+    raise SystemExit(
+        "unrecognized input: expected a span-event list, an /api/v1/trace "
+        "response ({'events': [...]}), or a Chrome trace "
+        "({'traceEvents': [...]})")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("input", help="input JSON path, or - for stdin")
+    ap.add_argument("-o", "--out", default="",
+                    help="write Chrome trace JSON here")
+    ap.add_argument("--check", action="store_true",
+                    help="schema-validate the trace; exit 1 on problems")
+    ap.add_argument("--breakdown", action="store_true",
+                    help="print the per-leg latency breakdown (needs span "
+                         "events, not an already-converted trace)")
+    args = ap.parse_args(argv)
+
+    if args.input == "-":
+        obj = json.load(sys.stdin)
+    else:
+        with open(args.input) as f:
+            obj = json.load(f)
+    events, trace = load_events(obj)
+    if trace is None:
+        trace = to_chrome_trace(events)
+
+    if args.breakdown:
+        if events is None:
+            raise SystemExit(
+                "--breakdown needs span events; a Chrome trace has "
+                "already lost the lineage structure")
+        print(json.dumps(stage_breakdown(events), indent=2))
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(trace, f)
+            f.write("\n")
+
+    n = len(trace.get("traceEvents") or [])
+    if args.check:
+        problems = validate_chrome_trace(trace)
+        if problems:
+            for p in problems:
+                print(f"PROBLEM: {p}", file=sys.stderr)
+            raise SystemExit(
+                f"trace check FAILED: {len(problems)} problem(s) "
+                f"in {n} events")
+        print(json.dumps({"check": "ok", "events": n,
+                          "out": args.out or None}))
+    elif not args.breakdown:
+        print(json.dumps({"events": n, "out": args.out or None}))
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,11 @@ def main(argv=None) -> None:
     ap.add_argument("--size", default="128x96",
                     help="camera geometry WxH (tiny models want small "
                          "frames)")
+    ap.add_argument("--trace-out", default="",
+                    help="write the soak's sampled frame-lineage spans as "
+                         "Chrome trace-event JSON (load in Perfetto / "
+                         "chrome://tracing; validate with "
+                         "tools/obs_export.py --check)")
     args = ap.parse_args(argv)
 
     import jax
@@ -131,11 +136,26 @@ def main(argv=None) -> None:
         "step_cache": soak["step_cache"]["final"],
         "step_cache_stable": soak["step_cache"]["stable"],
         "per_family_latency_ms": soak["per_family_latency_ms"],
+        "stage_breakdown": soak["obs"]["stage_breakdown"],
     }), flush=True)
     if soak["misrouted_results"]:
         raise SystemExit(
             f"soak failure: {soak['misrouted_results']} results crossed "
             f"model families (examples: {soak['misrouted_examples']})")
+    if args.trace_out:
+        # run_fleet_soak leaves its span rings intact after restoring the
+        # tracer config, so the export happens here, post-run.
+        from video_edge_ai_proxy_tpu.obs import tracer
+        from video_edge_ai_proxy_tpu.obs.spans import to_chrome_trace
+        trace_obj = to_chrome_trace(tracer.events())
+        with open(args.trace_out, "w") as f:
+            json.dump(trace_obj, f)
+            f.write("\n")
+        print(json.dumps({
+            "leg": "trace",
+            "events": len(trace_obj["traceEvents"]),
+            "artifact": args.trace_out,
+        }), flush=True)
 
     # -- leg 3: full-pipeline e2e ----------------------------------------
     if args.e2e:

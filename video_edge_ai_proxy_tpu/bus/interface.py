@@ -35,6 +35,22 @@ FRAME_TYPE_NAMES = {0: "", 1: "I", 2: "P", 3: "B"}
 FRAME_TYPE_CODES = {v: k for k, v in FRAME_TYPE_NAMES.items()}
 
 
+def note_publish(backend: str, device_id: str, nbytes: int) -> None:
+    """Shared publish accounting for every bus backend (obs/metrics.py):
+    frames and payload bytes, labeled by backend so a mixed fleet (shm
+    cameras + redis cameras) stays separable in one scrape."""
+    from ..obs import registry as obs_registry
+
+    obs_registry.counter(
+        "vep_bus_published_total", "Frames published to the bus",
+        ("backend", "stream"),
+    ).labels(backend, device_id).inc()
+    obs_registry.counter(
+        "vep_bus_published_bytes_total", "Frame payload bytes published",
+        ("backend", "stream"),
+    ).labels(backend, device_id).inc(float(nbytes))
+
+
 class RingSlotTooSmall(OSError):
     """A frame exceeded its shm ring slot. Distinct type so producers can
     grow-and-retry without confusing it with transport errors (a redis

@@ -13,7 +13,7 @@ from typing import Optional
 
 import numpy as np
 
-from .interface import Frame, FrameBus, FrameMeta
+from .interface import Frame, FrameBus, FrameMeta, note_publish
 
 
 class MemoryFrameBus(FrameBus):
@@ -44,6 +44,7 @@ class MemoryFrameBus(FrameBus):
         with self._db:
             self._db_value += 1
             self._db.notify_all()
+        note_publish("memory", device_id, data.nbytes)
         return seq
 
     def doorbell_token(self) -> int:

@@ -36,6 +36,7 @@ from .interface import (
     Frame,
     FrameBus,
     FrameMeta,
+    note_publish,
 )
 from .resp import RespClient, RespError
 
@@ -133,6 +134,7 @@ class RedisFrameBus(FrameBus):
             str(self._maxlen.get(device_id, 1)), "*",
             "data", vf.SerializeToString(),
         )
+        note_publish("redis", device_id, arr.nbytes)
         return _id_to_seq(entry_id)
 
     def read_latest(self, device_id: str, min_seq: int = 0) -> Optional[Frame]:

@@ -25,6 +25,7 @@ from .interface import (
     FrameBus,
     FrameMeta,
     RingSlotTooSmall,
+    note_publish,
 )
 from .native.build import build_library
 
@@ -261,6 +262,7 @@ class ShmFrameBus(FrameBus):
                 f"publish failed for {device_id} ({arr.nbytes} B > slot)"
             )
         self._lib.vb_doorbell_ring(self._db)
+        note_publish("shm", device_id, arr.nbytes)
         return int(seq)
 
     def _writer_revalidate(self, device_id: str, h: int) -> int:

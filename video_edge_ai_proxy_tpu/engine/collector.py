@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..bus.interface import Frame, FrameBus, FrameMeta
+from ..obs import registry as obs_registry, tracer
 
 
 @dataclass
@@ -106,6 +107,29 @@ class Collector:
         # active (plain collect path).
         self._window: Optional[dict] = None
         self._only: Optional[set] = None   # restrict to these ids (None = all)
+        # Latest-wins supersessions are BY DESIGN, but invisible drops are
+        # not: a cursor that jumps k>1 sequence numbers means k-1 frames
+        # were published and never read (camera outrunning the tick rate).
+        self._m_skipped = obs_registry.counter(
+            "vep_frames_skipped_total",
+            "Frames superseded before read (latest-wins drops)",
+            ("stream",),
+        )
+
+    def _note_read(self, device_id: str, seq: int, meta) -> None:
+        """Every cursor advance funnels here: counts latest-wins skips and
+        stamps the frame's ``collect`` lineage span. ``pub_ms`` rides the
+        span because the publish span usually lives in a worker
+        subprocess — the ingest->collect leg must be computable from the
+        engine side alone."""
+        prev = self._cursors.get(device_id, 0)
+        if prev and seq > prev + 1:
+            self._m_skipped.labels(device_id).inc(seq - prev - 1)
+        self._cursors[device_id] = seq
+        if meta is not None and tracer.sampled(meta.packet):
+            tracer.record(
+                device_id, "collect", meta.packet, pub_ms=meta.timestamp_ms
+            )
 
     def _stream_model(self, device_id: str):
         """(model name, clip_len) for one stream — per-stream override via
@@ -381,14 +405,14 @@ class Collector:
             if res is None:
                 continue
             if isinstance(res, Frame):   # geometry drifted mid-window
-                self._cursors[device_id] = res.seq
+                self._note_read(device_id, res.seq, res.meta)
                 if res.data.ndim == 3:
                     self._geom[device_id] = res.data.shape
                 win["spill"].append((device_id, g["model"], res))
                 drifted.append(device_id)
                 continue
             seq, meta = res
-            self._cursors[device_id] = seq
+            self._note_read(device_id, seq, meta)
             if slot is None:
                 g["slot"][device_id] = len(g["ids"])
                 g["ids"].append(device_id)
@@ -475,7 +499,7 @@ class Collector:
                     if res is None:
                         continue
                     if isinstance(res, Frame):   # geometry drifted
-                        self._cursors[device_id] = res.seq
+                        self._note_read(device_id, res.seq, res.meta)
                         if res.data.ndim == 3:   # corrupt 1-D frames must
                             # not poison the geometry cache (generic-path
                             # guard below applies here too)
@@ -483,7 +507,7 @@ class Collector:
                         spill.append((device_id, model, res))
                         continue
                     seq, meta = res
-                    self._cursors[device_id] = seq
+                    self._note_read(device_id, seq, meta)
                     ids.append(device_id)
                     metas.append(meta)
                 n = len(ids)
@@ -512,7 +536,7 @@ class Collector:
             )
             if frame is None:
                 continue
-            self._cursors[device_id] = frame.seq
+            self._note_read(device_id, frame.seq, frame.meta)
             model, clip_len = self._stream_model(device_id)
             if frame.data.ndim == 3:
                 self._geom[device_id] = frame.data.shape

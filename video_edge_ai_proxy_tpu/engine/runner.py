@@ -37,6 +37,8 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..bus.interface import FrameBus, FrameMeta
+from ..obs import registry as obs_registry, tracer
+from ..obs.watch import Watchdog
 from ..ops.nms import batched_nms
 from ..ops.preprocess import (
     preprocess_classify, preprocess_clip, preprocess_letterbox,
@@ -117,6 +119,32 @@ def build_serving_step(model, spec):
 
 @dataclass
 class StreamStats:
+    frames: int = 0
+    last_latency_ms: float = 0.0
+    ema_latency_ms: float = 0.0
+    last_batch: int = 0
+    # A first frame CAN legitimately measure 0.0 ms (synthetic sources
+    # stamp publish-time wall clock; sub-ms emit rounds to 0) — the seed
+    # flag, not the value, decides whether the EMA re-seeds.
+    ema_initialized: bool = False
+
+    def note_latency(self, latency_ms: float) -> None:
+        self.last_latency_ms = latency_ms
+        if self.ema_initialized:
+            self.ema_latency_ms = (
+                0.9 * self.ema_latency_ms + 0.1 * latency_ms)
+        else:
+            self.ema_latency_ms = latency_ms
+            self.ema_initialized = True
+
+
+@dataclass(frozen=True)
+class StreamStatsView:
+    """Immutable point-in-time copy handed out by `stats()`. The live
+    `StreamStats` objects are mutated by the drain thread; sharing them
+    with API handlers let a caller read torn (or worse, mutate engine)
+    state."""
+
     frames: int = 0
     last_latency_ms: float = 0.0
     ema_latency_ms: float = 0.0
@@ -229,6 +257,48 @@ class InferenceEngine:
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_spawn_lock = threading.Lock()
         self._probe_fn = None                    # jitted once, reused
+        # Unified metrics (obs/metrics.py): handles held here so hot-path
+        # observations skip the registry name lookup. Unlabeled families
+        # bind their singleton child eagerly — the sample then renders (as
+        # 0) from the first scrape, not from the first event. The registry
+        # is process-global — /metrics renders these directly.
+        self.watchdog = Watchdog()
+        self._m_ticks = obs_registry.counter(
+            "vep_engine_ticks_total", "Engine ticks completed").labels()
+        self._m_batches = obs_registry.counter(
+            "vep_engine_batches_total", "Device batches dispatched").labels()
+        self._m_frames = obs_registry.counter(
+            "vep_stream_frames_total", "Inference results per stream",
+            ("stream",))
+        self._m_latency = obs_registry.histogram(
+            "vep_stream_latency_ms",
+            "End-to-end frame latency, bus publish to result emit (ms)",
+            ("stream",))
+        self._m_device = obs_registry.histogram(
+            "vep_device_batch_ms",
+            "Batch submit to host fetch complete (ms)", ("model",))
+        self._m_occupancy = obs_registry.histogram(
+            "vep_batch_occupancy_pct",
+            "Real frames per padded batch slot (percent)").labels()
+        self._m_cache_miss = obs_registry.counter(
+            "vep_step_cache_misses_total",
+            "Serving-step cache misses (each triggers an XLA compile)"
+        ).labels()
+        self._m_cache_hit = obs_registry.counter(
+            "vep_step_cache_hits_total", "Serving-step cache hits").labels()
+        self._m_drain_depth = obs_registry.gauge(
+            "vep_drain_queue_depth",
+            "Dispatched batches waiting on the device drain thread").labels()
+        self._m_sub_drops = obs_registry.counter(
+            "vep_stream_subscriber_dropped_total",
+            "Results dropped on slow subscribers per stream", ("stream",))
+        self._m_late = obs_registry.counter(
+            "vep_frames_late_total",
+            "Results slower end-to-end than engine.obs_late_ms",
+            ("stream",))
+        # Recompile-storm detection state (tick loop only).
+        self._miss_seen = 0.0
+        self._miss_streak = 0
 
     # -- lifecycle --
 
@@ -669,8 +739,20 @@ class InferenceEngine:
                     (sq, si) for sq, si in self._subscribers if sq is not q
                 ]
 
-    def stats(self) -> Dict[str, StreamStats]:
-        return dict(self._stats)
+    def stats(self) -> Dict[str, StreamStatsView]:
+        # Snapshot copies, never the live objects: the drain thread keeps
+        # mutating StreamStats after this returns, and handing out live
+        # references let API callers observe mid-update state (or corrupt
+        # engine accounting by writing through them).
+        return {
+            device_id: StreamStatsView(
+                frames=st.frames,
+                last_latency_ms=st.last_latency_ms,
+                ema_latency_ms=st.ema_latency_ms,
+                last_batch=st.last_batch,
+            )
+            for device_id, st in list(self._stats.items())
+        }
 
     def _run_probe(self) -> None:
         """Device round-trip on a dedicated thread; writes the cache when
@@ -795,7 +877,10 @@ class InferenceEngine:
         model = model or self._spec.name
         key = (model, src_hw, bucket)
         fn = self._step_cache.get(key)
-        if fn is None:
+        if fn is not None:
+            self._m_cache_hit.inc()
+        else:
+            self._m_cache_miss.inc()
             import jax
 
             spec, mod, _ = self._ensure_model(model)
@@ -830,6 +915,7 @@ class InferenceEngine:
                 self._collector.keep_streams_hot(device_ids=inferred)
                 groups = self._collector.collect(device_ids=inferred)
                 t_collect = time.time() if self._cfg.stage_trace else 0.0
+                trace_on = tracer.enabled
                 for gi, group in enumerate(groups):
                     # A dispatch failure aborts the tick; every group not
                     # yet handed to the drain thread (this one AND the
@@ -849,8 +935,20 @@ class InferenceEngine:
                             self._collector.release(g)
                         raise
                     self.batches += 1
+                    self._m_batches.inc()
+                    self._m_occupancy.observe(
+                        100.0 * len(group.device_ids) / group.bucket
+                    )
+                    t_submit = time.time()
+                    if trace_on:
+                        for did, meta in zip(group.device_ids, group.metas):
+                            if tracer.sampled(meta.packet):
+                                tracer.record(
+                                    did, "submit", meta.packet,
+                                    ts=t_submit, bucket=group.bucket,
+                                )
                     self._enqueue_drain(
-                        _Inflight(group, outputs, time.time(), t_collect)
+                        _Inflight(group, outputs, t_submit, t_collect)
                     )
                 # Scope per-stream tracker state to streams that still
                 # exist: a long-lived engine with churning device_ids must
@@ -885,7 +983,9 @@ class InferenceEngine:
             except Exception:
                 log.exception("engine tick failed; continuing")
             self.ticks += 1
+            self._m_ticks.inc()
             self.last_tick_monotonic = time.monotonic()
+            self._watch_tick(tick_s)
             try:
                 # Tick remainder = incremental assembly: copy next tick's
                 # frames into their batch slots as they arrive (doorbell-
@@ -901,6 +1001,29 @@ class InferenceEngine:
                 elapsed = time.monotonic() - t0
                 if elapsed < tick_s:
                     self._stop.wait(tick_s - elapsed)
+
+    def _watch_tick(self, tick_s: float) -> None:
+        """Per-tick watermark checks (obs/watch.py): each warns once per
+        episode, so a stalled device or recompile storm surfaces as ONE
+        log line, not one per tick."""
+        depth = self._drain_q.qsize()
+        self._m_drain_depth.set(depth)
+        self.watchdog.check(
+            "drain_backpressure", depth, above=1,
+            detail="device slower than the tick loop (double buffer full)",
+        )
+        # Recompile storm: a step-cache miss on N consecutive ticks means
+        # shapes are churning faster than the cache warms (the exact
+        # pathology bucketed batching exists to prevent).
+        misses = self._m_cache_miss.value
+        self._miss_streak = (
+            self._miss_streak + 1 if misses > self._miss_seen else 0
+        )
+        self._miss_seen = misses
+        self.watchdog.check(
+            "recompile_storm", self._miss_streak, above=2,
+            detail="step-cache miss on 3+ consecutive ticks (shape churn)",
+        )
 
     def _enqueue_drain(self, inflight: _Inflight) -> None:
         """Hand a dispatched batch to the drain thread. Blocks (in short
@@ -936,10 +1059,14 @@ class InferenceEngine:
     def _emit(self, inflight: _Inflight) -> None:
         group = inflight.group
         spec = self._models[group.model or self._spec.name][0]
-        t_drain0 = time.time() if self._cfg.stage_trace else 0.0
+        t_drain0 = time.time()
         host = {k: np.asarray(v) for k, v in inflight.outputs.items()}  # D2H
-        t_drained = time.time() if self._cfg.stage_trace else 0.0
-        now_ms = int(time.time() * 1000)
+        t_drained = time.time()
+        device_ms = (t_drained - inflight.t_submit) * 1000.0
+        self._m_device.labels(group.model or self._spec.name).observe(
+            device_ms
+        )
+        now_ms = int(t_drained * 1000)
         for i, device_id in enumerate(group.device_ids):
             meta = group.metas[i]
             detections = self._to_detections(host, i, spec)
@@ -975,12 +1102,18 @@ class InferenceEngine:
             self._annotate(device_id, meta, detections, spec)
             st = self._stats.setdefault(device_id, StreamStats())
             st.frames += 1
-            st.last_latency_ms = latency
-            st.ema_latency_ms = (
-                latency if st.ema_latency_ms == 0.0
-                else 0.9 * st.ema_latency_ms + 0.1 * latency
-            )
+            st.note_latency(latency)
             st.last_batch = group.bucket
+            self._m_frames.labels(device_id).inc()
+            self._m_latency.labels(device_id).observe(latency)
+            if latency > self._cfg.obs_late_ms:
+                self._m_late.labels(device_id).inc()
+            if tracer.sampled(meta.packet):
+                tracer.record(
+                    device_id, "device", meta.packet, ts=t_drained,
+                    dur_ms=device_ms, bucket=group.bucket,
+                )
+                tracer.record(device_id, "emit", meta.packet)
 
     def _assign_tracks(self, device_id: str, model: str, detections) -> None:
         """Per-stream SORT-style association (engine/tracker.py): fills
@@ -1072,6 +1205,7 @@ class InferenceEngine:
                 self.subscriber_drops_by_stream[result.device_id] = (
                     self.subscriber_drops_by_stream.get(result.device_id, 0) + 1
                 )
+                self._m_sub_drops.labels(result.device_id).inc()
 
     def _annotate(
         self, device_id: str, meta: FrameMeta, detections: Sequence[pb.Detection],

@@ -351,8 +351,16 @@ def open_source(url: str, prefer: str = "") -> VideoSource:
     ``opencv`` / ``packet`` for A/B and fallback testing."""
     import os
 
+    from ..obs import registry as obs_registry
+
+    opens = obs_registry.counter(
+        "vep_source_opens_total", "Video sources opened, by backend kind",
+        ("kind",),
+    )
+
     scheme = urlparse(url).scheme
     if scheme == "test":
+        opens.labels("synthetic").inc()
         return SyntheticSource(url)
     if scheme == "replay":
         # Deterministic re-delivery of a recorded trace (replay/player.py):
@@ -360,14 +368,17 @@ def open_source(url: str, prefer: str = "") -> VideoSource:
         # replay plane must not load for live-camera workers.
         from ..replay.player import ReplaySource
 
+        opens.labels("replay").inc()
         return ReplaySource(url)
     prefer = prefer or os.environ.get("vep_source", "")
     if prefer == "opencv":
+        opens.labels("opencv").inc()
         return OpenCVSource(url)
     if prefer != "packet":
         from . import av
 
         if not av.available():
+            opens.labels("opencv").inc()
             return OpenCVSource(url)
     # env `vep_av_options`: extra "k=v:k=v" AVOptions for every packet
     # source a worker opens (inherited from the server env, same channel
@@ -376,4 +387,5 @@ def open_source(url: str, prefer: str = "") -> VideoSource:
     # whose decode exceeds one core (4K/high-fps); default stays 1
     # thread/worker (process-level parallelism, BASELINE.md capacity
     # table).
+    opens.labels("packet").inc()
     return PacketSource(url, av_options=os.environ.get("vep_av_options", ""))

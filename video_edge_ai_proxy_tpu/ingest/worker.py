@@ -40,6 +40,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..bus import FrameBus, FrameMeta, RingSlotTooSmall, open_bus
+from ..obs import registry as obs_registry, tracer
 from ..utils.logging import get_logger
 from .archive import GopSegment, PacketGopSegment, SegmentArchiver
 from .sources import VideoSource, open_source
@@ -158,6 +159,37 @@ class IngestWorker:
         self._gop_audio_info = None  # audio StreamInfo captured at GOP open
         self._audio_packets = 0
         self._recorder = None  # flight recorder (cfg.trace_dir), built in run()
+        # Unified metrics: per-process registry (subprocess workers report
+        # the same numbers through the status heartbeat; in-process workers
+        # — replay cameras, tests — land directly in the scraped registry).
+        dev = (cfg.device_id,)
+        self._m_packets = obs_registry.counter(
+            "vep_ingest_packets_total", "Video packets demuxed", ("stream",)
+        ).labels(*dev)
+        self._m_decoded = obs_registry.counter(
+            "vep_ingest_decoded_total", "Frames decoded", ("stream",)
+        ).labels(*dev)
+        self._m_published = obs_registry.counter(
+            "vep_ingest_published_total", "Frames published to the bus",
+            ("stream",),
+        ).labels(*dev)
+        self._m_corrupt = obs_registry.counter(
+            "vep_ingest_corrupt_total", "Corrupt packets flagged by demux",
+            ("stream",),
+        ).labels(*dev)
+        self._m_reconnects = obs_registry.counter(
+            "vep_ingest_reconnects_total", "Mid-stream EOF reconnect loops",
+            ("stream",),
+        ).labels(*dev)
+        # Subprocess workers inherit tracing intent via env (the parent's
+        # obs.tracer object does not cross the fork/exec boundary).
+        if os.environ.get("VEP_OBS_TRACE"):
+            tracer.configure(
+                enabled=True,
+                sample_every=int(
+                    os.environ.get("VEP_OBS_SAMPLE_EVERY") or 16
+                ),
+            )
 
     # -- control-plane reads (per packet; shm KV, nanosecond-cheap) --
 
@@ -359,6 +391,7 @@ class IngestWorker:
                         "stream %s EOF/gone; reconnecting in %.0fs",
                         cfg.device_id, RECONNECT_DELAY_S,
                     )
+                    self._m_reconnects.inc()
                     # The buffered GOP is a valid keyframe-headed prefix of
                     # the dying stream; archive it now — the re-opened
                     # demuxer has a fresh clock (and possibly fresh codec
@@ -405,6 +438,9 @@ class IngestWorker:
                     continue
 
                 self._packets += 1
+                self._m_packets.inc()
+                if pkt.is_corrupt:
+                    self._m_corrupt.inc()
                 if pkt.is_keyframe:
                     self._keyframes += 1
                 now_ms = pkt.timestamp_ms
@@ -425,6 +461,7 @@ class IngestWorker:
                     if frame is None:
                         continue
                     self._decoded += 1
+                    self._m_decoded.inc()
                     frame_type = (
                         getattr(self.source, "last_frame_type", "")
                         or ("I" if pkt.is_keyframe else "P")
@@ -471,6 +508,11 @@ class IngestWorker:
                         )
                         self.bus.publish(cfg.device_id, frame, meta)
                     self._published += 1
+                    self._m_published.inc()
+                    if tracer.sampled(meta.packet):
+                        # Lineage origin: frame id (the packet number) is
+                        # stamped here and flows unchanged to result emit.
+                        tracer.record(cfg.device_id, "publish", meta.packet)
                     if self._recorder is not None:
                         # Record what was published: synthetic frames are
                         # fully determined by (w, h, n), so the trace keeps

@@ -1,0 +1,28 @@
+"""Observability plane: unified metrics registry, frame-lineage tracing,
+stall/watermark detection (ISSUE r7 tentpole).
+
+Pure-Python, jax-free, importable from control-plane and worker code alike.
+Three modules:
+
+- :mod:`metrics` — process-wide counters/gauges/log2-histograms, rendered
+  once by ``/metrics`` (Prometheus 0.0.4) and ``/api/v1/stats`` (JSON).
+- :mod:`spans` — sampled per-frame lineage span events (ingest -> bus ->
+  batch -> device -> emit), per-stream ring buffers, Chrome trace-event
+  export (``tools/obs_export.py``) and ``/api/v1/trace``.
+- :mod:`watch` — threshold-crossing detection (drain backpressure, batch
+  occupancy, recompilation storms, frame drops) logged once per episode.
+"""
+
+from .metrics import Registry, registry
+from .spans import SpanRecorder, stage_breakdown, to_chrome_trace, tracer
+from .watch import Watchdog
+
+__all__ = [
+    "Registry",
+    "registry",
+    "SpanRecorder",
+    "stage_breakdown",
+    "to_chrome_trace",
+    "tracer",
+    "Watchdog",
+]

@@ -1,0 +1,114 @@
+"""Stall/watermark detection: threshold crossings logged once per episode.
+
+The reference proxy's failure modes are silent-by-default: a camera wedges
+and the bus just serves the last frame forever; the drain queue backs up
+and latency climbs with no log line; a shape churn recompiles every tick.
+The watchdog turns each into ONE warning when the threshold is crossed and
+ONE info when it recovers — hysteresis by episode, so a value oscillating
+around the threshold can't log-spam (the classic alert-flapping problem).
+
+Usage: call ``check`` from an existing periodic path (the engine tick) —
+the watchdog owns no thread. Each named condition is an episode state
+machine; ``snapshot()`` exports active episodes + totals for
+``/api/v1/stats`` and the soak artifact.
+
+Pure Python, jax-free.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger("vep.obs.watch")
+
+
+class Watchdog:
+    """Once-per-episode threshold monitor.
+
+    ``check(name, value, above=x)`` opens an episode (and logs WARNING)
+    the first time ``value > x``; subsequent breaching checks are silent;
+    the first non-breaching check closes the episode (and logs INFO with
+    the episode duration and peak). ``below=`` watches the other
+    direction (e.g. batch occupancy collapsing).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> {since, peak, threshold, direction, detail}
+        self._active: Dict[str, dict] = {}
+        self._episodes: Dict[str, int] = {}
+
+    def check(self, name: str, value: float, *,
+              above: Optional[float] = None,
+              below: Optional[float] = None,
+              detail: str = "") -> bool:
+        """Evaluate one condition; returns True while breaching."""
+        if (above is None) == (below is None):
+            raise ValueError("exactly one of above=/below= required")
+        breach = value > above if above is not None else value < below
+        threshold = above if above is not None else below
+        now = time.time()
+        with self._lock:
+            ep = self._active.get(name)
+            if breach:
+                if ep is None:
+                    self._active[name] = {
+                        "since": now,
+                        "peak": value,
+                        "threshold": threshold,
+                        "direction": "above" if above is not None
+                        else "below",
+                        "detail": detail,
+                    }
+                    self._episodes[name] = self._episodes.get(name, 0) + 1
+                    log.warning(
+                        "watch: %s %s threshold %g (value %g)%s",
+                        name,
+                        "above" if above is not None else "below",
+                        threshold, value,
+                        f" — {detail}" if detail else "",
+                    )
+                else:
+                    if above is not None:
+                        ep["peak"] = max(ep["peak"], value)
+                    else:
+                        ep["peak"] = min(ep["peak"], value)
+            elif ep is not None:
+                del self._active[name]
+                log.info(
+                    "watch: %s recovered after %.1fs (peak %g, "
+                    "threshold %g)",
+                    name, now - ep["since"], ep["peak"], ep["threshold"],
+                )
+        return breach
+
+    def active(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                k: {**v, "age_s": round(time.time() - v["since"], 1)}
+                for k, v in self._active.items()
+            }
+
+    def snapshot(self) -> dict:
+        """JSON-able state for ``/api/v1/stats`` and soak artifacts."""
+        with self._lock:
+            active = {
+                k: {
+                    "since": v["since"],
+                    "age_s": round(time.time() - v["since"], 1),
+                    "peak": v["peak"],
+                    "threshold": v["threshold"],
+                    "direction": v["direction"],
+                    "detail": v["detail"],
+                }
+                for k, v in self._active.items()
+            }
+            return {"active": active, "episodes": dict(self._episodes)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._episodes.clear()

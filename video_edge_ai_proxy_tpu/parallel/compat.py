@@ -1,0 +1,38 @@
+"""jax API compat for shard_map.
+
+``jax.shard_map`` (with its ``check_vma`` flag) became a public top-level
+API after the 0.4.x line; older runtimes ship the same transform as
+``jax.experimental.shard_map.shard_map`` with the equivalent flag named
+``check_rep``. Every in-repo user imports ``shard_map`` from here so one
+site owns the mapping and the package imports on both runtimes.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: public top-level API
+    from jax import shard_map as _impl
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # 0.4.x line
+    from jax.experimental.shard_map import shard_map as _impl
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` signature (keyword ``check_vma``), dispatched to
+    whichever implementation this runtime provides."""
+    return _impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )
+
+
+def axis_size(axis_name: str) -> int:
+    """``lax.axis_size`` appeared after the 0.4.x line; ``psum(1, axis)``
+    is the portable spelling (constant-folded, no collective issued)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

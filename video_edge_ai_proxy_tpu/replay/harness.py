@@ -224,7 +224,7 @@ def run_fleet_soak(
     src_hw: tuple = (96, 128), fps: float = 30.0, tick_ms: int = 10,
     trace_path: Optional[str] = None, fault_plan: Optional[FaultPlan] = None,
     warmup_timeout_s: float = 1800.0, sample_every_s: float = 2.0,
-    timeline_bin_s: float = 10.0,
+    timeline_bin_s: float = 10.0, trace_sample_every: int = 4,
 ) -> dict:
     """The >=120 s chaos soak. Returns the artifact's "soak" section."""
     import jax
@@ -232,6 +232,8 @@ def run_fleet_soak(
     from ..bus.memory_bus import MemoryFrameBus
     from ..engine import InferenceEngine
     from ..models import registry
+    from ..obs import registry as obs_registry, tracer
+    from ..obs.spans import stage_breakdown
     from ..utils.config import EngineConfig
 
     backend = jax.default_backend()
@@ -255,6 +257,13 @@ def run_fleet_soak(
             trace_path, sorted(assignment), width=w, height=h, fps=fps,
             gop=30, frames=max(60, int(min(duration_s, 30.0) * fps)))
     player = TracePlayer(trace_path)
+
+    # Frame lineage across the soak: cameras publish in-process, so the
+    # collect span's pub_ms carries the ingest leg; engine spans complete
+    # the chain. Restore the prior tracer config on exit — the soak runs
+    # inside the test/tool process alongside other obs users.
+    prev_trace = (tracer.enabled, tracer.sample_every)
+    tracer.configure(enabled=True, sample_every=max(1, trace_sample_every))
 
     inner_bus = MemoryFrameBus()
     bus = StallBus(inner_bus)
@@ -320,6 +329,10 @@ def run_fleet_soak(
         time.sleep(1.0)
     warmup_s = warmup_timeout_s - (warm_deadline - time.monotonic())
     eng.stage_records.clear()
+    # The measured window starts clean: warmup compiles would otherwise
+    # register as recompile-storm episodes and skew the span breakdown.
+    tracer.clear()
+    eng.watchdog.reset()
 
     plan = fault_plan if fault_plan is not None else \
         FaultPlan.default_churn(sorted(assignment), duration_s)
@@ -389,6 +402,18 @@ def run_fleet_soak(
     subscriber_drops = eng.subscriber_drops
     programs_final = len(eng._step_cache)
     ticks = eng.ticks
+    span_events = tracer.events()
+    obs_section = {
+        "metrics": obs_registry.snapshot(),
+        "watch": eng.watchdog.snapshot(),
+        "stage_breakdown": stage_breakdown(span_events),
+        "trace": {
+            "sample_every": tracer.sample_every,
+            "events": len(span_events),
+            "streams": len(tracer.streams()),
+        },
+    }
+    tracer.configure(enabled=prev_trace[0], sample_every=prev_trace[1])
     eng.stop()
     sink_thread.join(timeout=5)
     inner_bus.close()
@@ -437,6 +462,7 @@ def run_fleet_soak(
         "suppressed": {d: c.suppressed for d, c in cams.items()},
         "streams_with_results": len(stats),
         "faults_applied": faults_applied,
+        "obs": obs_section,
     }
 
 
@@ -460,6 +486,8 @@ def run_e2e(
 
     import grpc
 
+    from ..obs import registry as obs_registry, tracer
+    from ..obs.spans import stage_breakdown
     from ..proto import pb, pb_grpc
     from ..serve.models import StreamProcess
     from ..serve.server import Server
@@ -479,6 +507,12 @@ def run_e2e(
     cfg.annotation.endpoint = "http://127.0.0.1:1/annotate"   # no egress
     cfg.engine.model = model
     cfg.engine.track = False
+    # Server.__init__ reconfigures the global tracer from cfg.obs — the
+    # e2e artifact carries the stage-segmented breakdown (ingest leg via
+    # pub_ms on collect spans; the publish span lives in the subprocess
+    # worker's rings, not ours).
+    cfg.obs.trace = True
+    cfg.obs.sample_every = 4
     srv = Server(cfg, data_dir=tmp, grpc_port=0, rest_port=0,
                  enable_engine=True)
     srv.start()
@@ -517,11 +551,24 @@ def run_e2e(
         ))
         # Warmup covers worker boot + first-geometry compile; then measure.
         time.sleep(warmup_s)
+        tracer.clear()   # measured-window spans only
         measure_after[0] = time.monotonic()
         time.sleep(duration_s)
     finally:
         stop.set()
         t.join(timeout=10)
+        span_events = tracer.events()
+        obs_section = {
+            "metrics": obs_registry.snapshot(),
+            "watch": srv.engine.watchdog.snapshot()
+            if srv.engine is not None else None,
+            "stage_breakdown": stage_breakdown(span_events),
+            "trace": {
+                "sample_every": tracer.sample_every,
+                "events": len(span_events),
+            },
+        }
+        tracer.configure(enabled=False)
         srv.stop()
         shutil.rmtree(cfg.bus.shm_dir, ignore_errors=True)
         if workdir is None:
@@ -543,4 +590,5 @@ def run_e2e(
         "results_measured": len(measured),
         "latency_ms": _pct(measured),
         "unit": "ms publish->client-receive",
+        "obs": obs_section,
     }

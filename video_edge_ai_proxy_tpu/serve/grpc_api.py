@@ -29,6 +29,7 @@ from typing import Iterator, Optional
 import grpc
 
 from ..bus import FrameBus
+from ..obs import registry as obs_registry
 from ..proto import pb
 from ..uplink.queue import AnnotationQueue
 from ..utils.logging import get_logger
@@ -62,6 +63,12 @@ class ImageServicer:
         self._engine = engine
         self._deadline = stream_deadline_s
         self._api_endpoint = api_endpoint
+        self._m_frames_served = obs_registry.counter(
+            "vep_grpc_frames_served_total",
+            "VideoLatestImage frames streamed to clients", ("stream",))
+        self._m_results_streamed = obs_registry.counter(
+            "vep_grpc_results_streamed_total",
+            "Inference results streamed to clients", ("stream",))
 
     # -- VideoLatestImage: the hot path --
 
@@ -88,6 +95,7 @@ class ImageServicer:
                 continue  # reference sends nothing on a miss and serves the
                 # next request (grpc_api.go:223-229)
             cursors[device_id] = frame.seq
+            self._m_frames_served.labels(device_id).inc()
             yield _frame_to_proto(device_id, frame)
 
     def _wait_latest(self, device_id: str, cursor: int):
@@ -231,6 +239,7 @@ class ImageServicer:
             # non-empty filter narrows to one of them (empty = no filter).
             if request.model and result.model != request.model:
                 continue
+            self._m_results_streamed.labels(result.device_id).inc()
             yield result
 
 

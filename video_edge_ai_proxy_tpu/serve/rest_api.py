@@ -26,6 +26,8 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..obs import registry as obs_registry, tracer
+from ..obs.spans import stage_breakdown, to_chrome_trace
 from ..utils.logging import get_logger
 from .models import RTMPStreamStatus, StreamProcess
 from .process_manager import ProcessError, ProcessManager
@@ -178,91 +180,111 @@ def build_app(
                 "dropped": annotations.dropped,
                 "rejected_batches": annotations.rejected_batches,
             }
+        # Unified registry view (same families /metrics renders as
+        # Prometheus text) + watchdog episodes + tracer state.
+        out["obs"] = {
+            "metrics": obs_registry.snapshot(),
+            "watch": engine.watchdog.snapshot() if engine is not None
+            else None,
+            "trace": {
+                "enabled": tracer.enabled,
+                "sample_every": tracer.sample_every,
+                "streams": tracer.streams(),
+            },
+        }
         return web.json_response(out)
 
-    async def metrics(_request: web.Request) -> web.Response:
-        """Prometheus exposition of the same counters /api/v1/stats serves
-        as JSON (SURVEY.md §5.5: the reference has no metrics endpoint at
-        all; a fleet scrapes this one). Text format 0.0.4 — no client
-        library needed for gauges/counters."""
-        # Families buffered so each metric's samples render contiguously
-        # (text-format 0.0.4 requires one block per family), with label
-        # values escaped — a camera named 'cam"1' must corrupt nothing.
-        families: dict[str, tuple[str, str, list[str]]] = {}
+    async def trace(request: web.Request) -> web.Response:
+        """Live frame-lineage query (obs/spans.py): buffered span events,
+        their stage-segmented latency breakdown, or (``?format=chrome``)
+        ready-to-load Chrome trace-event JSON."""
+        stream = request.query.get("stream")
+        try:
+            limit = int(request.query.get("limit", "0")) or None
+        except ValueError:
+            return _error(400, "limit must be an integer")
+        events = tracer.events(stream=stream, limit=limit)
+        if request.query.get("format") == "chrome":
+            return web.json_response(to_chrome_trace(events))
+        return web.json_response({
+            "enabled": tracer.enabled,
+            "sample_every": tracer.sample_every,
+            "events": events,
+            "breakdown": stage_breakdown(events),
+        })
 
-        def esc(v: str) -> str:
-            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
-                    .replace("\n", "\\n"))
-
-        def emit(name, value, help_text, kind="gauge", device_id=None,
-                 model=None):
-            fam = families.setdefault(name, (help_text, kind, []))
-            pairs = []
-            if device_id:
-                pairs.append(f'device_id="{esc(device_id)}"')
-            if model:
-                pairs.append(f'model="{esc(model)}"')
-            labels = "{" + ",".join(pairs) + "}" if pairs else ""
-            fam[2].append(f"{name}{labels} {value}")
-
-        procs = await asyncio.to_thread(pm.list)
-        emit("vep_workers_total", len(procs), "Registered camera workers")
-        emit("vep_workers_running",
-             sum(1 for p in procs if p.state and p.state.running),
-             "Camera workers currently running")
+    def _sync_scrape_families() -> str:
+        """Mirror control-plane state the registry cannot observe live
+        (worker fleet, annotation queue, breaker-tripped models) into
+        registry families, then render EVERYTHING — engine counters,
+        latency histograms, ingest/bus counters — from the one registry.
+        Per-entity families are cleared first so a removed camera or a
+        recovered model stops exporting instead of freezing at its last
+        value."""
+        procs = pm.list()
+        obs_registry.gauge(
+            "vep_workers_total", "Registered camera workers"
+        ).set(len(procs))
+        obs_registry.gauge(
+            "vep_workers_running", "Camera workers currently running"
+        ).set(sum(1 for p in procs if p.state and p.state.running))
+        streaks = obs_registry.gauge(
+            "vep_worker_failing_streak", "Consecutive failures per worker",
+            ("stream",))
+        streaks.clear()
         for p in procs:
             if p.state:
-                emit("vep_worker_failing_streak", p.state.failing_streak,
-                     "Consecutive failures per worker", device_id=p.name)
+                streaks.labels(p.name).set(p.state.failing_streak)
         if engine is not None:
-            emit("vep_engine_ticks_total", engine.ticks,
-                 "Engine ticks completed", kind="counter")
-            emit("vep_engine_batches_total", engine.batches,
-                 "Device batches dispatched", kind="counter")
-            for did, st in engine.stats().items():
-                emit("vep_stream_frames_total", st.frames,
-                     "Inference results per stream", kind="counter",
-                     device_id=did)
-                emit("vep_stream_latency_ms", round(st.ema_latency_ms, 3),
-                     "EMA end-to-end latency per stream (ms)", device_id=did)
-            emit("vep_subscriber_dropped_total", engine.subscriber_drops,
-                 "Inference results dropped on slow subscribers",
-                 kind="counter")
-            for did, n in dict(engine.subscriber_drops_by_stream).items():
-                emit("vep_stream_subscriber_dropped_total", n,
-                     "Results dropped on slow subscribers per stream",
-                     kind="counter", device_id=did)
+            obs_registry.counter(
+                "vep_subscriber_dropped_total",
+                "Inference results dropped on slow subscribers",
+            ).labels().set(engine.subscriber_drops)
+            disabled = obs_registry.gauge(
+                "vep_model_disabled",
+                "Per-stream models tripped by the failure breaker "
+                "(value 1 while disabled)", ("model",))
+            disabled.clear()
             for name in list(engine._bad_models):
-                emit("vep_model_disabled", 1,
-                     "Per-stream models tripped by the failure breaker "
-                     "(value 1 while disabled)", model=name)
+                disabled.labels(name).set(1)
         if annotations is not None:
-            emit("vep_annotation_queue_depth", annotations.depth(),
-                 "Annotation uplink queue depth")
-            emit("vep_annotations_published_total", annotations.published,
-                 "Annotations enqueued", kind="counter")
-            emit("vep_annotations_acked_total", annotations.acked,
-                 "Annotation batches acked by the cloud", kind="counter")
-            emit("vep_annotations_dropped_total", annotations.dropped,
-                 "Annotations dropped at the unacked limit", kind="counter")
-            emit("vep_annotation_rejected_batches_total",
-                 annotations.rejected_batches,
-                 "Annotation batches rejected by the cloud (re-queued)",
-                 kind="counter")
+            obs_registry.gauge(
+                "vep_annotation_queue_depth", "Annotation uplink queue depth"
+            ).set(annotations.depth())
+            obs_registry.counter(
+                "vep_annotations_published_total", "Annotations enqueued"
+            ).labels().set(annotations.published)
+            obs_registry.counter(
+                "vep_annotations_acked_total",
+                "Annotation batches acked by the cloud",
+            ).labels().set(annotations.acked)
+            obs_registry.counter(
+                "vep_annotations_dropped_total",
+                "Annotations dropped at the unacked limit",
+            ).labels().set(annotations.dropped)
+            obs_registry.counter(
+                "vep_annotation_rejected_batches_total",
+                "Annotation batches rejected by the cloud (re-queued)",
+            ).labels().set(annotations.rejected_batches)
             if engine is not None:
-                emit("vep_annotations_suppressed_total",
-                     engine.annotations_suppressed,
-                     "Annotations withheld by the emit policy "
-                     "(engine.annotation_emit) before reaching the queue",
-                     kind="counter")
-        lines: list[str] = []
-        for name, (help_text, kind, samples) in families.items():
-            lines.append(f"# HELP {name} {help_text}")
-            lines.append(f"# TYPE {name} {kind}")
-            lines.extend(samples)
+                obs_registry.counter(
+                    "vep_annotations_suppressed_total",
+                    "Annotations withheld by the emit policy "
+                    "(engine.annotation_emit) before reaching the queue",
+                ).labels().set(engine.annotations_suppressed)
+        return obs_registry.render()
+
+    async def metrics(_request: web.Request) -> web.Response:
+        """Prometheus exposition (text format 0.0.4) rendered straight
+        from the unified obs registry (SURVEY.md §5.5: the reference has
+        no metrics endpoint at all; a fleet scrapes this one). Hot-path
+        subsystems (engine, collector, buses, ingest) observe into the
+        registry live; control-plane snapshots are mirrored in at scrape
+        time. Histogram families carry log2 buckets, so latency
+        percentiles come from PromQL's histogram_quantile, not EMA."""
+        text = await asyncio.to_thread(_sync_scrape_families)
         return web.Response(
-            text="\n".join(lines) + "\n",
-            content_type="text/plain", charset="utf-8",
+            text=text, content_type="text/plain", charset="utf-8",
         )
 
     async def profile_start(request: web.Request) -> web.Response:
@@ -350,6 +372,7 @@ def build_app(
     app.router.add_get("/api/v1/settings", settings_get)
     app.router.add_post("/api/v1/settings", settings_overwrite)
     app.router.add_get("/api/v1/stats", stats)
+    app.router.add_get("/api/v1/trace", trace)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/v1/rtspscan", rtspscan)

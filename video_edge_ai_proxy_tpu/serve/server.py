@@ -48,6 +48,15 @@ class Server:
     ):
         self.cfg = cfg or load_config()
         self.data_dir = data_dir
+        # Lineage tracing is process-global (obs.tracer): the engine,
+        # collector and in-process workers all stamp into the same rings.
+        from ..obs import tracer
+
+        tracer.configure(
+            enabled=self.cfg.obs.trace,
+            sample_every=self.cfg.obs.sample_every,
+            ring=self.cfg.obs.trace_ring,
+        )
         self.storage = Storage(os.path.join(data_dir, "registry.db"))
         self.bus = open_bus(
             bus_backend or self.cfg.bus.backend, self.cfg.bus.shm_dir,

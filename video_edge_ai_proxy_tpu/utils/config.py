@@ -139,6 +139,22 @@ class EngineConfig:
     # tools/bench_latency.py turns it on to measure the serving latency
     # budget stage by stage (VERDICT r3 weak #1).
     stage_trace: bool = False
+    # End-to-end latency (bus publish -> result emit) above this increments
+    # vep_frames_late_total for the stream (obs/watch.py episode checks key
+    # off the same number).
+    obs_late_ms: float = 1000.0
+
+
+@dataclass
+class ObsConfig:
+    """Observability plane (obs/): frame-lineage tracing knobs. Metrics
+    (obs/metrics.py) are always on — one counter add per event; tracing is
+    opt-in because span dicts allocate."""
+
+    trace: bool = False       # record sampled per-frame lineage spans
+    sample_every: int = 16    # trace 1-in-N frames (deterministic, by
+                              # packet id, so spans join into lineages)
+    trace_ring: int = 1024    # span events buffered per stream
 
 
 @dataclass
@@ -179,6 +195,7 @@ class Config:
     api: ApiConfig = field(default_factory=ApiConfig)
     buffer: BufferConfig = field(default_factory=BufferConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
 
 def _merge(dc: Any, data: dict[str, Any]) -> Any:
