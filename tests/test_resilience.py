@@ -1,0 +1,575 @@
+"""Resilience layer tests: retry policy, deadlines, circuit breaker,
+dead-letter spool, degradation ladder rungs, and the RESP client's
+idempotency-aware resync. Everything runs on fake clocks and recorded
+sleeps — no wall-clock waits in tier 1.
+"""
+
+import random
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+from video_edge_ai_proxy_tpu.bus.resp import NON_IDEMPOTENT, RespClient
+from video_edge_ai_proxy_tpu.engine.collector import BatchGroup, Collector
+from video_edge_ai_proxy_tpu.engine.runner import admitted_streams, shed_stale
+from video_edge_ai_proxy_tpu.obs.watch import Watchdog
+from video_edge_ai_proxy_tpu.resilience import (
+    RUNGS,
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    DeadLetterSpool,
+    DegradationLadder,
+    RetryPolicy,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class TestRetryPolicy:
+    def test_decorrelated_jitter_bounds_and_determinism(self):
+        p1 = RetryPolicy(base_s=0.1, cap_s=5.0, rng=random.Random(42))
+        p2 = RetryPolicy(base_s=0.1, cap_s=5.0, rng=random.Random(42))
+        prev = None
+        for _ in range(50):
+            d1 = p1.next_delay(prev)
+            d2 = p2.next_delay(prev)
+            assert d1 == d2  # same seed, same schedule
+            assert 0.1 <= d1 <= 5.0
+            prev = d1
+
+    def test_run_retries_then_succeeds(self):
+        sleeps = []
+        clk = FakeClock()
+        p = RetryPolicy(max_attempts=4, base_s=0.1, cap_s=1.0,
+                        rng=random.Random(7), clock=clk, sleep=sleeps.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert p.run(flaky) == "ok"
+        assert calls["n"] == 3
+        assert len(sleeps) == 2 and all(0.1 <= s <= 1.0 for s in sleeps)
+
+    def test_run_exhaustion_reraises_last(self):
+        p = RetryPolicy(max_attempts=3, rng=random.Random(0),
+                        sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise OSError(f"attempt {calls['n']}")
+
+        with pytest.raises(OSError, match="attempt 3"):
+            p.run(always)
+        assert calls["n"] == 3
+
+    def test_terminal_exceptions_do_not_retry(self):
+        p = RetryPolicy(max_attempts=5, sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def forbidden():
+            calls["n"] += 1
+            raise PermissionError("403")
+
+        with pytest.raises(PermissionError):
+            p.run(forbidden, should_retry=lambda e: not isinstance(
+                e, PermissionError))
+        assert calls["n"] == 1
+
+    def test_deadline_stops_retry_loop(self):
+        # The next backoff would overrun the budget: re-raise instead of
+        # sleeping past the deadline.
+        clk = FakeClock()
+        sleeps = []
+
+        def sleep(s):
+            sleeps.append(s)
+            clk.advance(s)
+
+        p = RetryPolicy(max_attempts=10, base_s=1.0, cap_s=1.0,
+                        rng=random.Random(1), clock=clk, sleep=sleep)
+        dl = Deadline.after(2.5, clock=clk)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            p.run(always, deadline=dl)
+        # 2.5 s budget, 1 s per backoff: two sleeps fit, the third would
+        # overrun -> 3 attempts, not 10.
+        assert calls["n"] == 3
+        assert len(sleeps) == 2
+
+    def test_on_retry_callback_sees_attempt_exc_delay(self):
+        seen = []
+        p = RetryPolicy(max_attempts=3, rng=random.Random(3),
+                        sleep=lambda s: None)
+        with pytest.raises(ValueError):
+            p.run(lambda: (_ for _ in ()).throw(ValueError("x")),
+                  on_retry=lambda a, e, d: seen.append((a, type(e), d)))
+        assert [s[0] for s in seen] == [1, 2]
+        assert all(s[1] is ValueError for s in seen)
+
+
+class TestDeadline:
+    def test_remaining_clamp_expired(self):
+        clk = FakeClock()
+        dl = Deadline.after(10.0, clock=clk)
+        assert dl.remaining() == pytest.approx(10.0)
+        assert dl.clamp(30.0) == pytest.approx(10.0)
+        assert dl.clamp(2.0) == pytest.approx(2.0)
+        clk.advance(10.0)
+        assert dl.expired and dl.remaining() == 0.0
+        with pytest.raises(DeadlineExceeded):
+            dl.check("post")
+
+    def test_sub_budget_never_outlives_parent(self):
+        clk = FakeClock()
+        parent = Deadline.after(5.0, clock=clk)
+        child = parent.sub(30.0)
+        assert child.remaining() == pytest.approx(5.0)
+        short = parent.sub(1.0)
+        assert short.remaining() == pytest.approx(1.0)
+
+
+class TestCircuitBreaker:
+    def make(self, clk, **kw):
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("recovery_timeout_s", 10.0)
+        return CircuitBreaker("testdep", clock=clk, **kw)
+
+    def test_opens_after_threshold_and_fails_fast(self):
+        clk = FakeClock()
+        b = self.make(clk)
+        for _ in range(3):
+            assert b.allow()
+            b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()
+        with pytest.raises(BreakerOpen) as ei:
+            b.call(lambda: "never")
+        assert ei.value.retry_in_s <= 10.0
+        assert b.snapshot()["transitions"] == {"open": 1}
+
+    def test_half_open_probe_then_close(self):
+        clk = FakeClock()
+        b = self.make(clk)
+        for _ in range(3):
+            b.record_failure()
+        clk.advance(10.0)
+        assert b.allow()            # the probe
+        assert not b.allow()        # only ONE probe in flight
+        b.record_success()
+        assert b.state == "closed"
+        assert b.allow() and b.allow()  # back to full admission
+        t = b.snapshot()["transitions"]
+        assert t == {"open": 1, "half_open": 1, "closed": 1}
+
+    def test_half_open_probe_failure_reopens(self):
+        clk = FakeClock()
+        b = self.make(clk)
+        for _ in range(3):
+            b.record_failure()
+        clk.advance(10.0)
+        assert b.allow()
+        b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()
+        assert b.time_in_open_s() == 0.0 or b.time_in_open_s() >= 0.0
+
+    def test_dead_probe_owner_readmits_after_window(self):
+        # A probe admitted but never resolved (owner crashed) must not
+        # wedge the breaker half-open forever.
+        clk = FakeClock()
+        b = self.make(clk)
+        for _ in range(3):
+            b.record_failure()
+        clk.advance(10.0)
+        assert b.allow()
+        assert not b.allow()
+        clk.advance(10.0)
+        assert b.allow()  # re-admitted
+
+    def test_excluded_exception_counts_as_answer(self):
+        # A 403 means the dependency ANSWERED: success for breaker
+        # purposes, and the exception still reaches the caller.
+        clk = FakeClock()
+        b = self.make(clk)
+        b.record_failure()
+        b.record_failure()
+
+        def forbidden():
+            raise PermissionError("403")
+
+        with pytest.raises(PermissionError):
+            b.call(forbidden, excluded=(PermissionError,))
+        assert b.state == "closed"
+        assert b.snapshot()["failures"] == 0
+
+    def test_call_success_resets_failure_streak(self):
+        clk = FakeClock()
+        b = self.make(clk)
+        b.record_failure()
+        b.record_failure()
+        assert b.call(lambda: 42) == 42
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"  # streak restarted after the success
+
+    def test_watchdog_flags_stuck_open_once_per_episode(self):
+        clk = FakeClock()
+        wd = Watchdog()
+        b = self.make(clk, max_open_s=60.0, watchdog=wd)
+        for _ in range(3):
+            b.record_failure()
+        clk.advance(5.0)
+        b.allow()
+        assert "breaker_testdep_open" not in wd.snapshot()["active"]
+        clk.advance(61.0)   # now past max_open_s (and past recovery: the
+        b.allow()           # watchdog check happens before the probe gate)
+        assert "breaker_testdep_open" in wd.snapshot()["active"]
+        b.record_success()
+        b.allow()           # closed-path check(0.0) ends the episode
+        assert "breaker_testdep_open" not in wd.snapshot()["active"]
+        assert wd.snapshot()["episodes"]["breaker_testdep_open"] == 1
+
+
+class TestDeadLetterSpool:
+    def test_put_drain_roundtrip_fifo(self, tmp_path):
+        sp = DeadLetterSpool(str(tmp_path))
+        sp.put([b"a1", b"a2"])
+        sp.put([b"b1"])
+        assert sp.pending() == 2 and sp.pending_events() == 3
+        seen = []
+        assert sp.drain(lambda items: seen.append(items) or True) == 2
+        assert seen == [[b"a1", b"a2"], [b"b1"]]  # oldest first
+        assert sp.pending() == 0
+        snap = sp.snapshot()
+        assert snap["spooled_events"] == 3 and snap["drained_events"] == 3
+
+    def test_survives_process_restart(self, tmp_path):
+        DeadLetterSpool(str(tmp_path)).put([b"x", b"y"])
+        sp2 = DeadLetterSpool(str(tmp_path))
+        assert sp2.pending() == 1 and sp2.pending_events() == 2
+        out = []
+        sp2.drain(lambda items: out.extend(items) or True)
+        assert out == [b"x", b"y"]
+
+    def test_handler_false_stops_and_preserves(self, tmp_path):
+        sp = DeadLetterSpool(str(tmp_path))
+        sp.put([b"a"])
+        sp.put([b"b"])
+        assert sp.drain(lambda items: False) == 0
+        assert sp.pending() == 2  # nothing lost, retried later
+
+    def test_handler_exception_propagates_and_preserves(self, tmp_path):
+        sp = DeadLetterSpool(str(tmp_path))
+        sp.put([b"a"])
+
+        def boom(items):
+            raise PermissionError("403")
+
+        with pytest.raises(PermissionError):
+            sp.drain(boom)
+        assert sp.pending() == 1
+
+    def test_bounded_evicts_oldest_and_counts(self, tmp_path):
+        sp = DeadLetterSpool(str(tmp_path), max_batches=2)
+        sp.put([b"old1", b"old2"])
+        sp.put([b"mid"])
+        sp.put([b"new"])
+        assert sp.pending() == 2
+        snap = sp.snapshot()
+        assert snap["dropped_batches"] == 1 and snap["dropped_events"] == 2
+        out = []
+        sp.drain(lambda items: out.extend(items) or True)
+        assert out == [b"mid", b"new"]  # the oldest batch was the victim
+
+    def test_corrupt_file_dropped_not_fatal(self, tmp_path):
+        sp = DeadLetterSpool(str(tmp_path))
+        sp.put([b"good"])
+        (tmp_path / "9999999.batch").write_bytes(b"garbage")
+        out = []
+        assert sp.drain(lambda items: out.extend(items) or True) == 1
+        assert out == [b"good"]
+        assert sp.snapshot()["dropped_batches"] == 1
+        assert sp.pending() == 0
+
+
+class TestDegradationLadder:
+    def make(self, clk, wd=None):
+        return DegradationLadder(
+            escalate_after_s=0.5, recover_after_s=2.0, depth_threshold=2,
+            lag_factor=3.0, clock=clk, watchdog=wd)
+
+    def obs(self, lad, *, depth=0, lag=0.0):
+        return lad.observe(queue_depth=depth, tick_lag_s=lag,
+                           tick_budget_s=0.01)
+
+    def test_no_pressure_stays_normal(self):
+        clk = FakeClock()
+        lad = self.make(clk)
+        for _ in range(100):
+            assert self.obs(lad) == "normal"
+            clk.advance(0.1)
+        assert lad.snapshot()["transitions"] == {}
+
+    def test_escalates_one_rung_per_window_through_all(self):
+        clk = FakeClock()
+        lad = self.make(clk)
+        walked = []
+        for _ in range(40):
+            walked.append(self.obs(lad, depth=5))
+            clk.advance(0.1)
+        # 0.5 s per rung: normal until 0.5, then one rung per window,
+        # saturating at the top rung.
+        assert walked[0] == "normal"
+        assert "shed" in walked and "bucket_downshift" in walked
+        assert walked[-1] == "admission_pause"
+        t = lad.snapshot()["transitions"]
+        assert t["shed"] == 1 and t["admission_pause"] == 1
+
+    def test_pressure_blip_shorter_than_window_ignored(self):
+        clk = FakeClock()
+        lad = self.make(clk)
+        for _ in range(20):
+            assert self.obs(lad, depth=5) == "normal"
+            clk.advance(0.2)
+            assert self.obs(lad, depth=0) == "normal"  # timer resets
+            clk.advance(0.2)
+
+    def test_tick_lag_is_a_pressure_signal_too(self):
+        clk = FakeClock()
+        lad = self.make(clk)
+        self.obs(lad, lag=0.05)       # 5x budget > 3x factor
+        clk.advance(0.6)
+        assert self.obs(lad, lag=0.05) == "shed"
+
+    def test_recovers_one_rung_per_calm_window(self):
+        clk = FakeClock()
+        wd = Watchdog()
+        lad = self.make(clk, wd)
+        for _ in range(40):           # drive to the top
+            self.obs(lad, depth=5)
+            clk.advance(0.1)
+        assert lad.rung == "admission_pause"
+        assert "engine_degraded" in wd.snapshot()["active"]
+        seen = []
+        for _ in range(140):          # calm: walk back down
+            seen.append(self.obs(lad, depth=0))
+            clk.advance(0.1)
+        assert seen[-1] == "normal"
+        order = [seen[0]] + [r for a, r in zip(seen, seen[1:]) if r != a]
+        assert order == ["admission_pause", "bucket_downshift", "shed",
+                         "normal"]
+        # One degraded episode across the whole excursion, closed now.
+        assert "engine_degraded" not in wd.snapshot()["active"]
+        assert wd.snapshot()["episodes"]["engine_degraded"] == 1
+
+
+class TestRungMechanics:
+    """The engine-side primitives each rung applies."""
+
+    def test_admitted_streams_deterministic_half(self):
+        assert admitted_streams([]) == []
+        assert admitted_streams(["solo"]) == ["solo"]  # never pause all
+        assert admitted_streams(["c", "a", "b"]) == ["a", "b"]
+        ids = [f"s{i}" for i in range(10)]
+        first = admitted_streams(list(reversed(ids)))
+        assert first == ids[:5]
+        assert admitted_streams(ids) == first  # stable across ticks
+
+    def _group(self, stamps, now_ms):
+        n = len(stamps)
+        frames = np.zeros((n, 4, 4, 3), np.uint8)
+        for i in range(n):
+            frames[i] = i + 1          # row-identifying fill
+        return BatchGroup(
+            src_hw=(4, 4),
+            device_ids=[f"cam{i}" for i in range(n)],
+            frames=frames,
+            metas=[FrameMeta(width=4, height=4, timestamp_ms=s)
+                   for s in stamps],
+            bucket=n,
+        )
+
+    def test_shed_stale_compacts_and_rebuckets(self):
+        now = 10_000.0
+        g = self._group([9_900, 9_000, 9_950, 8_000], now)  # 2 stale
+        kept, shed = shed_stale(g, now, 500.0, (1, 2, 4, 8))
+        assert shed == 2
+        assert kept.device_ids == ["cam0", "cam2"]
+        assert kept.bucket == 2 and kept.frames.shape[0] == 2
+        # Fresh rows compacted in place, in order.
+        assert int(kept.frames[0, 0, 0, 0]) == 1
+        assert int(kept.frames[1, 0, 0, 0]) == 3
+
+    def test_shed_stale_pads_zero_when_bucket_exceeds_n(self):
+        now = 10_000.0
+        g = self._group([9_990, 9_980, 9_970, 8_000], now)   # 1 stale
+        kept, shed = shed_stale(g, now, 500.0, (1, 2, 4, 8))
+        assert shed == 1 and kept.bucket == 4
+        assert not kept.frames[3].any()  # pad row zeroed (was cam3's data)
+
+    def test_shed_stale_all_stale_returns_none(self):
+        now = 10_000.0
+        g = self._group([1_000, 2_000], now)
+        kept, shed = shed_stale(g, now, 500.0, (1, 2, 4))
+        assert kept is None and shed == 2
+
+    def test_shed_stale_unstamped_frames_are_fresh(self):
+        now = 10_000.0
+        g = self._group([0, 0], now)    # no publish timestamp
+        kept, shed = shed_stale(g, now, 500.0, (1, 2, 4))
+        assert shed == 0 and kept is g
+
+    def test_collector_bucket_cap(self):
+        from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+
+        col = Collector(MemoryFrameBus(), buckets=(1, 2, 4, 8, 16))
+        assert col._effective_buckets() == (1, 2, 4, 8, 16)
+        col.set_bucket_cap(8)
+        assert col._effective_buckets() == (1, 2, 4, 8)
+        col.set_bucket_cap(0)           # below smallest: keep the floor
+        assert col._effective_buckets() == (1,)
+        col.set_bucket_cap(None)
+        assert col._effective_buckets() == (1, 2, 4, 8, 16)
+
+
+# ---------------------------------------------------------------------------
+# RESP resync idempotency regression: connection dies mid-command
+# ---------------------------------------------------------------------------
+
+
+class _DropOnceServer:
+    """Minimal RESP server whose next command can be scripted to be
+    RECEIVED IN FULL and then have the connection die before any reply —
+    exactly the 'server may have executed it' window the client's
+    idempotency gate exists for."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.received: list[bytes] = []   # verbs, arrival order
+        self.drop_next = False
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._client, args=(conn,), daemon=True).start()
+
+    def _client(self, conn):
+        f = conn.makefile("rb")
+        try:
+            while True:
+                head = f.readline()
+                if not head or not head.startswith(b"*"):
+                    return
+                parts = []
+                for _ in range(int(head[1:])):
+                    size = int(f.readline()[1:])
+                    parts.append(f.read(size))
+                    f.read(2)
+                self.received.append(parts[0].upper())
+                if self.drop_next:
+                    self.drop_next = False
+                    conn.shutdown(socket.SHUT_RDWR)
+                    return
+                conn.sendall(b"+OK\r\n")
+        except (OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture()
+def drop_server():
+    srv = _DropOnceServer()
+    yield srv
+    srv.close()
+
+
+class TestRespIdempotencyResync:
+    def test_idempotent_command_retried_transparently(self, drop_server):
+        cli = RespClient("127.0.0.1", drop_server.port, timeout_s=5.0)
+        try:
+            assert cli.command("SET", "k", "v") == "OK"
+            drop_server.drop_next = True
+            assert cli.command("GET", "k") == "OK"  # resync + auto-retry
+            assert drop_server.received.count(b"GET") == 2
+        finally:
+            cli.close()
+
+    def test_non_idempotent_command_surfaces_not_resent(self, drop_server):
+        cli = RespClient("127.0.0.1", drop_server.port, timeout_s=5.0)
+        try:
+            drop_server.drop_next = True
+            with pytest.raises((ConnectionError, OSError)):
+                cli.command("XADD", "s", "*", "f", "v")
+            # The server got it EXACTLY once: no double-append.
+            assert drop_server.received.count(b"XADD") == 1
+            # The client recovered: next command reconnects and works.
+            assert cli.command("PING") == "OK"
+        finally:
+            cli.close()
+
+    def test_unsafe_ok_restores_auto_retry(self, drop_server):
+        # Callers whose semantics tolerate duplicates (latest-wins frame
+        # plane, rmq duplicates-over-loss) opt back in per call.
+        cli = RespClient("127.0.0.1", drop_server.port, timeout_s=5.0)
+        try:
+            drop_server.drop_next = True
+            assert cli.command("XADD", "s", "*", "f", "v",
+                               unsafe_ok=True) == "OK"
+            assert drop_server.received.count(b"XADD") == 2
+        finally:
+            cli.close()
+
+    def test_pipeline_with_unsafe_verb_not_resent(self, drop_server):
+        cli = RespClient("127.0.0.1", drop_server.port, timeout_s=5.0)
+        try:
+            drop_server.drop_next = True
+            with pytest.raises((ConnectionError, OSError)):
+                cli.pipeline([("GET", "k"), ("LPUSH", "q", "x")])
+            assert drop_server.received.count(b"LPUSH") == 0  # died on GET
+            drop_server.drop_next = True
+            out = cli.pipeline([("GET", "k"), ("HSET", "h", "f", "v")])
+            assert out == ["OK", "OK"]  # all-idempotent pipeline retried
+        finally:
+            cli.close()
+
+    def test_non_idempotent_set_membership(self):
+        assert b"XADD" in NON_IDEMPOTENT and b"LPUSH" in NON_IDEMPOTENT
+        assert b"GET" not in NON_IDEMPOTENT and b"SET" not in NON_IDEMPOTENT
+        assert b"XRANGE" not in NON_IDEMPOTENT

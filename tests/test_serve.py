@@ -189,6 +189,34 @@ class TestProcessManager:
             lambda: manager.info("cam1").state.failing_streak >= 1, timeout=30
         )
 
+    def test_failing_streak_backoff_resets_after_stability(
+            self, pm, monkeypatch):
+        """ISSUE satellite: repeated worker exits grow a decorrelated-
+        jitter restart backoff (RetryPolicy, bounded by
+        RESTART_BACKOFF_MAX_S); once the worker stays up past the
+        stability window, streak AND backoff reset so the next failure
+        starts from base again."""
+        import video_edge_ai_proxy_tpu.serve.process_manager as pmmod
+
+        monkeypatch.setenv("vep_max_frames", "5")  # worker dies after 5
+        manager, bus, _ = pm
+        monkeypatch.setattr(manager, "STABLE_AFTER_S", 2.0)
+        manager.start(StreamProcess(name="cam1", rtsp_endpoint=synth_url()))
+        assert wait_for(
+            lambda: manager.info("cam1").state.failing_streak >= 2,
+            timeout=60,
+        )
+        entry = manager._entries["cam1"]
+        assert 0.0 < entry.backoff_s <= pmmod.RESTART_BACKOFF_MAX_S
+        # Source heals: respawned workers inherit the env WITHOUT the
+        # frame cap, run stable past the window, and the streak resets.
+        monkeypatch.delenv("vep_max_frames")
+        assert wait_for(
+            lambda: manager.info("cam1").state.failing_streak == 0,
+            timeout=60,
+        )
+        assert entry.backoff_s == 0.0
+
     def test_sigkill_exit_surfaces_oom_flag(self, pm):
         """SIGKILL exit (the kernel OOM killer's signature for a subprocess
         runner) must surface as oom_killed in the process state — the

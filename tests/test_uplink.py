@@ -77,6 +77,105 @@ class TestAnnotationMapping:
         assert "bounding_box" not in out and "location" not in out
 
 
+class _ScriptedCloud(object):
+    """CloudClient stand-in with a scripted outcome per post: 'ok'
+    delivers, 'down' raises URLError (transport), '403' raises
+    ForbiddenError. The last script entry repeats forever."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.posts = 0
+        self.batches = []   # delivered event lists, in arrival order
+
+    def post_annotations(self, url, annotations, deadline=None):
+        import urllib.error
+
+        from video_edge_ai_proxy_tpu.uplink.cloud import ForbiddenError
+
+        step = self.script[min(self.posts, len(self.script) - 1)]
+        self.posts += 1
+        if step == "down":
+            raise urllib.error.URLError("scripted outage")
+        if step == "403":
+            raise ForbiddenError("scripted 403")
+        self.batches.append(list(annotations))
+        return b"{}"
+
+
+def _fast_handler(cloud, spool=None):
+    import random
+
+    from video_edge_ai_proxy_tpu.resilience import CircuitBreaker, RetryPolicy
+    from video_edge_ai_proxy_tpu.uplink.cloud import make_batch_handler
+
+    return make_batch_handler(
+        None, "test://annotate", client=cloud, spool=spool,
+        retry=RetryPolicy(max_attempts=2, base_s=0.001, cap_s=0.002,
+                          rng=random.Random(0), sleep=lambda s: None),
+        breaker=CircuitBreaker("uplink_test", failure_threshold=2,
+                               recovery_timeout_s=0.0),
+    )
+
+
+class TestBatchHandlerResilience:
+    def _batch(self, tag, n=2):
+        return [
+            pb.AnnotateRequest(
+                device_name=f"{tag}-cam{i}", type="moving", start_timestamp=i,
+            ).SerializeToString()
+            for i in range(n)
+        ]
+
+    def test_failed_then_recovered_delivers_exactly_once(self, tmp_path):
+        """ISSUE satellite: endpoint down -> batches land in the spool
+        (acked, not lost, not requeued); endpoint recovers -> the next
+        post drains the backlog oldest-first; EVERY batch arrives at the
+        cloud exactly once."""
+        from video_edge_ai_proxy_tpu.resilience import DeadLetterSpool
+
+        cloud = _ScriptedCloud(["down"])
+        spool = DeadLetterSpool(str(tmp_path))
+        handler = _fast_handler(cloud, spool)
+        for tag in ("b0", "b1", "b2"):
+            assert handler(self._batch(tag)) is True  # spooled == acked
+        assert spool.pending() == 3 and cloud.batches == []
+        assert handler.breaker.state == "open"
+
+        cloud.script = ["ok"]                         # endpoint recovers
+        assert handler(self._batch("b3")) is True
+        assert spool.pending() == 0
+        names = [e["device_name"] for batch in cloud.batches for e in batch]
+        assert sorted(names) == sorted(
+            f"b{i}-cam{j}" for i in range(4) for j in range(2))
+        assert len(names) == len(set(names))          # exactly once
+        # Live batch first, then the spool drains oldest-first.
+        first_of = [b[0]["device_name"] for b in cloud.batches]
+        assert first_of == ["b3-cam0", "b0-cam0", "b1-cam0", "b2-cam0"]
+
+    def test_no_spool_requeues_instead(self):
+        cloud = _ScriptedCloud(["down"])
+        handler = _fast_handler(cloud, spool=None)
+        assert handler(self._batch("x")) is False  # queue keeps ownership
+
+    def test_forbidden_terminally_disables(self, tmp_path):
+        """ISSUE satellite: ForbiddenError still disables the consumer —
+        never spooled, never retried (credentials don't heal by retrying);
+        later batches are acked-and-dropped without touching the wire."""
+        from video_edge_ai_proxy_tpu.resilience import DeadLetterSpool
+
+        cloud = _ScriptedCloud(["403"])
+        spool = DeadLetterSpool(str(tmp_path))
+        handler = _fast_handler(cloud, spool)
+        assert handler(self._batch("a")) is True
+        assert handler.state["disabled"] is True
+        assert spool.pending() == 0          # terminal, not transient
+        posts_after_disable = cloud.posts
+        assert handler(self._batch("b")) is True
+        assert cloud.posts == posts_after_disable  # wire untouched
+        # An answered 403 is not a dependency failure: breaker stays closed.
+        assert handler.breaker.state == "closed"
+
+
 class TestSignedUplinkWire:
     def test_batch_handler_posts_signed_json(self):
         """The uplink's actual wire call (reference annotation_consumer.go:90

@@ -30,6 +30,15 @@ can act, hence jax.config.update (CLAUDE.md).
 Usage:
   python tools/soak_replay.py --duration 120            # acceptance run
   python tools/soak_replay.py --duration 20 --no-e2e    # quick smoke
+  python tools/soak_replay.py --duration 20 --no-e2e \
+      --faults uplink_down,bus_flap,device_stall        # chaos smoke
+
+With ``--faults`` the soak runs the resilience fault script instead of
+the churn plan and gates hard on the resilience invariants: annotation
+conservation (delivered + explicit spool evictions == published — zero
+silent loss), a fully-drained uplink at exit (zero deadlocks), and
+subscriber drops bounded by the frame budget. ``make chaos-smoke`` runs
+all three kinds deterministically.
 """
 
 from __future__ import annotations
@@ -71,6 +80,11 @@ def main(argv=None) -> None:
                          "Chrome trace-event JSON (load in Perfetto / "
                          "chrome://tracing; validate with "
                          "tools/obs_export.py --check)")
+    ap.add_argument("--faults", default="",
+                    help="comma list of resilience fault kinds for the "
+                         "soak (uplink_down, bus_flap, device_stall), "
+                         "scheduled in disjoint windows; omitted = the "
+                         "default churn plan")
     args = ap.parse_args(argv)
 
     import jax
@@ -124,7 +138,25 @@ def main(argv=None) -> None:
     print(json.dumps({"leg": "determinism", **det}), flush=True)
 
     # -- leg 2: chaos soak ------------------------------------------------
-    soak = run_fleet_soak(duration_s=args.duration, src_hw=(h, w))
+    fault_plan = None
+    if args.faults:
+        from video_edge_ai_proxy_tpu.replay.faults import (
+            KINDS, RESILIENCE_KINDS, FaultPlan,
+        )
+        kinds = [k.strip() for k in args.faults.split(",") if k.strip()]
+        bad = sorted(set(kinds) - set(KINDS))
+        if bad:
+            ap.error(f"unknown fault kind(s) {bad}; "
+                     f"choose from {sorted(RESILIENCE_KINDS)}")
+        churn = sorted(set(kinds) - set(RESILIENCE_KINDS))
+        if churn:
+            ap.error(f"--faults selects resilience kinds only "
+                     f"({sorted(RESILIENCE_KINDS)}); the churn kinds "
+                     f"{churn} run in the default plan when --faults is "
+                     f"omitted")
+        fault_plan = FaultPlan.resilience(args.duration, kinds=kinds)
+    soak = run_fleet_soak(duration_s=args.duration, src_hw=(h, w),
+                          fault_plan=fault_plan)
     artifact["soak"] = soak
     print(json.dumps({
         "leg": "soak",
@@ -142,6 +174,42 @@ def main(argv=None) -> None:
         raise SystemExit(
             f"soak failure: {soak['misrouted_results']} results crossed "
             f"model families (examples: {soak['misrouted_examples']})")
+    res = soak["resilience"]
+    uplink = res["uplink"]
+    print(json.dumps({
+        "leg": "resilience",
+        "ladder": res["ladder"],
+        "shed_frames": res["shed_frames"],
+        "breaker": uplink["breaker"],
+        "published": uplink["published"],
+        "delivered_events": uplink["delivered_events"],
+        "post_failures": uplink["post_failures"],
+        "spool": {k: uplink["spool"][k] for k in (
+            "spooled_batches", "drained_batches", "dropped_events",
+            "pending_batches")},
+        "conserved": uplink["conserved"],
+    }), flush=True)
+    # Chaos gates (ISSUE: zero deadlocks, zero lost annotations, bounded
+    # subscriber drops). Reaching this line at all is the deadlock gate's
+    # first half; a drained uplink is the second.
+    if not uplink["conserved"]:
+        raise SystemExit(
+            "chaos failure: annotation conservation broken — published="
+            f"{uplink['published']} != delivered="
+            f"{uplink['delivered_events']} + spool_dropped="
+            f"{uplink['spool']['dropped_events']}")
+    if uplink["final_queue_depth"] or uplink["spool"]["pending_batches"]:
+        raise SystemExit(
+            "chaos failure: uplink failed to drain after recovery "
+            f"(queue depth {uplink['final_queue_depth']}, spool "
+            f"{uplink['spool']['pending_batches']} batches) — wedged "
+            "retry/breaker/spool path")
+    max_drops = int(args.duration * soak["streams"] * 30.0)
+    if soak["subscriber_drops"] > max_drops:
+        raise SystemExit(
+            f"chaos failure: {soak['subscriber_drops']} subscriber drops "
+            f"exceeds the {max_drops} frame budget — drain thread was "
+            "blocked, not shedding")
     if args.trace_out:
         # run_fleet_soak leaves its span rings intact after restoring the
         # tracer config, so the export happens here, post-run.

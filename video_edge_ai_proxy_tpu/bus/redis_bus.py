@@ -28,6 +28,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..resilience.breaker import CircuitBreaker
 from ..utils.logging import get_logger
 from .interface import (
     FIELD_LAST_QUERY,
@@ -83,6 +84,15 @@ class RedisFrameBus(FrameBus):
         # after _REPROBE_S so a foreign-looking key that later becomes a
         # real camera is picked up without per-poll payload fetches.
         self._stream_verdict: dict[str, tuple[bool, float]] = {}
+        # Read-path circuit breaker: when Redis dies, the engine tick polls
+        # every stream every ~10 ms — without a breaker that is hundreds of
+        # reconnect storms per second and a raised exception per tick.
+        # Open breaker => reads degrade (no frame / no streams) at memory
+        # speed; one probe per recovery window re-closes it when the
+        # server returns. Writes still raise so producers see the outage.
+        self._breaker = CircuitBreaker(
+            "redis_bus_read", failure_threshold=3, recovery_timeout_s=1.0
+        )
 
     # -- frame plane --
 
@@ -129,15 +139,42 @@ class RedisFrameBus(FrameBus):
         )
         for i, dim in enumerate(arr.shape):
             vf.shape.dim.append(pb.ShapeProto.Dim(size=dim, name=str(i)))
+        # unsafe_ok: XADD is non-idempotent (a resync retry can append the
+        # frame twice), but the frame plane is latest-wins with MAXLEN ~
+        # trimming — a duplicate newest entry is benign, losing the frame
+        # to a transient flap is worse.
         entry_id = self._client.command(
             "XADD", device_id, "MAXLEN", "~",
             str(self._maxlen.get(device_id, 1)), "*",
             "data", vf.SerializeToString(),
+            unsafe_ok=True,
         )
         note_publish("redis", device_id, arr.nbytes)
         return _id_to_seq(entry_id)
 
+    def _guard_read(self, fn, fallback):
+        """Run one read under the breaker; degrade to ``fallback`` on a
+        dead link (and while the breaker is open) instead of raising."""
+        if not self._breaker.allow():
+            return fallback
+        try:
+            out = fn()
+        except (OSError, ConnectionError) as exc:
+            self._breaker.record_failure()
+            log.warning("redis read failed (%s); breaker %s",
+                        exc, self._breaker.state)
+            return fallback
+        self._breaker.record_success()
+        return out
+
     def read_latest(self, device_id: str, min_seq: int = 0) -> Optional[Frame]:
+        return self._guard_read(
+            lambda: self._read_latest_unguarded(device_id, min_seq), None
+        )
+
+    def _read_latest_unguarded(
+        self, device_id: str, min_seq: int = 0
+    ) -> Optional[Frame]:
         if min_seq:
             # Cheap tip probe before shipping a multi-MB frame body: the
             # collector polls faster than cameras produce, so most reads
@@ -167,6 +204,16 @@ class RedisFrameBus(FrameBus):
         return Frame(seq=seq, **_unmarshal(payload))
 
     def read_latest_blocking(
+        self, device_id: str, min_seq: int = 0, timeout_s: float = 1.0
+    ) -> Optional[Frame]:
+        return self._guard_read(
+            lambda: self._read_latest_blocking_unguarded(
+                device_id, min_seq, timeout_s
+            ),
+            None,
+        )
+
+    def _read_latest_blocking_unguarded(
         self, device_id: str, min_seq: int = 0, timeout_s: float = 1.0
     ) -> Optional[Frame]:
         """Server-side wait via ``XREAD BLOCK`` — ONE round trip per miss
@@ -204,7 +251,10 @@ class RedisFrameBus(FrameBus):
             )
             if reply:
                 # Something newer than min_seq exists; serve the tip.
-                frame = self.read_latest(device_id, min_seq=min_seq)
+                # Unguarded: this whole loop already runs under ONE
+                # breaker admission (a nested allow() would reject the
+                # half-open probe's own inner read).
+                frame = self._read_latest_unguarded(device_id, min_seq=min_seq)
                 if frame is not None:
                     return frame
 
@@ -225,6 +275,9 @@ class RedisFrameBus(FrameBus):
     _REPROBE_S = 10.0  # rejected-key re-probe interval
 
     def streams(self) -> list[str]:
+        return self._guard_read(self._streams_unguarded, [])
+
+    def _streams_unguarded(self) -> list[str]:
         """Stream-typed keys that are actually camera frame streams.
 
         The db is shared in the mixed-fleet deployment this backend exists

@@ -21,16 +21,45 @@ class RespError(Exception):
     """Server returned a RESP error reply."""
 
 
+#: Verbs that mutate state non-idempotently: re-sending one after a resync
+#: can double-apply it (two XADD entries, a counter bumped twice, a list
+#: popped twice). Everything else (GET/SET/HSET/DEL/XRANGE/...) converges
+#: to the same state when replayed and is safe to auto-retry.
+NON_IDEMPOTENT = frozenset({
+    b"XADD", b"XDEL", b"XAUTOCLAIM",
+    b"INCR", b"INCRBY", b"INCRBYFLOAT", b"DECR", b"DECRBY",
+    b"HINCRBY", b"HINCRBYFLOAT",
+    b"APPEND", b"SETRANGE",
+    b"LPUSH", b"RPUSH", b"LPUSHX", b"RPUSHX", b"LPOP", b"RPOP",
+    b"BLPOP", b"BRPOP", b"RPOPLPUSH", b"BRPOPLPUSH", b"LMOVE", b"BLMOVE",
+    b"LREM", b"LINSERT", b"SPOP",
+})
+
+
+def _verb(parts) -> bytes:
+    head = parts[0]
+    if not isinstance(head, bytes):
+        head = str(head).encode()
+    return head.upper()
+
+
 class RespClient:
     """One socket, one lock: commands are request/response and the bus
     serializes callers (same stance as the shm bus's consumer lock).
 
     A socket error mid-command leaves the stream desynced (a partial reply
     may sit in the buffer), so any failure drops the connection, clears the
-    buffer, reconnects, and retries the command once — the resync the
-    reference gets from go-redis/redis-py's connection pools. The retry can
-    double-apply a non-idempotent command (an XADD that executed before the
-    link died) — benign under latest-wins frame semantics."""
+    buffer, reconnects, and — when that is provably safe — retries the
+    command once (the resync the reference gets from go-redis/redis-py's
+    connection pools). Safety is idempotency-aware: if ``sendall`` itself
+    failed, the server saw at most a partial RESP command it cannot
+    execute, so *anything* may be re-sent; if the failure came while
+    reading the reply, the command may already have executed, so only
+    verbs outside :data:`NON_IDEMPOTENT` are re-sent. A non-idempotent
+    command that may have executed surfaces ``ConnectionError`` to the
+    caller instead — callers that tolerate duplicates (the XADD frame
+    plane under latest-wins, the rmq queue's duplicates-over-loss
+    contract) opt back in per call with ``unsafe_ok=True``."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
                  timeout_s: float = 5.0, handshake: tuple = ()):
@@ -126,30 +155,39 @@ class RespClient:
             b"$%d\r\n%s\r\n" % (len(p), p) for p in enc
         )
 
-    def command(self, *parts: Union[str, bytes, int]) -> Reply:
+    def command(self, *parts: Union[str, bytes, int],
+                unsafe_ok: bool = False) -> Reply:
         msg = self._encode(parts)
+        retry_safe = unsafe_ok or _verb(parts) not in NON_IDEMPOTENT
         with self._lock:
             for attempt in (0, 1):
+                sent = False
                 try:
                     if self._sock is None:
                         self._connect()
                     self._sock.sendall(msg)
+                    sent = True
                     return self._read_reply()
                 except (OSError, ConnectionError):
                     # Desynced or dead link: never reuse the buffer/socket.
                     self.close()
-                    if attempt:
+                    # sent=False -> the server got at most a partial RESP
+                    # command it cannot execute: replaying is always safe.
+                    # sent=True -> it may have executed: replay only
+                    # idempotent verbs (or explicit unsafe_ok opt-ins).
+                    if attempt or (sent and not retry_safe):
                         raise
             raise ConnectionError("unreachable")  # pragma: no cover
 
-    def pipeline(self, commands) -> list:
+    def pipeline(self, commands, *, unsafe_ok: bool = False) -> list:
         """Send N commands in ONE write and read N replies — one round
         trip instead of N (the batch-drain path needs this: popping and
         acking a 299-event batch command-by-command costs ~600 sequential
-        RTTs against a remote server). Same resync-retry-once semantics
-        as ``command``; the retry can double-apply non-idempotent
-        commands, which callers must tolerate (the annotation queue's
-        rmq semantics already do — duplicates over loss).
+        RTTs against a remote server). Resync-retry semantics match
+        ``command``, with the whole pipeline as the unit: it is re-sent
+        only if the link died before any of it reached the server, or if
+        every verb is idempotent, or with ``unsafe_ok=True`` (the
+        annotation queue's rmq pipelines opt in — duplicates over loss).
 
         A server error reply mid-pipeline is returned in place as a
         RespError INSTANCE (not raised): later replies still need
@@ -158,12 +196,17 @@ class RespClient:
         if not commands:
             return []
         msg = b"".join(self._encode(c) for c in commands)
+        retry_safe = unsafe_ok or all(
+            _verb(c) not in NON_IDEMPOTENT for c in commands
+        )
         with self._lock:
             for attempt in (0, 1):
+                sent = False
                 try:
                     if self._sock is None:
                         self._connect()
                     self._sock.sendall(msg)
+                    sent = True
                     out = []
                     for _ in commands:
                         try:
@@ -173,7 +216,7 @@ class RespClient:
                     return out
                 except (OSError, ConnectionError):
                     self.close()
-                    if attempt:
+                    if attempt or (sent and not retry_safe):
                         raise
             raise ConnectionError("unreachable")  # pragma: no cover
 

@@ -73,6 +73,10 @@ class Collector:
     ):
         self._bus = bus
         self._buckets = tuple(sorted(buckets))
+        # Degradation-ladder bucket cap (resilience/ladder.py rung 2):
+        # None = full bucket list; an int hides buckets above it so new
+        # batches compile/run at the next-smaller device program.
+        self._bucket_cap: Optional[int] = None
         self._clip_len = clip_len
         self._active_window_s = active_window_s
         self._model_of = model_of
@@ -115,6 +119,22 @@ class Collector:
             "Frames superseded before read (latest-wins drops)",
             ("stream",),
         )
+
+    def set_bucket_cap(self, cap: Optional[int]) -> None:
+        """Cap the effective bucket list (degradation-ladder rung 2,
+        resilience/ladder.py): ``cap=8`` hides buckets above 8 so new
+        batches run the smaller, already-compiled device program; ``None``
+        restores the full list. In-flight groups and the assembly
+        window's existing allocations are untouched — the cap applies
+        from the next planning/collect pass."""
+        self._bucket_cap = cap
+
+    def _effective_buckets(self) -> tuple:
+        cap = self._bucket_cap
+        if cap is None:
+            return self._buckets
+        eff = tuple(b for b in self._buckets if b <= cap)
+        return eff or self._buckets[:1]
 
     def _note_read(self, device_id: str, seq: int, meta) -> None:
         """Every cursor advance funnels here: counts latest-wins skips and
@@ -356,7 +376,8 @@ class Collector:
         path and join the window next tick)."""
         if device_ids is None:
             device_ids = self.inference_streams()
-        max_bucket = self._buckets[-1]
+        buckets = self._effective_buckets()
+        max_bucket = buckets[-1]
         fast_plan: Dict[tuple, list] = {}
         for device_id in device_ids:
             model, clip_len = self._stream_model(device_id)
@@ -368,7 +389,7 @@ class Collector:
         for (model, geom), devs in sorted(fast_plan.items()):
             for ci, start in enumerate(range(0, len(devs), max_bucket)):
                 chunk = devs[start:start + max_bucket]
-                alloc = next(b for b in self._buckets if b >= len(chunk))
+                alloc = next(b for b in buckets if b >= len(chunk))
                 shape = (alloc,) + geom
                 buf, bidx = self._pooled(shape)
                 key = (model, geom, ci)
@@ -441,7 +462,8 @@ class Collector:
         if device_ids is None:
             device_ids = self.inference_streams()
         self._begin_tick()
-        max_bucket = self._buckets[-1]
+        buckets = self._effective_buckets()
+        max_bucket = buckets[-1]
 
         groups: List[BatchGroup] = []
         spill: List[tuple] = []             # geometry drifted mid-plan
@@ -460,6 +482,9 @@ class Collector:
                 n = len(g["ids"])
                 if n == 0:
                     continue   # idle group; its buffer ages out via epochs
+                # Full bucket list, NOT the capped one: the window buffer
+                # was allocated before a cap could land, and its alloc is
+                # always a member of the full list >= n.
                 bucket = next(b for b in self._buckets if b >= n)
                 view = g["buf"][:bucket]
                 if bucket != n:
@@ -487,7 +512,7 @@ class Collector:
         for (model, geom), devs in sorted(fast_plan.items()):
             for start in range(0, len(devs), max_bucket):
                 chunk = devs[start:start + max_bucket]
-                alloc = next(b for b in self._buckets if b >= len(chunk))
+                alloc = next(b for b in buckets if b >= len(chunk))
                 batch, bidx = self._pooled((alloc,) + geom)
                 ids: List[str] = []
                 metas: List[FrameMeta] = []
@@ -517,7 +542,7 @@ class Collector:
                         # unrotating would pop a legitimate same-tick entry.
                         self._unrotate((alloc,) + geom)
                     continue
-                bucket = next(b for b in self._buckets if b >= n)
+                bucket = next(b for b in buckets if b >= n)
                 view = batch[:bucket]
                 if bucket != n:
                     view[n:] = 0
@@ -566,7 +591,7 @@ class Collector:
             for start in range(0, len(items), max_bucket):
                 chunk = items[start:start + max_bucket]
                 n = len(chunk)
-                bucket = next(b for b in self._buckets if b >= n)
+                bucket = next(b for b in buckets if b >= n)
                 # Fused stack+pad: one pass instead of np.stack + concat.
                 batch = np.empty(
                     (bucket,) + chunk[0][1].shape, chunk[0][1].dtype

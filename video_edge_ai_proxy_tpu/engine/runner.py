@@ -45,6 +45,7 @@ from ..ops.preprocess import (
     unletterbox_boxes,
 )
 from ..proto import pb
+from ..resilience.ladder import RUNGS, DegradationLadder
 from ..utils.config import EngineConfig
 from ..utils.logging import get_logger
 from .classes import class_name
@@ -115,6 +116,54 @@ def build_serving_step(model, spec):
             return {"top_probs": top_p, "top_ids": top_i.astype(jnp.int32)}
 
     return raw
+
+
+_RUNG_IDX = {r: i for i, r in enumerate(RUNGS)}
+
+
+def admitted_streams(inferred: Sequence[str]) -> List[str]:
+    """Degradation-ladder rung 3 (admission_pause): admit a deterministic
+    half of the streams — the first half of the sorted id list, so the
+    SAME streams stay admitted across ticks (stable batches, no
+    membership thrash) and recovery resumes the rest. One stream never
+    pauses (shedding the whole fleet is an outage, not a degradation)."""
+    ids = sorted(inferred)
+    if len(ids) <= 1:
+        return ids
+    return ids[: (len(ids) + 1) // 2]
+
+
+def shed_stale(group: BatchGroup, now_ms: float, max_staleness_ms: float,
+               buckets: Sequence[int]):
+    """Degradation-ladder rung 1: drop frames older than the staleness
+    bound from a collected group BEFORE dispatch (oldest-first by
+    construction — only stale rows leave). Fresh rows compact in place
+    within the pooled buffer view (the lease is untouched) and the view
+    re-slices to the smallest covering bucket. Returns ``(group, shed)``;
+    group is None when every row was stale (caller releases the lease).
+    Frames without a publish timestamp are treated as fresh."""
+    keep = [
+        i for i, m in enumerate(group.metas)
+        if not m.timestamp_ms or now_ms - m.timestamp_ms <= max_staleness_ms
+    ]
+    shed = len(group.metas) - len(keep)
+    if shed == 0:
+        return group, 0
+    if not keep:
+        return None, shed
+    for new_i, old_i in enumerate(keep):
+        if new_i != old_i:
+            group.frames[new_i] = group.frames[old_i]
+    group.device_ids = [group.device_ids[i] for i in keep]
+    group.metas = [group.metas[i] for i in keep]
+    n = len(keep)
+    bucket = next(b for b in sorted(buckets) if b >= n)
+    view = group.frames[:bucket]
+    if bucket != n:
+        view[n:] = 0
+    group.frames = view
+    group.bucket = bucket
+    return group, shed
 
 
 @dataclass
@@ -299,6 +348,23 @@ class InferenceEngine:
         # Recompile-storm detection state (tick loop only).
         self._miss_seen = 0.0
         self._miss_streak = 0
+        # Overload degradation ladder (resilience/ladder.py): observed
+        # once per tick with drain-queue depth + previous tick duration;
+        # the returned rung gates shedding / bucket cap / admission.
+        # Shares the engine watchdog so a degraded excursion logs once.
+        self.ladder: Optional[DegradationLadder] = None
+        if self._cfg.ladder:
+            self.ladder = DegradationLadder(
+                escalate_after_s=self._cfg.ladder_escalate_after_s,
+                recover_after_s=self._cfg.ladder_recover_after_s,
+                watchdog=self.watchdog,
+            )
+        self.shed_frames = 0
+        self._m_shed = obs_registry.counter(
+            "vep_ladder_shed_frames_total",
+            "Frames shed by the degradation ladder (stale at dispatch)",
+        ).labels()
+        self._last_tick_dur_s = 0.0
 
     # -- lifecycle --
 
@@ -910,10 +976,30 @@ class InferenceEngine:
             # log-and-keep-going stance as the reference's worker loops,
             # rtsp_to_rtmp.py:186-187).
             try:
+                # Degradation ladder: one observe per tick (queue depth +
+                # last tick's duration vs budget); the rung gates the
+                # stages below. Closed-ladder overhead is one comparison.
+                rung = "normal"
+                if self.ladder is not None:
+                    rung = self.ladder.observe(
+                        queue_depth=self._drain_q.qsize(),
+                        tick_lag_s=self._last_tick_dur_s,
+                        tick_budget_s=tick_s,
+                    )
+                    self._apply_rung_cap(rung)
                 # One bus enumeration per tick, threaded everywhere.
                 present, inferred = self._collector.partition()
+                if rung == "admission_pause":
+                    # Rung 3: only the admitted half competes for device
+                    # slots; the paused half's workers stop decoding too
+                    # (keep_streams_hot skips them).
+                    inferred = admitted_streams(inferred)
                 self._collector.keep_streams_hot(device_ids=inferred)
                 groups = self._collector.collect(device_ids=inferred)
+                if rung != "normal" and groups:
+                    # Rung 1+: stale frames leave before they cost device
+                    # time (shed oldest-first with a staleness bound).
+                    groups = self._shed_stale_groups(groups)
                 t_collect = time.time() if self._cfg.stage_trace else 0.0
                 trace_on = tracer.enabled
                 for gi, group in enumerate(groups):
@@ -985,6 +1071,10 @@ class InferenceEngine:
             self.ticks += 1
             self._m_ticks.inc()
             self.last_tick_monotonic = time.monotonic()
+            # Tick staleness signal for the ladder: how long the work
+            # phase (partition/collect/dispatch) ran, excluding the
+            # assembly window that absorbs the remaining budget.
+            self._last_tick_dur_s = self.last_tick_monotonic - t0
             self._watch_tick(tick_s)
             try:
                 # Tick remainder = incremental assembly: copy next tick's
@@ -1001,6 +1091,33 @@ class InferenceEngine:
                 elapsed = time.monotonic() - t0
                 if elapsed < tick_s:
                     self._stop.wait(tick_s - elapsed)
+
+    def _apply_rung_cap(self, rung: str) -> None:
+        """Rung 2+ (bucket_downshift): hide the largest batch bucket so
+        new batches run the next-smaller (cheaper, typically
+        already-compiled) device program; below rung 2 the cap clears."""
+        cap = None
+        if _RUNG_IDX[rung] >= 2 and len(self._buckets) > 1:
+            cap = self._buckets[-2]
+        self._collector.set_bucket_cap(cap)
+
+    def _shed_stale_groups(self, groups: List[BatchGroup]) -> List[BatchGroup]:
+        """Apply rung 1 shedding to this tick's groups (see shed_stale);
+        fully-stale groups return their pooled-buffer lease here."""
+        now_ms = time.time() * 1000.0
+        out: List[BatchGroup] = []
+        for group in groups:
+            kept, shed = shed_stale(
+                group, now_ms, self._cfg.shed_staleness_ms, self._buckets
+            )
+            if shed:
+                self.shed_frames += shed
+                self._m_shed.inc(shed)
+            if kept is None:
+                self._collector.release(group)
+            else:
+                out.append(kept)
+        return out
 
     def _watch_tick(self, tick_s: float) -> None:
         """Per-tick watermark checks (obs/watch.py): each warns once per

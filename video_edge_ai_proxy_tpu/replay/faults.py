@@ -16,6 +16,16 @@ them (replay/harness.py):
 - ``slow_subscriber`` — stop draining the result subscription for
   ``duration_s`` (backpressure: the engine must drop-and-count via
   subscriber_drops, never block the drain thread).
+- ``uplink_down`` — the annotation cloud endpoint fails every POST for
+  ``duration_s`` (resilience wiring: retries back off, the breaker
+  opens, batches land in the dead-letter spool and re-drain on
+  recovery — zero annotations lost).
+- ``bus_flap`` — publishes raise ``ConnectionError`` for ``duration_s``
+  (a flapping link: cameras tolerate it, the bus breaker and resp
+  idempotency-aware resync keep readers degraded, not wedged).
+- ``device_stall`` — every device step call slows for ``duration_s``
+  (a contended/thermal-throttled chip: sustained tick-budget overrun
+  must walk the engine's degradation ladder, then recover).
 
 JSON round-trip so plans can be committed next to artifacts.
 """
@@ -27,8 +37,21 @@ from dataclasses import asdict, dataclass, field
 
 KINDS = (
     "camera_kill", "camera_restore", "frame_gap", "bus_stall",
-    "slow_subscriber",
+    "slow_subscriber", "uplink_down", "bus_flap", "device_stall",
 )
+
+#: Schedule template for the resilience kinds (fraction of the soak
+#: window: start, duration) — disjoint windows, each with recovery slack
+#: before the next, so the artifact attributes effects to causes.
+_RESILIENCE_WINDOWS = {
+    "uplink_down": (0.15, 0.20),
+    "bus_flap": (0.50, 0.06),
+    "device_stall": (0.62, 0.15),
+}
+
+#: The kinds `tools/soak_replay.py --faults` may select (the churn kinds
+#: need per-device scheduling and run via default_churn instead).
+RESILIENCE_KINDS = tuple(_RESILIENCE_WINDOWS)
 
 
 @dataclass(order=True)
@@ -99,4 +122,26 @@ class FaultPlan:
         ev.append(FaultEvent(
             at_s=duration_s * 0.85, kind="slow_subscriber",
             duration_s=max(2.0, duration_s * 0.05)))
+        return cls(ev)
+
+    @classmethod
+    def resilience(
+        cls, duration_s: float, kinds=("uplink_down", "bus_flap",
+                                       "device_stall"),
+    ) -> "FaultPlan":
+        """The chaos-smoke script: the three resilience fault kinds in
+        disjoint windows scaled to the soak length (``make chaos-smoke``
+        runs all three; ``tools/soak_replay.py --faults`` selects)."""
+        ev = []
+        for kind in kinds:
+            if kind not in _RESILIENCE_WINDOWS:
+                raise ValueError(
+                    f"not a resilience fault kind: {kind!r} "
+                    f"(choose from {sorted(_RESILIENCE_WINDOWS)})"
+                )
+            frac, dur = _RESILIENCE_WINDOWS[kind]
+            ev.append(FaultEvent(
+                at_s=duration_s * frac, kind=kind,
+                duration_s=max(1.0, duration_s * dur),
+            ))
         return cls(ev)

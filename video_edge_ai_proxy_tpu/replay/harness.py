@@ -10,10 +10,13 @@ Three entry points, all consumed by ``tools/soak_replay.py``:
   claim, and the host for the seeded-numerics-fault test.
 - :func:`run_fleet_soak` — in-process fleet soak: N replay-driven cameras
   (6 detect + 5 embed + 5 classify by default) on the in-proc bus, one
-  InferenceEngine with per-stream model routing, a scripted FaultPlan
-  (camera kill/re-add, frame gaps, bus stall, slow subscriber), recording
+  InferenceEngine with per-stream model routing, the REAL annotation
+  uplink handler (retry + breaker + dead-letter spool) over a flaky fake
+  cloud, a scripted FaultPlan (camera kill/re-add, frame gaps, bus
+  stall/flap, slow subscriber, uplink down, device stall), recording
   per-family latency percentiles, bucket_fill over time, step-cache
-  stability and cross-family result misrouting.
+  stability, cross-family result misrouting, and a "resilience" section
+  (ladder transitions, breaker states, annotation conservation).
 - :func:`run_e2e` — the FULL single-process pipeline: a real Server
   (subprocess ingest worker reading ``replay://``, bus, collector,
   engine, gRPC serve) with a client measuring publish->receive latency —
@@ -140,13 +143,15 @@ def lockstep_checksum(
 
 class StallBus:
     """FrameBus proxy whose publish path can be stalled for a window —
-    the ``bus_stall`` fault (a wedged shm writer / slow Redis). Publishes
-    block in small sleeps until the window passes; everything else
-    delegates."""
+    the ``bus_stall`` fault (a wedged shm writer / slow Redis) — or made
+    to fail fast for a window — the ``bus_flap`` fault (a flapping link:
+    publishes raise ``ConnectionError`` instead of blocking). Everything
+    else delegates."""
 
     def __init__(self, bus):
         self._bus = bus
         self._stall_until = 0.0
+        self._flap_until = 0.0
 
     def __getattr__(self, name):
         return getattr(self._bus, name)
@@ -154,10 +159,40 @@ class StallBus:
     def stall_for(self, duration_s: float) -> None:
         self._stall_until = time.monotonic() + duration_s
 
+    def flap_for(self, duration_s: float) -> None:
+        self._flap_until = time.monotonic() + duration_s
+
     def publish(self, device_id, frame, meta):
         while time.monotonic() < self._stall_until:
             time.sleep(0.01)
+        if time.monotonic() < self._flap_until:
+            raise ConnectionError("bus_flap (scripted fault)")
         return self._bus.publish(device_id, frame, meta)
+
+
+class _FlakyCloud:
+    """CloudClient stand-in for the soak's annotation uplink: delivery is
+    an in-memory count, and the ``uplink_down`` fault makes every post
+    raise ``URLError`` for a window — the transport-failure class the
+    real handler retries, breaks on, and spools through. Exactly-once by
+    construction (a post either raises before counting or delivers), so
+    the artifact's conservation check is exact."""
+
+    def __init__(self):
+        self.down_until = 0.0
+        self.posts = 0
+        self.post_failures = 0
+        self.delivered = 0
+
+    def post_annotations(self, url, annotations, deadline=None):
+        import urllib.error
+
+        self.posts += 1
+        if time.monotonic() < self.down_until:
+            self.post_failures += 1
+            raise urllib.error.URLError("uplink_down (scripted fault)")
+        self.delivered += len(annotations)
+        return b"{}"
 
 
 class _ReplayCamera(threading.Thread):
@@ -210,6 +245,13 @@ class _ReplayCamera(threading.Thread):
             meta = meta_for(ev, frame, timestamp_ms=int(time.time() * 1000))
             try:
                 self.bus.publish(self.device_id, frame, meta)
+            except ConnectionError:
+                # bus_flap: the link dropped the publish but the stream
+                # itself is intact — count suppressed and keep the
+                # cursor (re-creating the stream would reset its seq and
+                # confuse the collector for no reason).
+                self.suppressed += 1
+                continue
             except ValueError:
                 # Raced a camera_kill's drop_stream: treat as suppressed
                 # and re-create on the next live frame.
@@ -227,6 +269,9 @@ def run_fleet_soak(
     timeline_bin_s: float = 10.0, trace_sample_every: int = 4,
 ) -> dict:
     """The >=120 s chaos soak. Returns the artifact's "soak" section."""
+    import shutil
+    import tempfile
+
     import jax
 
     from ..bus.memory_bus import MemoryFrameBus
@@ -234,6 +279,9 @@ def run_fleet_soak(
     from ..models import registry
     from ..obs import registry as obs_registry, tracer
     from ..obs.spans import stage_breakdown
+    from ..resilience import CircuitBreaker, DeadLetterSpool, RetryPolicy
+    from ..uplink.cloud import make_batch_handler
+    from ..uplink.queue import AnnotationQueue
     from ..utils.config import EngineConfig
 
     backend = jax.default_backend()
@@ -268,14 +316,57 @@ def run_fleet_soak(
     inner_bus = MemoryFrameBus()
     bus = StallBus(inner_bus)
     default_model = next(iter(fleet))
+
+    # Annotation uplink under test: the REAL batch handler (retry +
+    # breaker + dead-letter spool, uplink/cloud.py) over a flaky fake
+    # transport. Timings are soak-scale (tens of ms) so the uplink_down
+    # window exercises the whole ladder: retries, breaker open, spool,
+    # drain-on-recovery — within one smoke run.
+    ann_cloud = _FlakyCloud()
+    spool_dir = tempfile.mkdtemp(prefix="vep_soak_spool_")
+    ann_spool = DeadLetterSpool(spool_dir, max_bytes=8 << 20)
+    ann_handler = make_batch_handler(
+        None, "soak://annotate", client=ann_cloud, spool=ann_spool,
+        retry=RetryPolicy(max_attempts=2, base_s=0.01, cap_s=0.05),
+        breaker=CircuitBreaker(
+            "uplink_soak", failure_threshold=2, recovery_timeout_s=0.5),
+        post_deadline_s=5.0,
+    )
+    ann_q = AnnotationQueue(
+        ann_handler, max_batch_size=299, poll_duration_ms=100,
+        unacked_limit=100_000, requeue_interval_s=0.5,
+    )
+    ann_q.start()
+
     eng = InferenceEngine(
         bus,
         EngineConfig(
             model=default_model, tick_ms=tick_ms, stage_trace=True,
             batch_buckets=(1, 2, 4, 8, 16), track=False,
+            annotation_emit="all",   # firehose: conservation needs volume
         ),
         model_resolver=lambda d: assignment.get(d, ""),
+        annotations=ann_q,
     )
+
+    # device_stall fault: while the window is open every serving-step
+    # call eats ~50 ms of fake device time. Per-call (not one long
+    # block) so consecutive over-budget ticks build the SUSTAINED
+    # pressure the ladder's escalate hysteresis requires.
+    stall = {"until": 0.0}
+    _orig_step = eng._step
+
+    def _stalled_step(src_hw, bucket, model=None):
+        fn = _orig_step(src_hw, bucket, model)
+
+        def slow(*a, **k):
+            if time.monotonic() < stall["until"]:
+                time.sleep(0.05)
+            return fn(*a, **k)
+
+        return slow
+
+    eng._step = _stalled_step
     eng.warmup()
     eng.start()
 
@@ -386,6 +477,12 @@ def run_fleet_soak(
                 bus.stall_for(ev.duration_s)
             elif ev.kind == "slow_subscriber":
                 slow_until[0] = time.monotonic() + ev.duration_s
+            elif ev.kind == "uplink_down":
+                ann_cloud.down_until = time.monotonic() + ev.duration_s
+            elif ev.kind == "bus_flap":
+                bus.flap_for(ev.duration_s)
+            elif ev.kind == "device_stall":
+                stall["until"] = time.monotonic() + ev.duration_s
         if now_s >= next_sample:
             step_cache_samples.append(
                 {"t_s": round(now_s, 1), "programs": len(eng._step_cache)})
@@ -414,9 +511,50 @@ def run_fleet_soak(
         },
     }
     tracer.configure(enabled=prev_trace[0], sample_every=prev_trace[1])
+    ladder_snapshot = eng.ladder.snapshot() if eng.ladder is not None else None
+    shed_frames = eng.shed_frames
     eng.stop()
     sink_thread.join(timeout=5)
     inner_bus.close()
+
+    # Final uplink drain: uplink healthy again, every queued batch and
+    # every spooled batch must make it out — the "zero lost annotations"
+    # claim is this loop terminating with both depths at zero.
+    ann_cloud.down_until = 0.0
+    drain_deadline = time.monotonic() + 30.0
+    while ann_q.depth() > 0 and time.monotonic() < drain_deadline:
+        ann_q.requeue_rejected()
+        if ann_q.drain_once() == 0:
+            time.sleep(0.05)
+    while ann_spool.pending() > 0 and time.monotonic() < drain_deadline:
+        ann_handler([])   # empty batch = pure spool drain through cloud.py
+    ann_q.stop()
+    spool_snapshot = ann_spool.snapshot()
+    shutil.rmtree(spool_dir, ignore_errors=True)
+    # Conservation: everything the engine enqueued was delivered exactly
+    # once, minus only explicit spool evictions (bounded spool) — no
+    # silent loss anywhere in queue -> handler -> spool -> drain.
+    conserved = (
+        ann_cloud.delivered + spool_snapshot["dropped_events"]
+        == ann_q.published
+    )
+    resilience_section = {
+        "ladder": ladder_snapshot,
+        "shed_frames": shed_frames,
+        "uplink": {
+            "published": ann_q.published,
+            "acked": ann_q.acked,
+            "queue_dropped": ann_q.dropped,
+            "rejected_batches": ann_q.rejected_batches,
+            "posts": ann_cloud.posts,
+            "post_failures": ann_cloud.post_failures,
+            "delivered_events": ann_cloud.delivered,
+            "final_queue_depth": ann_q.depth(),
+            "breaker": ann_handler.breaker.snapshot(),
+            "spool": spool_snapshot,
+            "conserved": conserved,
+        },
+    }
 
     bucket_fill_timeline = [
         {
@@ -463,6 +601,7 @@ def run_fleet_soak(
         "streams_with_results": len(stats),
         "faults_applied": faults_applied,
         "obs": obs_section,
+        "resilience": resilience_section,
     }
 
 

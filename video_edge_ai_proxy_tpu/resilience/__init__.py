@@ -1,0 +1,31 @@
+"""Fault-domain isolation primitives (retry/backoff, deadlines, breakers,
+dead-letter spooling, engine degradation ladder).
+
+No reference counterpart: the reference proxy leans on Docker
+``--restart always`` (``rtsp_process_manager.go:76``) and go-redis
+connection pools for all of its fault handling, so every remote
+dependency is one naked call deep. Here failure is a first-class,
+bounded state: callers compose a RetryPolicy (decorrelated-jitter
+backoff under a Deadline budget), a per-dependency CircuitBreaker, and —
+for data that must not be dropped — a bounded on-disk DeadLetterSpool.
+The engine's overload behavior is the DegradationLadder.
+
+Everything in this package is pure Python (no jax), deterministic under
+injected clocks, and safe to import from control-plane code.
+"""
+
+from .breaker import BreakerOpen, CircuitBreaker
+from .ladder import RUNGS, DegradationLadder
+from .policy import Deadline, DeadlineExceeded, RetryPolicy
+from .spool import DeadLetterSpool
+
+__all__ = [
+    "BreakerOpen",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "DegradationLadder",
+    "DeadLetterSpool",
+    "RetryPolicy",
+    "RUNGS",
+]

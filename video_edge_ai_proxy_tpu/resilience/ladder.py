@@ -1,0 +1,144 @@
+"""Engine overload degradation ladder (state machine only; the engine
+applies the rungs).
+
+No reference counterpart: under overload the reference's per-camera
+decode loops simply fall behind (latest-frame-wins ring hides the lag,
+``rtsp_to_rtmp.py:144-145``) and the annotation queue sheds newest-first
+at ``unacked_limit``. A fused TPU serving plane needs an *explicit*
+policy instead, because one slow tick stalls every stream in the batch.
+
+Rungs, in escalation order (each includes the previous):
+
+1. ``normal``           — nothing.
+2. ``shed``             — drop frames older than a staleness bound
+                          before dispatch (oldest-first, per group).
+3. ``bucket_downshift`` — cap the collector's batch bucket at the
+                          next-smaller size so device programs shrink.
+4. ``admission_pause``  — pause admission for a deterministic half of
+                          the streams; the rest keep their latency SLO.
+
+Pressure is ``queue_depth >= depth_threshold`` (drain backpressure) or
+``tick_lag_s > lag_factor * tick_budget_s`` (tick staleness). The ladder
+escalates one rung after ``escalate_after_s`` of *continuous* pressure
+(the timer restarts at each transition, so reaching rung N takes N
+windows) and recovers one rung per ``recover_after_s`` pressure-free.
+Transitions are counted in the obs registry (``vep_ladder_rung``,
+``vep_ladder_transitions_total{to}``) and a degraded episode is logged
+once via the engine watchdog, not once per tick.
+
+The clock is injectable so rung tests run on fake time, sleep-free.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..obs import registry as obs_registry
+
+log = logging.getLogger(__name__)
+
+__all__ = ["RUNGS", "DegradationLadder"]
+
+RUNGS = ("normal", "shed", "bucket_downshift", "admission_pause")
+
+
+class DegradationLadder:
+    """Hysteretic escalate/recover state machine over :data:`RUNGS`."""
+
+    def __init__(
+        self,
+        *,
+        escalate_after_s: float = 0.5,
+        recover_after_s: float = 2.0,
+        depth_threshold: int = 2,
+        lag_factor: float = 3.0,
+        clock: Callable[[], float] = time.monotonic,
+        watchdog=None,
+    ):
+        self.escalate_after_s = float(escalate_after_s)
+        self.recover_after_s = float(recover_after_s)
+        self.depth_threshold = int(depth_threshold)
+        self.lag_factor = float(lag_factor)
+        self._clock = clock
+        self._watchdog = watchdog
+        self._lock = threading.Lock()
+        self._rung = 0
+        self._pressure_since: Optional[float] = None
+        self._calm_since: Optional[float] = None
+        #: transition counts by target rung name, for soak artifacts.
+        self.transitions: Dict[str, int] = {}
+        self._m_rung = obs_registry.gauge(
+            "vep_ladder_rung",
+            "Engine degradation ladder rung (0=normal .. 3=admission_pause)",
+        ).labels()
+        self._m_trans = obs_registry.counter(
+            "vep_ladder_transitions_total", "Degradation ladder transitions", ("to",)
+        )
+        self._m_rung.set(0)
+
+    def _to(self, idx: int) -> None:
+        # Caller holds self._lock.
+        name = RUNGS[idx]
+        level = logging.WARNING if idx > self._rung else logging.INFO
+        log.log(level, "degradation ladder: %s -> %s", RUNGS[self._rung], name)
+        self._rung = idx
+        self.transitions[name] = self.transitions.get(name, 0) + 1
+        self._m_rung.set(idx)
+        self._m_trans.labels(name).inc()
+
+    def observe(self, *, queue_depth: int, tick_lag_s: float, tick_budget_s: float) -> str:
+        """Feed one tick's pressure signals; returns the current rung name."""
+        now = self._clock()
+        pressure = (
+            queue_depth >= self.depth_threshold
+            or tick_lag_s > self.lag_factor * tick_budget_s
+        )
+        with self._lock:
+            if pressure:
+                self._calm_since = None
+                if self._pressure_since is None:
+                    self._pressure_since = now
+                elif (
+                    now - self._pressure_since >= self.escalate_after_s
+                    and self._rung < len(RUNGS) - 1
+                ):
+                    self._to(self._rung + 1)
+                    self._pressure_since = now
+            else:
+                self._pressure_since = None
+                if self._rung > 0:
+                    if self._calm_since is None:
+                        self._calm_since = now
+                    elif now - self._calm_since >= self.recover_after_s:
+                        self._to(self._rung - 1)
+                        self._calm_since = now
+                else:
+                    self._calm_since = None
+            rung = self._rung
+        if self._watchdog is not None:
+            # Watchdog opens one "degraded" episode across the whole
+            # excursion and logs recovery when the ladder returns to normal.
+            self._watchdog.check(
+                "engine_degraded",
+                float(rung),
+                above=0.0,
+                detail=f"degradation ladder at '{RUNGS[rung]}'",
+            )
+        return RUNGS[rung]
+
+    @property
+    def rung(self) -> str:
+        with self._lock:
+            return RUNGS[self._rung]
+
+    @property
+    def rung_index(self) -> int:
+        with self._lock:
+            return self._rung
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"rung": RUNGS[self._rung], "transitions": dict(self.transitions)}

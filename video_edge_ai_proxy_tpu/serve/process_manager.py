@@ -51,7 +51,14 @@ log = get_logger("serve.process_manager")
 
 LOG_TAIL_LINES = 100   # reference pulls last 100 container log lines (:296)
 SUPERVISE_INTERVAL_S = 1.0
+# Failing-streak restart backoff (resilience/policy.py): decorrelated
+# jitter growing from RESTART_BACKOFF_S toward RESTART_BACKOFF_MAX_S, so
+# a fleet of workers killed by one upstream outage does not restart in
+# lockstep (the reference delegates this entirely to Docker
+# restart-always, rtsp_process_manager.go:76, which has the same
+# thundering-herd behavior).
 RESTART_BACKOFF_S = 1.0
+RESTART_BACKOFF_MAX_S = 10.0
 
 # preexec_fn runs between fork and exec: nothing there may take locks, so the
 # libc handle (and through it, prctl) must be resolved once at import time in
@@ -345,6 +352,7 @@ class _Entry:
         self.inference_model = ""  # per-stream engine model override
         self.annotation_policy = ""  # per-stream annotation emit override
         self.restart_due = 0.0  # backoff deadline; 0 = not pending
+        self.backoff_s = 0.0  # previous backoff (decorrelated-jitter seed)
 
 
 class ProcessManager:
@@ -397,6 +405,13 @@ class ProcessManager:
         self._nice = nice
         self._entries: dict[str, _Entry] = {}
         self._stopping: set[str] = set()  # mid-stop ids (see stop())
+        # Supervisor restart pacing: next_delay() only — the supervisor
+        # loop owns the clock (backoff is a deadline, not a sleep).
+        from ..resilience.policy import RetryPolicy
+
+        self._restart_policy = RetryPolicy(
+            base_s=RESTART_BACKOFF_S, cap_s=RESTART_BACKOFF_MAX_S
+        )
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._supervisor = threading.Thread(
@@ -954,6 +969,8 @@ class ProcessManager:
                         and now - entry.last_spawn > self.STABLE_AFTER_S
                     ):
                         entry.failing_streak = 0
+                        entry.backoff_s = 0.0  # healthy interval: backoff
+                        # restarts from base on the next failure
                         # Stable again: clear the last-exit cause so
                         # oom_killed stops reporting a long-gone event
                         # (Docker clears OOMKilled on a healthy restart too).
@@ -964,10 +981,13 @@ class ProcessManager:
                     entry.restarting = True
                     entry.last_exit = code
                     # Backoff as a deadline, not a sleep: one flapping camera
-                    # must not delay supervision of the others.
-                    entry.restart_due = now + min(
-                        RESTART_BACKOFF_S * entry.failing_streak, 10.0
+                    # must not delay supervision of the others. Decorrelated
+                    # jitter (RetryPolicy.next_delay) de-synchronizes a
+                    # fleet's restarts after a shared-cause kill.
+                    entry.backoff_s = self._restart_policy.next_delay(
+                        entry.backoff_s or None
                     )
+                    entry.restart_due = now + entry.backoff_s
                     log.warning(
                         "worker %s exited code=%s streak=%d; restart in %.1fs",
                         device_id, code, entry.failing_streak,

@@ -111,9 +111,20 @@ class Server:
             # from log_dir the way the subprocess runner does.
             adopt_workers=self.cfg.worker_adoption,
         )
+        # Dead-letter spool: annotation batches that exhaust their retries
+        # persist under the data dir and re-drain once the uplink heals
+        # (resilience/spool.py) — bounded by spool_max_bytes.
+        from ..resilience import DeadLetterSpool
+
+        spool_dir = self.cfg.annotation.spool_dir or os.path.join(
+            data_dir, "annotation_spool"
+        )
         ann_kwargs = dict(
             handler=make_batch_handler(
-                self.settings, self.cfg.annotation.endpoint
+                self.settings, self.cfg.annotation.endpoint,
+                spool=DeadLetterSpool(
+                    spool_dir, max_bytes=self.cfg.annotation.spool_max_bytes
+                ),
             ),
             max_batch_size=self.cfg.annotation.max_batch_size,
             poll_duration_ms=self.cfg.annotation.poll_duration_ms,

@@ -161,8 +161,10 @@ class RedisAnnotationQueue(AnnotationQueue):
                 # `is not None`: RESP nil ends the list; an EMPTY payload
                 # (b"", falsy) is a legal queued event and must not halt
                 # the sweep with entries still stranded.
+                # unsafe_ok: a resync retry can re-run one RPOPLPUSH; the
+                # queue's documented contract is duplicates over loss.
                 while self._client.command(
-                    "RPOPLPUSH", key, self._ready
+                    "RPOPLPUSH", key, self._ready, unsafe_ok=True
                 ) is not None:
                     n += 1
         except (RespError, IOError) as exc:
@@ -180,13 +182,17 @@ class RedisAnnotationQueue(AnnotationQueue):
         try:
             # LPUSH first and use its reply (the ready length) for the
             # limit check — no pre-flight LLENs on the hot path.
+            # unsafe_ok on the LPUSH/LPOP pair: a resync retry can
+            # duplicate one queued event — tolerated (duplicates over
+            # loss; the cloud POST is idempotent on payload).
             ready_len = int(
-                self._client.command("LPUSH", self._ready, payload)
+                self._client.command("LPUSH", self._ready, payload,
+                                     unsafe_ok=True)
             )
             if ready_len + self._other_depth() > self._unacked_limit:
                 # Over limit: shed from the head — the event just pushed
                 # (or a concurrent publisher's, equally being shed).
-                self._client.command("LPOP", self._ready)
+                self._client.command("LPOP", self._ready, unsafe_ok=True)
                 self.dropped += 1
                 if self.dropped % 100 == 1:
                     log.warning(
@@ -229,9 +235,11 @@ class RedisAnnotationQueue(AnnotationQueue):
             # (command-by-command this is 299 sequential RTTs per batch —
             # slower than the 299/300 ms drain budget on a ~1 ms link).
             # Extra commands past the queue tail return nil, harmlessly.
+            # unsafe_ok: a resync retry re-pops into unacked — events land
+            # in unacked twice at worst (double delivery, never loss).
             replies = self._client.pipeline([
                 ("RPOPLPUSH", self._ready, self._unacked)
-            ] * self._max_batch)
+            ] * self._max_batch, unsafe_ok=True)
             for v in replies:
                 if isinstance(v, (RespError, type(None))):
                     break
@@ -248,9 +256,11 @@ class RedisAnnotationQueue(AnnotationQueue):
             ok = False
         try:
             if ok:
+                # unsafe_ok (here and on reject below): double-applied
+                # bookkeeping at worst re-delivers, never loses.
                 self._client.pipeline([
                     ("LREM", self._unacked, "-1", v) for v in batch
-                ])
+                ], unsafe_ok=True)
                 self.acked += len(batch)
                 return len(batch)
             self.rejected_batches += 1
@@ -262,7 +272,7 @@ class RedisAnnotationQueue(AnnotationQueue):
             for v in batch:
                 cmds.append(("LPUSH", self._rejected_key, v))
                 cmds.append(("LREM", self._unacked, "-1", v))
-            self._client.pipeline(cmds)
+            self._client.pipeline(cmds, unsafe_ok=True)
         except (RespError, IOError) as exc:
             # Whatever we couldn't move stays in unacked; the startup
             # sweep of the next incarnation returns it to ready.
@@ -271,8 +281,9 @@ class RedisAnnotationQueue(AnnotationQueue):
 
     def requeue_rejected(self) -> None:
         try:
+            # unsafe_ok: duplicates over loss (see drain_once).
             while self._client.command(
-                "RPOPLPUSH", self._rejected_key, self._ready
+                "RPOPLPUSH", self._rejected_key, self._ready, unsafe_ok=True
             ) is not None:
                 pass
         except (RespError, IOError) as exc:

@@ -52,6 +52,10 @@ class AnnotationConfig:
     unacked_limit: int = 1000
     poll_duration_ms: int = 300
     max_batch_size: int = 299
+    # Dead-letter spool for batches that exhaust uplink retries
+    # (resilience/spool.py): "" = <data_dir>/annotation_spool.
+    spool_dir: str = ""
+    spool_max_bytes: int = 64 << 20
 
 
 @dataclass
@@ -143,6 +147,18 @@ class EngineConfig:
     # vep_frames_late_total for the stream (obs/watch.py episode checks key
     # off the same number).
     obs_late_ms: float = 1000.0
+    # Overload degradation ladder (resilience/ladder.py): normal -> shed
+    # stale frames -> cap the batch bucket one size down -> pause
+    # admission for half the streams. Driven by drain-queue depth and
+    # tick staleness; escalates after ladder_escalate_after_s of
+    # continuous pressure, recovers one rung per ladder_recover_after_s
+    # pressure-free. False = never degrade (old behavior: latency grows).
+    ladder: bool = True
+    ladder_escalate_after_s: float = 0.5
+    ladder_recover_after_s: float = 2.0
+    # Rung 1 (shed): frames older than this at dispatch are dropped
+    # oldest-first instead of occupying device batch slots.
+    shed_staleness_ms: float = 500.0
 
 
 @dataclass
