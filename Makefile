@@ -2,7 +2,7 @@
 # whole build; ours adds the native bus lib and test/bench shortcuts).
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
-	obs-smoke chaos-smoke
+	obs-smoke chaos-smoke perf-gate
 
 all: proto native
 
@@ -73,6 +73,16 @@ chaos-smoke:
 		--out /tmp/vep_chaos_smoke.json
 	@python -c "import json; d=json.load(open('/tmp/vep_chaos_smoke.json')); \
 		print(json.dumps(d['soak']['resilience'], indent=2))"
+
+# Performance regression gate: run the bench, then compare its JSON line
+# against the committed BENCH_r*.json trajectory (tools/bench_gate.py;
+# fails below best-committed minus 5%). Metric-matched: a non-TPU host
+# emits a *_cpu metric with no committed baseline, which records and
+# passes (first-run semantics) — the target is safe anywhere. A
+# contended dev chip reports instead of flaking (see bench_gate.py).
+perf-gate:
+	python bench.py | tee /tmp/vep_bench_latest.json
+	python tools/bench_gate.py /tmp/vep_bench_latest.json
 
 # One-command genuine-Redis conformance run (VERDICT r3 #8): on any host
 # with redis-server on PATH, re-runs every Redis-plane test against the

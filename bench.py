@@ -206,6 +206,25 @@ def main() -> None:
             "not production-shaped (VERDICT r4 weak #2)"
         )
 
+    # Live MFU attribution (obs/perf.py): cost-analyze the exact serving
+    # program and derive achieved TFLOP/s from the scan-amortized batch
+    # time — the committed cross-check for the engine's live
+    # vep_perf_mfu_pct gauge vs the offline profile_mfu artifacts
+    # (BASELINE.md "Live vs offline MFU" table). Cost analysis may be
+    # unsupported on a backend: report nulls, never fail the bench.
+    from video_edge_ai_proxy_tpu.obs.perf import (
+        DEFAULT_PEAK_TFLOPS, cost_summary, mfu_pct,
+    )
+
+    step_flops = 0.0
+    try:
+        step_flops = cost_summary(
+            jax.jit(one_batch).lower(base_dev).compile()
+        ).get("flops", 0.0)
+    except Exception:
+        pass
+    live_mfu = mfu_pct(step_flops, batch_ms, DEFAULT_PEAK_TFLOPS)
+
     # Golden gate: pinned inputs + pinned weights must reproduce the
     # committed content checksum bit-exactly (replay/goldens.json). A
     # missing golden records the fresh value in the artifact instead of
@@ -223,6 +242,11 @@ def main() -> None:
         "h2d_mbps": round(base.nbytes / 1e6 / h2d_s, 1),
         "e2e_tunnel_ms": round(e2e_ms, 1),
         "fps_64stream_bucket": round(fps64, 1) if fps64 else None,
+        "step_gflop": round(step_flops / 1e9, 2) if step_flops else None,
+        "live_tflops": (round(step_flops / (batch_ms * 1e-3) / 1e12, 2)
+                        if step_flops and batch_ms else None),
+        "live_mfu_pct": round(live_mfu, 2) if live_mfu is not None else None,
+        "peak_tflops": DEFAULT_PEAK_TFLOPS,
         "checksum": total,
         "checksum_key": golden_key,
         "checksum_golden": golden,

@@ -349,3 +349,363 @@ class TestEngineObsSatellites:
         obj = to_chrome_trace(events)
         assert validate_chrome_trace(obj) == []
         tracer.clear()
+
+
+# ---------------------------------------------------------------------------
+# r9: device-performance attribution (obs/perf.py)
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestPerfTracker:
+    def test_compile_and_batch_attribution(self):
+        from video_edge_ai_proxy_tpu.obs.perf import PerfTracker
+
+        reg = Registry()
+        clk = _FakeClock()
+        perf = PerfTracker(registry=reg, peak_tflops=100.0, clock=clk)
+        perf.note_compile("m", (96, 128), 4, 1.5, cost={"flops": 5e9})
+        fam = {f.name: f for f in reg.families()}
+        assert fam["vep_compile_seconds"].labels("m", "96x128", "4").count \
+            == 1
+        assert fam["vep_compile_programs_total"].labels(
+            "m", "96x128", "4").value == 1
+        for _ in range(20):
+            clk.advance(0.01)
+            perf.note_batch("m", (96, 128), 4, 10.0, 3)
+        # 5 GFLOP / 10 ms = 0.5 TFLOP/s = 0.5% of the 100 TF peak.
+        assert fam["vep_perf_mfu_pct"].labels("m", "4").value \
+            == pytest.approx(0.5)
+        assert fam["vep_perf_padded_slots_total"].labels("m", "4").value \
+            == 20
+        assert fam["vep_perf_batch_slots_total"].labels("m", "4").value \
+            == 80
+        assert fam["vep_perf_bucket_occupancy_pct"].labels("m", "4").value \
+            == pytest.approx(75.0)
+        assert perf.fps() > 0
+        snap = perf.snapshot()
+        json.dumps(snap)          # artifact sections must be JSON-able
+        assert snap["compiles"][0]["programs"] == 1
+        b = snap["buckets"][0]
+        assert b["padded_slots"] == 20 and b["frames"] == 60
+        assert b["mfu_pct"] == pytest.approx(0.5)
+        assert lint_exposition(reg.render()) == []
+
+    def test_cost_summary_tolerates_api_shapes(self):
+        from video_edge_ai_proxy_tpu.obs.perf import cost_summary
+
+        class C:
+            def __init__(self, rv):
+                self.rv = rv
+
+            def cost_analysis(self):
+                if isinstance(self.rv, Exception):
+                    raise self.rv
+                return self.rv
+
+        assert cost_summary(C({"flops": 2.0}))["flops"] == 2.0
+        assert cost_summary(C([{"flops": 3.0}]))["flops"] == 3.0
+        assert cost_summary(C([])) == {}
+        assert cost_summary(C(None)) == {}
+        assert cost_summary(C(RuntimeError("unsupported"))) == {}
+
+    def test_mfu_pct_degenerate_inputs(self):
+        from video_edge_ai_proxy_tpu.obs.perf import mfu_pct
+
+        assert mfu_pct(0.0, 10.0, 100.0) is None
+        assert mfu_pct(1e9, 0.0, 100.0) is None
+        assert mfu_pct(1e9, 10.0, 0.0) is None
+        # 1 TFLOP in 10 ms = 100 TF/s = 100% of a 100 TF peak.
+        assert mfu_pct(1e12, 10.0, 100.0) == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------------
+# r9: SLO burn-rate engine (obs/slo.py) under fake clocks
+# ---------------------------------------------------------------------------
+
+
+def _slo(clk, reg, *, objective=0.99, fire=10.0, warmup=0.0,
+         fast=300.0, slow=3600.0):
+    from video_edge_ai_proxy_tpu.obs.slo import BurnRateSLO, SLOSpec
+
+    return BurnRateSLO(
+        SLOSpec(name="t", objective=objective, fire_burn_rate=fire,
+                warmup_s=warmup, fast_window_s=fast, slow_window_s=slow),
+        clock=clk, registry=reg)
+
+
+class TestSLOBurnRate:
+    def test_fast_burn_fires_and_counts_one_episode(self):
+        clk = _FakeClock()
+        slo = _slo(clk, Registry())
+        # 50% bad for 10 minutes: burn 0.5/0.01 = 50 on BOTH windows.
+        for _ in range(60):
+            clk.advance(10.0)
+            slo.record(good=1, bad=1)
+        state = slo.evaluate()
+        assert state["burn"]["fast"] == pytest.approx(50.0)
+        assert state["firing"] and state["episodes"] == 1
+        # staying in burn does not open a second episode
+        clk.advance(10.0)
+        slo.record(good=1, bad=1)
+        assert slo.evaluate()["episodes"] == 1
+
+    def test_slow_burn_holds_fire(self):
+        """A short spike trips the fast window only — no page (the whole
+        point of requiring BOTH windows)."""
+        clk = _FakeClock()
+        slo = _slo(clk, Registry())
+        # 55 minutes of clean traffic, then 4 minutes of 100% bad.
+        for _ in range(330):
+            clk.advance(10.0)
+            slo.record(good=10)
+        for _ in range(24):
+            clk.advance(10.0)
+            slo.record(bad=10)
+        state = slo.evaluate()
+        assert state["burn"]["fast"] > 10.0       # fast window saturated
+        assert state["burn"]["slow"] < 10.0       # diluted by the hour
+        assert not state["firing"]
+
+    def test_recovery_closes_episode_on_fast_window(self):
+        clk = _FakeClock()
+        slo = _slo(clk, Registry())
+        wd = Watchdog()
+        for _ in range(60):
+            clk.advance(10.0)
+            slo.record(bad=1)
+        assert slo.evaluate(wd)["firing"]
+        assert "slo_burn:t" in wd.snapshot()["active"]
+        # 6 minutes of clean traffic pushes the bad burst out of the
+        # fast window; the slow window still remembers it.
+        for _ in range(36):
+            clk.advance(10.0)
+            slo.record(good=1)
+        state = slo.evaluate(wd)
+        assert not state["firing"]
+        assert state["burn"]["slow"] > 10.0
+        assert state["episodes"] == 1
+        assert "slo_burn:t" not in wd.snapshot()["active"]
+        assert wd.snapshot()["episodes"]["slo_burn:t"] == 1
+
+    def test_warmup_gates_firing(self):
+        clk = _FakeClock()
+        slo = _slo(clk, Registry(), warmup=120.0)
+        for _ in range(6):
+            clk.advance(10.0)
+            slo.record(bad=5)
+        assert not slo.evaluate()["firing"]       # 60 s < 120 s warmup
+        for _ in range(7):
+            clk.advance(10.0)
+            slo.record(bad=5)
+        assert slo.evaluate()["firing"]
+
+    def test_empty_windows_report_none(self):
+        clk = _FakeClock()
+        slo = _slo(clk, Registry())
+        state = slo.evaluate()
+        assert state["burn"] == {"fast": None, "slow": None}
+        assert not state["firing"]
+
+    def test_engine_aggregates_and_snapshots(self):
+        from video_edge_ai_proxy_tpu.obs.slo import SLOEngine, default_slos
+
+        clk = _FakeClock()
+        reg = Registry()
+        eng = SLOEngine(default_slos(warmup_s=0.0), clock=clk,
+                        registry=reg)
+        assert eng.names() == ["aggregate_fps", "detect_latency_p50",
+                               "stream_availability"]
+        for _ in range(60):
+            clk.advance(10.0)
+            eng.record("aggregate_fps", bad=1)
+            eng.record("detect_latency_p50", good=1)
+        out = eng.evaluate()
+        assert out["burning"]
+        assert out["slos"]["aggregate_fps"]["firing"]
+        assert not out["slos"]["detect_latency_p50"]["firing"]
+        snap = eng.snapshot()
+        json.dumps(snap)
+        assert snap["burning"] and "aggregate_fps" in snap["slos"]
+        assert lint_exposition(reg.render()) == []
+
+
+# ---------------------------------------------------------------------------
+# r9: engine integration — live attribution, REST surfaces, hot-path bound
+# ---------------------------------------------------------------------------
+
+
+class TestEnginePerfSLO:
+    def _serve_one(self, bus, eng, device_id="cam1"):
+        bus.create_stream(device_id, 32 * 32 * 3)
+        eng.start()
+        try:
+            deadline = time.time() + 30
+            while not eng.stats().get(device_id) and time.time() < deadline:
+                _publish(bus, device_id)
+                time.sleep(0.05)
+        finally:
+            eng.stop()
+        assert eng.stats().get(device_id), "engine never served a frame"
+
+    def test_engine_attributes_compile_and_batches(self, bus):
+        from video_edge_ai_proxy_tpu.obs import registry
+
+        eng = _engine(bus)
+        self._serve_one(bus, eng)
+        snap = eng.perf.snapshot()
+        # The one serving program this run compiled is attributed with a
+        # positive wall time; on the CPU backend XLA cost analysis also
+        # yields FLOPs, which makes the MFU gauge live.
+        assert snap["compiles"], "no compile recorded at the miss site"
+        rec = snap["compiles"][0]
+        assert rec["programs"] >= 1 and rec["compile_s"] > 0
+        assert rec["geometry"] == "32x32"
+        assert snap["buckets"] and snap["buckets"][0]["device_ms_ema"] > 0
+        assert snap["fps"] > 0
+        fam = {f.name: f for f in registry.families()}
+        geo = (rec["model"], rec["geometry"], str(rec["bucket"]))
+        assert fam["vep_compile_seconds"].labels(*geo).count >= 1
+        text = registry.render()
+        assert "vep_compile_seconds" in text
+        assert "vep_perf_padded_slots_total" in text
+        assert "vep_perf_mfu_pct" in text
+        assert lint_exposition(text) == []
+
+    def test_stats_view_carries_device_attribution(self, bus):
+        eng = _engine(bus)
+        self._serve_one(bus, eng)
+        view = eng.stats()["cam1"]
+        assert view.bucket == view.last_batch >= 1
+        assert view.padded_slots >= 0
+        assert view.device_ms_ema > 0
+        d = dataclasses.asdict(view)     # the /api/v1/stats wire shape
+        assert {"bucket", "padded_slots", "device_ms_ema"} <= set(d)
+
+    def test_rest_slo_endpoint_and_metrics_golden(self, bus):
+        """Full REST surface over a served engine: /api/v1/slo returns
+        per-SLO burn + episode state, /api/v1/stats carries the perf/slo
+        obs sections and the new stream fields, and the complete
+        /metrics exposition (engine + perf + slo families) lints clean."""
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        class _PM:
+            def list(self):
+                return []
+
+        eng = _engine(bus)
+        self._serve_one(bus, eng)
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            rest = f"http://127.0.0.1:{srv.bound_port}"
+            with urllib.request.urlopen(rest + "/api/v1/slo") as r:
+                slo = json.loads(r.read())
+            assert set(slo) == {"burning", "slos"}
+            for state in slo["slos"].values():
+                assert {"burn", "firing", "episodes", "objective",
+                        "fire_burn_rate"} <= set(state)
+            assert {"detect_latency_p50", "aggregate_fps",
+                    "stream_availability"} == set(slo["slos"])
+            with urllib.request.urlopen(rest + "/api/v1/stats") as r:
+                stats = json.loads(r.read())
+            cam = stats["engine"]["streams"]["cam1"]
+            assert {"bucket", "padded_slots", "device_ms_ema"} <= set(cam)
+            assert stats["obs"]["perf"]["compiles"]
+            assert "slos" in stats["obs"]["slo"]
+            with urllib.request.urlopen(rest + "/metrics") as r:
+                text = r.read().decode()
+            for fam in ("vep_perf_mfu_pct", "vep_perf_padded_slots_total",
+                        "vep_compile_seconds", "vep_slo_burn_rate",
+                        "vep_slo_firing"):
+                assert fam in text, f"{fam} missing from /metrics"
+            assert lint_exposition(text) == []
+        finally:
+            srv.stop()
+
+    def test_slo_disabled_engine(self, bus):
+        """engine.slo=False: no SLO objects, no ladder input, and the
+        REST endpoint answers 400 instead of crashing."""
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5,
+            slo=False))
+        assert eng.slo is None
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        class _PM:
+            def list(self):
+                return []
+
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            import urllib.error
+            import urllib.request
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.bound_port}/api/v1/slo")
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
+
+
+class TestHotPathAllocationBound:
+    def test_perf_slo_instrumentation_fixed_allocation(self):
+        """r9 guard: with tracing off, the per-tick perf/SLO work
+        (note_batch + SLO record + throttled evaluate) holds a FIXED
+        memory footprint — automated successor to the r7 'within noise'
+        one-off measurement. Warm 2k iterations populate every cache and
+        ring; the next 2k must not grow traced allocations beyond a
+        small bound."""
+        import tracemalloc
+
+        from video_edge_ai_proxy_tpu.obs.perf import PerfTracker
+        from video_edge_ai_proxy_tpu.obs.slo import SLOEngine, default_slos
+
+        reg = Registry()
+        clk = _FakeClock()
+        perf = PerfTracker(registry=reg, clock=clk)
+        perf.note_compile("m", (96, 128), 4, 0.5, cost={"flops": 1e9})
+        slo = SLOEngine(default_slos(warmup_s=0.0), clock=clk,
+                        registry=reg)
+
+        def tick():
+            clk.advance(0.01)
+            perf.note_batch("m", (96, 128), 4, 7.5, 3)
+            slo.record("detect_latency_p50", good=1.0)
+            slo.record("aggregate_fps", bad=1.0)
+            slo.record("stream_availability", good=1.0)
+
+        for _ in range(2000):
+            tick()
+        slo.evaluate()
+        tracemalloc.start()
+        try:
+            base, _ = tracemalloc.get_traced_memory()
+            for i in range(2000):
+                tick()
+                if i % 100 == 0:
+                    slo.evaluate()
+            now, _ = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        growth = now - base
+        assert growth < 64 * 1024, (
+            f"perf/SLO hot path grew {growth} B over 2000 ticks — "
+            "per-tick allocations are no longer bounded")

@@ -386,6 +386,23 @@ class TestDegradationLadder:
         assert "engine_degraded" not in wd.snapshot()["active"]
         assert wd.snapshot()["episodes"]["engine_degraded"] == 1
 
+    def test_slo_burn_is_a_pressure_signal_too(self):
+        """r9: a firing SLO escalates the ladder with NO queue or lag
+        pressure at all — degradation starts shedding load before the
+        queue backs up — and clearing the burn walks it back down."""
+        clk = FakeClock()
+        lad = self.make(clk)
+        burn = lambda b: lad.observe(queue_depth=0, tick_lag_s=0.0,
+                                     tick_budget_s=0.01, slo_burning=b)
+        assert burn(True) == "normal"
+        clk.advance(0.6)
+        assert burn(True) == "shed"
+        # burn cleared: one calm recover window walks back to normal
+        for _ in range(25):
+            rung = burn(False)
+            clk.advance(0.1)
+        assert rung == "normal"
+
 
 class TestRungMechanics:
     """The engine-side primitives each rung applies."""

@@ -1,0 +1,167 @@
+"""Bench regression gate: newest bench.py line vs the committed trajectory.
+
+``bench.py`` prints one JSON line per run; acceptance runs are committed
+as ``BENCH_r*.json`` artifacts (shape: {"n", "cmd", "rc", "tail",
+"parsed": {...bench dict...}}). This tool closes the loop the artifacts
+only documented: it parses the latest bench output (file argument or
+stdin), finds every committed artifact with the SAME ``metric`` string,
+and fails (exit 1) when the new value regresses more than ``--tolerance``
+(default 5%) below the best committed value.
+
+Semantics chosen for unattended CI (``make perf-gate``):
+
+- **Metric-matched only.** A CPU-backend run emits ``*_cpu`` metrics with
+  no committed TPU baseline — the gate reports "no baseline" and passes
+  (first-run semantics), so the target is safe on any host.
+- **Contention-aware.** bench.py flags ``contended_device`` when another
+  process held the chip during the run; such runs gate leniently (warn +
+  pass) unless ``--strict-contended``, because a shared dev chip must not
+  flake CI. Committed artifacts flagged contended are likewise excluded
+  from the baseline.
+- **Best-of-trajectory baseline.** Gating against max(committed) rather
+  than latest(committed) means a slow r(N) acceptance run can never
+  ratchet the bar downward.
+
+Usage:
+  python bench.py | tee /tmp/bench.json && python tools/bench_gate.py /tmp/bench.json
+  python tools/bench_gate.py -            # read bench output from stdin
+  python tools/bench_gate.py out.json --tolerance 0.03
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_bench_output(text: str) -> dict:
+    """Last JSON object line holding a bench dict ({"metric", "value"}).
+    Accepts raw bench.py stdout (progress lines + one JSON line) and
+    artifact-shaped wrappers ({"parsed": {...}})."""
+    best = None
+    # A whole artifact file (pretty-printed JSON) parses in one shot;
+    # bench stdout falls through to the line scan.
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict):
+        if isinstance(obj.get("parsed"), dict):
+            obj = obj["parsed"]
+        if "metric" in obj and "value" in obj:
+            return obj
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and isinstance(obj.get("parsed"), dict):
+            obj = obj["parsed"]
+        if isinstance(obj, dict) and "metric" in obj and "value" in obj:
+            best = obj
+    if best is None:
+        raise SystemExit(
+            "bench_gate: no bench JSON line ({'metric': .., 'value': ..}) "
+            "found in input")
+    return best
+
+
+def load_trajectory(baseline_dir: str) -> list:
+    """Every committed BENCH_r*.json's parsed bench dict, tagged with its
+    artifact name, ordered by artifact name (r01, r02, ...)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(baseline_dir,
+                                              "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = art.get("parsed") if isinstance(art, dict) else None
+        if isinstance(parsed, dict) and "metric" in parsed \
+                and "value" in parsed:
+            parsed = dict(parsed)
+            parsed["_artifact"] = os.path.basename(path)
+            out.append(parsed)
+    return out
+
+
+def gate(current: dict, trajectory: list, tolerance: float,
+         strict_contended: bool = False) -> dict:
+    """Pure decision: returns the report dict; report["pass"] is the
+    verdict (unit-tested without artifacts on disk)."""
+    metric = current["metric"]
+    value = float(current["value"])
+    matched = [t for t in trajectory if t.get("metric") == metric]
+    usable = [t for t in matched if not t.get("contended_device")]
+    report = {
+        "tool": "bench_gate",
+        "metric": metric,
+        "value": value,
+        "tolerance": tolerance,
+        "trajectory": [
+            {"artifact": t.get("_artifact"), "value": t.get("value"),
+             "contended": bool(t.get("contended_device"))}
+            for t in matched
+        ],
+    }
+    if not usable:
+        report.update(passed=True, reason="no committed baseline for "
+                      f"metric {metric!r} (first run records the bar)")
+        return report
+    reference = max(float(t["value"]) for t in usable)
+    floor = reference * (1.0 - tolerance)
+    report.update(reference=reference, floor=round(floor, 1))
+    if current.get("contended_device") and not strict_contended:
+        report.update(passed=True, contended=True,
+                      reason="run flagged contended_device: reported, "
+                      "not gated (--strict-contended to enforce)")
+        return report
+    if value >= floor:
+        report.update(passed=True,
+                      reason=f"{value} >= floor {floor:.1f} "
+                      f"({reference} - {tolerance:.0%})")
+    else:
+        report.update(passed=False,
+                      reason=f"regression: {value} < floor {floor:.1f} "
+                      f"(best committed {reference} - {tolerance:.0%})")
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("input", nargs="?", default="-",
+                    help="bench.py output file, or - for stdin")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed fractional drop below the best "
+                         "committed value (default 0.05 = -5%%)")
+    ap.add_argument("--baseline-dir", default=REPO,
+                    help="directory holding BENCH_r*.json artifacts")
+    ap.add_argument("--strict-contended", action="store_true",
+                    help="gate contended-device runs too (default: "
+                         "report only)")
+    args = ap.parse_args(argv)
+
+    if args.input == "-":
+        text = sys.stdin.read()
+    else:
+        with open(args.input) as f:
+            text = f.read()
+    current = parse_bench_output(text)
+    trajectory = load_trajectory(args.baseline_dir)
+    report = gate(current, trajectory, args.tolerance,
+                  strict_contended=args.strict_contended)
+    print(json.dumps(report, indent=2))
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

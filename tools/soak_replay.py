@@ -189,6 +189,22 @@ def main(argv=None) -> None:
             "pending_batches")},
         "conserved": uplink["conserved"],
     }), flush=True)
+    # r9: device-performance attribution + SLO burn state. Informational
+    # (the artifact's "perf"/"slo" sections carry the full detail): a
+    # long CPU soak may legitimately burn the fps objective — that's the
+    # SLO engine working, not a soak failure.
+    slo = soak.get("slo")
+    print(json.dumps({
+        "leg": "slo",
+        "fps": soak["perf"]["fps"],
+        "compiled_programs": sum(
+            rec["programs"] for rec in soak["perf"]["compiles"]),
+        "burning": slo["burning"] if slo else None,
+        "burn": {name: s["burn"] for name, s in slo["slos"].items()}
+        if slo else None,
+        "episodes": {name: s["episodes"]
+                     for name, s in slo["slos"].items()} if slo else None,
+    }), flush=True)
     # Chaos gates (ISSUE: zero deadlocks, zero lost annotations, bounded
     # subscriber drops). Reaching this line at all is the deadlock gate's
     # first half; a drained uplink is the second.

@@ -41,6 +41,13 @@ class BatchGroup:
                                    # view a pooled buffer under strict
                                    # leasing (Collector.release returns it)
 
+    @property
+    def padded_slots(self) -> int:
+        """Batch slots carrying zero-padding instead of real frames — the
+        per-batch waste obs/perf.py attributes (pad_to_bucket and the
+        pooled fast paths both pad up to ``bucket``)."""
+        return max(0, self.bucket - len(self.device_ids))
+
 
 def pad_to_bucket(group: BatchGroup, buckets: Sequence[int]) -> BatchGroup:
     """Zero-pad the batch dim to the smallest bucket >= N. Oversized batches

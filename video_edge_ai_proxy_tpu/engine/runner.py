@@ -38,6 +38,8 @@ import numpy as np
 
 from ..bus.interface import FrameBus, FrameMeta
 from ..obs import registry as obs_registry, tracer
+from ..obs.perf import PerfTracker
+from ..obs.slo import SLOEngine, default_slos
 from ..obs.watch import Watchdog
 from ..ops.nms import batched_nms
 from ..ops.preprocess import (
@@ -172,6 +174,16 @@ class StreamStats:
     last_latency_ms: float = 0.0
     ema_latency_ms: float = 0.0
     last_batch: int = 0
+    # Per-stream device attribution (r9): padding waste and device time
+    # of the batches that served this stream, so /api/v1/stats can say
+    # which streams ride under-filled (expensive) buckets.
+    padded_slots: int = 0          # zero-padded slots in the last batch
+    device_ms_ema: float = 0.0
+    device_ms_initialized: bool = False
+    # Monotonic time of the last emitted result — the availability-SLO
+    # signal (obs/slo.py): an inferred stream that stops emitting goes
+    # "unavailable" after slo_availability_window_s.
+    last_emit_mono: float = 0.0
     # A first frame CAN legitimately measure 0.0 ms (synthetic sources
     # stamp publish-time wall clock; sub-ms emit rounds to 0) — the seed
     # flag, not the value, decides whether the EMA re-seeds.
@@ -186,6 +198,14 @@ class StreamStats:
             self.ema_latency_ms = latency_ms
             self.ema_initialized = True
 
+    def note_device(self, device_ms: float, padded_slots: int) -> None:
+        self.padded_slots = padded_slots
+        if self.device_ms_initialized:
+            self.device_ms_ema = 0.9 * self.device_ms_ema + 0.1 * device_ms
+        else:
+            self.device_ms_ema = device_ms
+            self.device_ms_initialized = True
+
 
 @dataclass(frozen=True)
 class StreamStatsView:
@@ -198,6 +218,12 @@ class StreamStatsView:
     last_latency_ms: float = 0.0
     ema_latency_ms: float = 0.0
     last_batch: int = 0
+    # r9 per-stream device attribution. `bucket` is the padded size of
+    # the last batch that served the stream (same number last_batch has
+    # always carried, named for the API surface the ISSUE specifies).
+    bucket: int = 0
+    padded_slots: int = 0
+    device_ms_ema: float = 0.0
 
 
 @dataclass
@@ -209,6 +235,60 @@ class _Inflight:
     t_submit: float
     t_collect: float = 0.0    # wall s the collector returned this group
                               # (stage_trace only; 0 when tracing is off)
+
+
+class _TimedStep:
+    """Callable wrapper around a jitted serving step that AOT-compiles on
+    first call, timing the compile wall-clock and capturing XLA cost
+    analysis (FLOPs/bytes) into the engine's :class:`PerfTracker` — the
+    per-cache-miss attribution behind the ``vep_compile_*`` families.
+
+    The jit path stays the source of truth: when ``lower().compile()``
+    is unsupported, or the AOT executable later rejects its inputs
+    (avals drift, e.g. params re-placed onto a mesh), the wrapper
+    permanently falls back to calling the plain jitted function, where
+    jax's own cache handles compilation. Harness wrappers that decorate
+    ``InferenceEngine._step`` (replay/harness.py device-stall fault)
+    keep working: ``_step`` still returns a plain callable.
+    """
+
+    __slots__ = ("_jit", "_aot", "_perf", "_model", "_src_hw", "_bucket")
+
+    def __init__(self, jit_fn, perf: PerfTracker, model: str,
+                 src_hw: tuple, bucket: int):
+        self._jit = jit_fn
+        self._aot = None          # None = not compiled; False = jit path
+        self._perf = perf
+        self._model = model
+        self._src_hw = src_hw
+        self._bucket = bucket
+
+    def __call__(self, variables, frames):
+        if self._aot is None:
+            t0 = time.perf_counter()
+            try:
+                compiled = self._jit.lower(variables, frames).compile()
+            except Exception:
+                # No AOT on this backend/version: time the first jit call
+                # instead (includes one execution — an upper bound, still
+                # the right order of magnitude for compile-storm triage).
+                self._aot = False
+                t0 = time.perf_counter()
+                out = self._jit(variables, frames)
+                self._perf.note_compile(
+                    self._model, self._src_hw, self._bucket,
+                    time.perf_counter() - t0, cost={})
+                return out
+            self._perf.note_compile(
+                self._model, self._src_hw, self._bucket,
+                time.perf_counter() - t0, compiled=compiled)
+            self._aot = compiled
+        if self._aot is not False:
+            try:
+                return self._aot(variables, frames)
+            except Exception:
+                self._aot = False
+        return self._jit(variables, frames)
 
 
 class InferenceEngine:
@@ -365,6 +445,31 @@ class InferenceEngine:
             "Frames shed by the degradation ladder (stale at dispatch)",
         ).labels()
         self._last_tick_dur_s = 0.0
+        # Live device-performance attribution (obs/perf.py): compile
+        # cost per (model, geometry, bucket) fed from _step misses,
+        # per-batch device time / padding waste / MFU fed from _emit.
+        self.perf = PerfTracker(peak_tflops=self._cfg.peak_tflops)
+        # SLO burn-rate engine (obs/slo.py): per-frame latency events
+        # from _emit, per-tick fps + availability samples from the tick
+        # loop; evaluated at most every slo_eval_interval_s. The
+        # aggregate burn verdict feeds the ladder as extra pressure
+        # (cfg.slo_ladder).
+        self.slo: Optional[SLOEngine] = None
+        self._slo_latency = self._slo_fps = self._slo_avail = None
+        self._slo_burning = False
+        self._slo_next_eval = 0.0
+        if self._cfg.slo:
+            self.slo = SLOEngine(
+                default_slos(
+                    latency_ms=self._cfg.slo_latency_ms,
+                    target_fps=self._cfg.slo_target_fps,
+                    warmup_s=self._cfg.slo_warmup_s,
+                ),
+                watchdog=self.watchdog,
+            )
+            self._slo_latency = self.slo.get("detect_latency_p50")
+            self._slo_fps = self.slo.get("aggregate_fps")
+            self._slo_avail = self.slo.get("stream_availability")
 
     # -- lifecycle --
 
@@ -816,6 +921,9 @@ class InferenceEngine:
                 last_latency_ms=st.last_latency_ms,
                 ema_latency_ms=st.ema_latency_ms,
                 last_batch=st.last_batch,
+                bucket=st.last_batch,
+                padded_slots=st.padded_slots,
+                device_ms_ema=st.device_ms_ema,
             )
             for device_id, st in list(self._stats.items())
         }
@@ -960,7 +1068,11 @@ class InferenceEngine:
                     # Dequantize inside the program: XLA fuses int8*scale
                     # into each weight's first consumer, HBM stays int8.
                     return _base(dequantize_tree(qv), frames_u8)
-            fn = jax.jit(raw)
+            # Compile attribution (obs/perf.py): the wrapper AOT-compiles
+            # on first call, recording wall time + XLA cost analysis per
+            # (model, geometry, bucket) — this is the only cache-miss
+            # site, so every compile in the process is accounted.
+            fn = _TimedStep(jax.jit(raw), self.perf, model, src_hw, bucket)
             self._step_cache[key] = fn
         return fn
 
@@ -985,6 +1097,11 @@ class InferenceEngine:
                         queue_depth=self._drain_q.qsize(),
                         tick_lag_s=self._last_tick_dur_s,
                         tick_budget_s=tick_s,
+                        # SLO-level pressure: a sustained multi-window
+                        # budget burn (obs/slo.py) starts shedding before
+                        # queues physically back up.
+                        slo_burning=(self._slo_burning
+                                     and self._cfg.slo_ladder),
                     )
                     self._apply_rung_cap(rung)
                 # One bus enumeration per tick, threaded everywhere.
@@ -1075,7 +1192,7 @@ class InferenceEngine:
             # phase (partition/collect/dispatch) ran, excluding the
             # assembly window that absorbs the remaining budget.
             self._last_tick_dur_s = self.last_tick_monotonic - t0
-            self._watch_tick(tick_s)
+            self._watch_tick(tick_s, inferred)
             try:
                 # Tick remainder = incremental assembly: copy next tick's
                 # frames into their batch slots as they arrive (doorbell-
@@ -1119,10 +1236,12 @@ class InferenceEngine:
                 out.append(kept)
         return out
 
-    def _watch_tick(self, tick_s: float) -> None:
+    def _watch_tick(self, tick_s: float,
+                    inferred: Sequence[str] = ()) -> None:
         """Per-tick watermark checks (obs/watch.py): each warns once per
         episode, so a stalled device or recompile storm surfaces as ONE
-        log line, not one per tick."""
+        log line, not one per tick. Also feeds the per-tick SLO samples
+        (fps, availability) and runs the throttled SLO evaluation."""
         depth = self._drain_q.qsize()
         self._m_drain_depth.set(depth)
         self.watchdog.check(
@@ -1141,6 +1260,35 @@ class InferenceEngine:
             "recompile_storm", self._miss_streak, above=2,
             detail="step-cache miss on 3+ consecutive ticks (shape churn)",
         )
+        if self.slo is not None:
+            self._slo_tick(inferred)
+
+    def _slo_tick(self, inferred: Sequence[str]) -> None:
+        """Per-tick SLO sampling + throttled evaluation (obs/slo.py).
+
+        Only sampled while streams are inferred: an idle engine (no
+        cameras) has no fps/availability objective to miss, so it must
+        never build ladder pressure. Recording is ring index math;
+        the window-scan evaluation runs at most once per
+        slo_eval_interval_s so the tick loop never pays it per tick.
+        """
+        now = time.monotonic()
+        if inferred:
+            if self._cfg.slo_target_fps > 0:
+                good = self.perf.fps() >= self._cfg.slo_target_fps
+                self._slo_fps.record(good=1.0 if good else 0.0,
+                                     bad=0.0 if good else 1.0)
+            window = self._cfg.slo_availability_window_s
+            for device_id in inferred:
+                st = self._stats.get(device_id)
+                if st is None or not st.last_emit_mono:
+                    continue   # never served yet: boot grace, not an SLI
+                ok = now - st.last_emit_mono <= window
+                self._slo_avail.record(good=1.0 if ok else 0.0,
+                                       bad=0.0 if ok else 1.0)
+        if now >= self._slo_next_eval:
+            self._slo_next_eval = now + self._cfg.slo_eval_interval_s
+            self._slo_burning = self.slo.evaluate()["burning"]
 
     def _enqueue_drain(self, inflight: _Inflight) -> None:
         """Hand a dispatched batch to the drain thread. Blocks (in short
@@ -1183,6 +1331,16 @@ class InferenceEngine:
         self._m_device.labels(group.model or self._spec.name).observe(
             device_ms
         )
+        # Per-bucket device attribution (obs/perf.py): device-time
+        # histogram, padded-slot waste, occupancy, live MFU/fps gauges.
+        self.perf.note_batch(
+            group.model or self._spec.name, group.src_hw, group.bucket,
+            device_ms, len(group.device_ids),
+        )
+        slo_latency = (
+            self._slo_latency
+            if self.slo is not None and spec.kind == "detect" else None
+        )
         now_ms = int(t_drained * 1000)
         for i, device_id in enumerate(group.device_ids):
             meta = group.metas[i]
@@ -1221,6 +1379,14 @@ class InferenceEngine:
             st.frames += 1
             st.note_latency(latency)
             st.last_batch = group.bucket
+            st.note_device(device_ms, group.padded_slots)
+            st.last_emit_mono = time.monotonic()
+            if slo_latency is not None and meta.timestamp_ms:
+                # p50 detect-latency SLI: one good/bad event per emitted
+                # detect frame (objective 0.5 == the p50 target).
+                ok = latency <= self._cfg.slo_latency_ms
+                slo_latency.record(good=1.0 if ok else 0.0,
+                                   bad=0.0 if ok else 1.0)
             self._m_frames.labels(device_id).inc()
             self._m_latency.labels(device_id).observe(latency)
             if latency > self._cfg.obs_late_ms:

@@ -1,8 +1,9 @@
 """Observability plane: unified metrics registry, frame-lineage tracing,
-stall/watermark detection (ISSUE r7 tentpole).
+stall/watermark detection (ISSUE r7 tentpole), live device-performance
+attribution and SLO burn-rate evaluation (ISSUE r9 tentpole).
 
 Pure-Python, jax-free, importable from control-plane and worker code alike.
-Three modules:
+Five modules:
 
 - :mod:`metrics` — process-wide counters/gauges/log2-histograms, rendered
   once by ``/metrics`` (Prometheus 0.0.4) and ``/api/v1/stats`` (JSON).
@@ -11,15 +12,30 @@ Three modules:
   export (``tools/obs_export.py``) and ``/api/v1/trace``.
 - :mod:`watch` — threshold-crossing detection (drain backpressure, batch
   occupancy, recompilation storms, frame drops) logged once per episode.
+- :mod:`perf` — XLA compile cost + wall-time per (model, geometry,
+  bucket), per-batch device time, padded-slot waste, live MFU /
+  aggregate-fps gauges (``vep_perf_*`` / ``vep_compile_*``).
+- :mod:`slo` — declarative SLOs (p50 detect latency, aggregate fps,
+  stream availability) with multi-window burn-rate episodes, served at
+  ``/api/v1/slo`` and feeding the resilience degradation ladder.
 """
 
 from .metrics import Registry, registry
+from .perf import PerfTracker, cost_summary, mfu_pct
+from .slo import BurnRateSLO, SLOEngine, SLOSpec, default_slos
 from .spans import SpanRecorder, stage_breakdown, to_chrome_trace, tracer
 from .watch import Watchdog
 
 __all__ = [
     "Registry",
     "registry",
+    "PerfTracker",
+    "cost_summary",
+    "mfu_pct",
+    "BurnRateSLO",
+    "SLOEngine",
+    "SLOSpec",
+    "default_slos",
     "SpanRecorder",
     "stage_breakdown",
     "to_chrome_trace",

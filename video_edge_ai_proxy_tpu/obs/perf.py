@@ -1,0 +1,335 @@
+"""Live device-performance attribution: compile cost, padding waste, MFU.
+
+The reference proxy has no notion of device efficiency at all — its per-
+stream in/out frame counters (reference grpcapi.go:141 stats loop) say
+*whether* frames flow, never *how well the accelerator is used*. On a TPU
+the three quantities that decide "as fast as the hardware allows" are
+(a) what each compiled program costs (XLA cost analysis: FLOPs/bytes),
+(b) how long the device actually spends per batch, and (c) how many batch
+slots carry zero-padding instead of real frames (``pad_to_bucket``,
+engine/collector.py:45). Until r9 those existed only offline
+(tools/profile_mfu.py artifacts like ``MFU_vit_r05.json``); this module
+is the *live* counterpart feeding the r7 registry (obs/metrics.py) so
+``/metrics`` and ``/api/v1/stats`` show, per model+bucket: device ms,
+achieved TFLOPs vs ``peak_tflops``, and % slots wasted to padding
+(MOSAIC / arxiv 2305.03222: spatial multiplexing lives or dies on
+continuous accelerator-utilization accounting).
+
+Design notes:
+
+- **jax-free at import.** ``cost_summary`` takes an already-compiled XLA
+  executable object duck-typed (``.cost_analysis()``), so the control
+  plane imports this without initializing a backend (CLAUDE.md rule).
+- **Fixed-allocation hot path.** ``note_batch`` runs per device batch on
+  the drain thread: child metric handles and EMA cells are cached per
+  (model, bucket) key — after the first batch of a key, the call makes no
+  new long-lived objects (guarded by the tier-1 allocation-bound test in
+  tests/test_obs.py).
+- **Live MFU is a proxy, not a profile.** ``device_ms`` as measured by
+  the engine (runner.py `_emit`) includes drain-queue wait, and on the
+  dev tunnel RPC overhead; the gauge trends with true MFU (BASELINE.md
+  cross-checks it against offline ``profile_mfu`` within ~10% on the
+  lockstep bench) but is not a tracing profile.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Dict, Optional, Tuple
+
+from . import metrics
+
+# v5e bf16 dense peak, single chip — same constant tools/profile_mfu.py
+# uses for the offline artifacts, so live and offline MFU are comparable.
+DEFAULT_PEAK_TFLOPS = 197.0
+
+
+def cost_summary(compiled) -> dict:
+    """FLOPs/bytes from an XLA compiled executable's ``cost_analysis()``.
+
+    Same shape-tolerance as tools/profile_mfu.py: jax versions return a
+    dict, a list of dicts, or raise on backends without cost analysis —
+    normalize all of that to a plain {"flops": .., "bytes_accessed": ..}
+    dict, empty when unavailable (callers treat missing FLOPs as
+    "MFU unknown", never as an error).
+    """
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    out: dict = {}
+    flops = float(cost.get("flops", 0.0) or 0.0)
+    nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
+    if flops > 0.0:
+        out["flops"] = flops
+    if nbytes > 0.0:
+        out["bytes_accessed"] = nbytes
+    return out
+
+
+def mfu_pct(flops: float, device_ms: float,
+            peak_tflops: float) -> Optional[float]:
+    """Model FLOPs utilization: achieved FLOP/s over peak, percent.
+    None when any input is unknown/degenerate rather than a fake 0."""
+    if flops <= 0.0 or device_ms <= 0.0 or peak_tflops <= 0.0:
+        return None
+    achieved = flops / (device_ms * 1e-3)
+    return 100.0 * achieved / (peak_tflops * 1e12)
+
+
+class _RateWindow:
+    """Sliding-window event rate over a bounded deque of (t, n) samples.
+
+    Memory is bounded by ``maxlen``; expired entries are popped on every
+    add, so steady state neither grows nor shrinks — the allocation-bound
+    test measures across this. One sample per device batch (not per
+    frame), so 4096 slots cover >40 s even at 100 batches/s.
+    """
+
+    __slots__ = ("_window_s", "_samples", "_total")
+
+    def __init__(self, window_s: float = 10.0, maxlen: int = 4096):
+        self._window_s = float(window_s)
+        self._samples: Deque[Tuple[float, float]] = collections.deque(
+            maxlen=maxlen)
+        self._total = 0.0
+
+    def add(self, n: float, now: float) -> None:
+        if len(self._samples) == self._samples.maxlen:
+            self._total -= self._samples[0][1]   # about to be evicted
+        self._samples.append((now, float(n)))
+        self._total += n
+        self._expire(now)
+
+    def _expire(self, now: float) -> None:
+        cutoff = now - self._window_s
+        s = self._samples
+        while s and s[0][0] < cutoff:
+            self._total -= s.popleft()[1]
+
+    def rate(self, now: float) -> float:
+        """Events/second over the window (0.0 when empty)."""
+        self._expire(now)
+        if not self._samples:
+            return 0.0
+        span = max(now - self._samples[0][0], 1e-6)
+        # Use the real elapsed span, capped at the window, so the rate is
+        # meaningful immediately after start instead of diluted by the
+        # not-yet-elapsed window remainder.
+        return self._total / min(max(span, 0.5), self._window_s)
+
+
+class _BatchCell:
+    """Per-(model, geometry, bucket) hot-path state: pre-resolved metric
+    children + EMA accumulator, so ``note_batch`` is lookups and float
+    math after the first batch of a key."""
+
+    __slots__ = ("device", "padded", "slots", "occupancy", "mfu", "tflops",
+                 "ema_ms", "ema_init", "frames", "padded_total")
+
+    def __init__(self, device, padded, slots, occupancy, mfu, tflops):
+        self.device = device
+        self.padded = padded
+        self.slots = slots
+        self.occupancy = occupancy
+        self.mfu = mfu
+        self.tflops = tflops
+        self.ema_ms = 0.0
+        self.ema_init = False
+        self.frames = 0
+        self.padded_total = 0
+
+
+class PerfTracker:
+    """Per-engine device-performance attribution feeding the registry.
+
+    ``note_compile`` runs at every step-cache miss (engine/runner.py
+    ``_step``): compile wall time + XLA cost analysis keyed by
+    (model, geometry, bucket). ``note_batch`` runs per drained device
+    batch: device-time histogram, padded-slot waste, occupancy, and the
+    derived live MFU / achieved-TFLOPs / aggregate-fps gauges
+    (``vep_perf_*`` + ``vep_compile_*`` families).
+    """
+
+    def __init__(self, *, peak_tflops: float = DEFAULT_PEAK_TFLOPS,
+                 registry: Optional[metrics.Registry] = None,
+                 clock=time.monotonic, fps_window_s: float = 10.0):
+        reg = registry if registry is not None else metrics.registry
+        self.peak_tflops = float(peak_tflops)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # (model, geometry, bucket) -> compile record
+        self._compiles: Dict[Tuple[str, str, int], dict] = {}
+        # (model, geometry, bucket) -> hot-path cell
+        self._cells: Dict[Tuple[str, str, int], _BatchCell] = {}
+        self._fps = _RateWindow(window_s=fps_window_s)
+
+        self._m_compile_s = reg.histogram(
+            "vep_compile_seconds",
+            "XLA compile wall time per step-cache miss",
+            ("model", "geometry", "bucket"))
+        self._m_compile_programs = reg.counter(
+            "vep_compile_programs_total",
+            "Compiled serving programs per (model, geometry, bucket)",
+            ("model", "geometry", "bucket"))
+        self._m_program_gflop = reg.gauge(
+            "vep_compile_program_gflop",
+            "FLOPs per program execution from XLA cost analysis (GFLOP)",
+            ("model", "geometry", "bucket"))
+        self._m_device = reg.histogram(
+            "vep_perf_device_ms",
+            "Device batch time per bucket (submit->drained; includes "
+            "drain-queue wait)", ("model", "bucket"))
+        self._m_padded = reg.counter(
+            "vep_perf_padded_slots_total",
+            "Batch slots filled with padding, not frames (pad_to_bucket "
+            "waste)", ("model", "bucket"))
+        self._m_slots = reg.counter(
+            "vep_perf_batch_slots_total",
+            "Total batch slots dispatched (real frames + padding)",
+            ("model", "bucket"))
+        self._m_occupancy = reg.gauge(
+            "vep_perf_bucket_occupancy_pct",
+            "Real frames over bucket size, last batch",
+            ("model", "bucket"))
+        self._m_mfu = reg.gauge(
+            "vep_perf_mfu_pct",
+            "Live model-FLOPs utilization vs peak_tflops (EMA device "
+            "time; proxy, see obs/perf.py)", ("model", "bucket"))
+        self._m_tflops = reg.gauge(
+            "vep_perf_achieved_tflops",
+            "Achieved TFLOP/s per batch (EMA device time)",
+            ("model", "bucket"))
+        self._m_peak = reg.gauge(
+            "vep_perf_peak_tflops",
+            "Configured device peak TFLOP/s used for MFU")
+        self._m_peak.set(self.peak_tflops)
+        self._m_fps = reg.gauge(
+            "vep_perf_fps",
+            "Aggregate emitted frames/second (sliding window)")
+
+    # -- compile-time attribution ----------------------------------------
+
+    @staticmethod
+    def _geometry(src_hw: Tuple[int, int]) -> str:
+        return f"{src_hw[0]}x{src_hw[1]}"
+
+    def note_compile(self, model: str, src_hw: Tuple[int, int], bucket: int,
+                     seconds: float, *, compiled=None,
+                     cost: Optional[dict] = None) -> None:
+        """Record one step-cache-miss compile. ``compiled`` (an XLA
+        executable) or a pre-extracted ``cost`` dict supplies FLOPs."""
+        if cost is None:
+            cost = cost_summary(compiled) if compiled is not None else {}
+        geometry = self._geometry(src_hw)
+        key = (model, geometry, bucket)
+        with self._lock:
+            rec = self._compiles.get(key)
+            if rec is None:
+                rec = {"model": model, "geometry": geometry,
+                       "bucket": bucket, "programs": 0,
+                       "compile_s": 0.0, "flops": 0.0,
+                       "bytes_accessed": 0.0}
+                self._compiles[key] = rec
+            rec["programs"] += 1
+            rec["compile_s"] += float(seconds)
+            if cost.get("flops"):
+                rec["flops"] = cost["flops"]
+            if cost.get("bytes_accessed"):
+                rec["bytes_accessed"] = cost["bytes_accessed"]
+        b = str(bucket)
+        self._m_compile_s.labels(model, geometry, b).observe(float(seconds))
+        self._m_compile_programs.labels(model, geometry, b).inc()
+        if cost.get("flops"):
+            self._m_program_gflop.labels(model, geometry, b).set(
+                cost["flops"] / 1e9)
+
+    # -- tick-time attribution -------------------------------------------
+
+    def note_batch(self, model: str, src_hw: Tuple[int, int], bucket: int,
+                   device_ms: float, frames: int) -> None:
+        """Record one drained device batch: ``frames`` real frames in a
+        ``bucket``-slot program that ran for ``device_ms``."""
+        geometry = self._geometry(src_hw)
+        key = (model, geometry, bucket)
+        cell = self._cells.get(key)
+        if cell is None:
+            cell = self._make_cell(key)
+        padded = bucket - frames
+        cell.device.observe(device_ms)
+        if padded > 0:
+            cell.padded.inc(padded)
+        cell.slots.inc(bucket)
+        cell.occupancy.set(100.0 * frames / bucket if bucket else 0.0)
+        if cell.ema_init:
+            cell.ema_ms = 0.9 * cell.ema_ms + 0.1 * device_ms
+        else:
+            cell.ema_ms = device_ms
+            cell.ema_init = True
+        cell.frames += frames
+        cell.padded_total += max(padded, 0)
+        rec = self._compiles.get(key)
+        flops = rec["flops"] if rec is not None else 0.0
+        util = mfu_pct(flops, cell.ema_ms, self.peak_tflops)
+        if util is not None:
+            cell.mfu.set(util)
+            cell.tflops.set(flops / (cell.ema_ms * 1e-3) / 1e12)
+        now = self._clock()
+        self._fps.add(frames, now)
+        self._m_fps.set(self._fps.rate(now))
+
+    def _make_cell(self, key: Tuple[str, str, int]) -> _BatchCell:
+        model, _geometry, bucket = key
+        b = str(bucket)
+        cell = _BatchCell(
+            device=self._m_device.labels(model, b),
+            padded=self._m_padded.labels(model, b),
+            slots=self._m_slots.labels(model, b),
+            occupancy=self._m_occupancy.labels(model, b),
+            mfu=self._m_mfu.labels(model, b),
+            tflops=self._m_tflops.labels(model, b),
+        )
+        with self._lock:
+            return self._cells.setdefault(key, cell)
+
+    def fps(self) -> float:
+        """Aggregate emitted frames/second over the sliding window."""
+        return self._fps.rate(self._clock())
+
+    # -- snapshots --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able attribution summary for /api/v1/stats and the soak
+        artifact's "perf" section."""
+        with self._lock:
+            compiles = [dict(rec) for rec in self._compiles.values()]
+            buckets = []
+            for (model, geometry, bucket), cell in sorted(
+                    self._cells.items()):
+                rec = self._compiles.get((model, geometry, bucket))
+                flops = rec["flops"] if rec is not None else 0.0
+                util = mfu_pct(flops, cell.ema_ms, self.peak_tflops)
+                slots = cell.frames + cell.padded_total
+                buckets.append({
+                    "model": model, "geometry": geometry, "bucket": bucket,
+                    "device_ms_ema": round(cell.ema_ms, 3),
+                    "frames": cell.frames,
+                    "padded_slots": cell.padded_total,
+                    "padded_pct": round(100.0 * cell.padded_total / slots,
+                                        2) if slots else 0.0,
+                    "mfu_pct": round(util, 3) if util is not None else None,
+                })
+        return {
+            "peak_tflops": self.peak_tflops,
+            "fps": round(self.fps(), 1),
+            "compiles": sorted(
+                compiles, key=lambda r: (r["model"], r["geometry"],
+                                         r["bucket"])),
+            "buckets": buckets,
+        }

@@ -513,6 +513,12 @@ def run_fleet_soak(
     tracer.configure(enabled=prev_trace[0], sample_every=prev_trace[1])
     ladder_snapshot = eng.ladder.snapshot() if eng.ladder is not None else None
     shed_frames = eng.shed_frames
+    # r9 attribution snapshots, captured live like the ladder's: compile
+    # cost + device-time/padding/MFU per bucket, and per-SLO burn state
+    # (a >=2x-warmup soak may legitimately fire the fps objective on the
+    # CPU backend — the artifact records it; the chaos gates don't care).
+    perf_section = eng.perf.snapshot()
+    slo_section = eng.slo.snapshot() if eng.slo is not None else None
     eng.stop()
     sink_thread.join(timeout=5)
     inner_bus.close()
@@ -602,6 +608,8 @@ def run_fleet_soak(
         "faults_applied": faults_applied,
         "obs": obs_section,
         "resilience": resilience_section,
+        "perf": perf_section,
+        "slo": slo_section,
     }
 
 
@@ -706,6 +714,11 @@ def run_e2e(
                 "sample_every": tracer.sample_every,
                 "events": len(span_events),
             },
+            "perf": srv.engine.perf.snapshot()
+            if srv.engine is not None else None,
+            "slo": srv.engine.slo.snapshot()
+            if srv.engine is not None and srv.engine.slo is not None
+            else None,
         }
         tracer.configure(enabled=False)
         srv.stop()

@@ -17,8 +17,11 @@ Rungs, in escalation order (each includes the previous):
 4. ``admission_pause``  — pause admission for a deterministic half of
                           the streams; the rest keep their latency SLO.
 
-Pressure is ``queue_depth >= depth_threshold`` (drain backpressure) or
-``tick_lag_s > lag_factor * tick_budget_s`` (tick staleness). The ladder
+Pressure is ``queue_depth >= depth_threshold`` (drain backpressure),
+``tick_lag_s > lag_factor * tick_budget_s`` (tick staleness), or — since
+r9 — ``slo_burning`` (a sustained multi-window SLO budget burn,
+obs/slo.py), so the engine starts shedding while the *user-visible*
+objective degrades, before queues physically back up. The ladder
 escalates one rung after ``escalate_after_s`` of *continuous* pressure
 (the timer restarts at each transition, so reaching rung N takes N
 windows) and recovers one rung per ``recover_after_s`` pressure-free.
@@ -89,12 +92,17 @@ class DegradationLadder:
         self._m_rung.set(idx)
         self._m_trans.labels(name).inc()
 
-    def observe(self, *, queue_depth: int, tick_lag_s: float, tick_budget_s: float) -> str:
-        """Feed one tick's pressure signals; returns the current rung name."""
+    def observe(self, *, queue_depth: int, tick_lag_s: float,
+                tick_budget_s: float, slo_burning: bool = False) -> str:
+        """Feed one tick's pressure signals; returns the current rung name.
+        ``slo_burning`` is the SLO engine's aggregate burn verdict — an
+        SLO-level pressure source ORed with the queue-level ones, subject
+        to the same escalate/recover hysteresis."""
         now = self._clock()
         pressure = (
             queue_depth >= self.depth_threshold
             or tick_lag_s > self.lag_factor * tick_budget_s
+            or slo_burning
         )
         with self._lock:
             if pressure:

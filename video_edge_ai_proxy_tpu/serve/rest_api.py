@@ -191,8 +191,25 @@ def build_app(
                 "sample_every": tracer.sample_every,
                 "streams": tracer.streams(),
             },
+            # r9 device-performance attribution + SLO burn state (the
+            # same objects /api/v1/slo serves, embedded for one-call
+            # dashboards).
+            "perf": engine.perf.snapshot() if engine is not None
+            else None,
+            "slo": engine.slo.snapshot()
+            if engine is not None and engine.slo is not None else None,
         }
         return web.json_response(out)
+
+    async def slo(_request: web.Request) -> web.Response:
+        """Per-SLO burn rates + episode state (obs/slo.py): fast/slow
+        window burn multiples, firing flag, opened-episode counts, and
+        the aggregate `burning` verdict the degradation ladder sees."""
+        if engine is None:
+            return _error(400, "engine not running")
+        if engine.slo is None:
+            return _error(400, "SLO engine disabled (engine.slo config)")
+        return web.json_response(engine.slo.snapshot())
 
     async def trace(request: web.Request) -> web.Response:
         """Live frame-lineage query (obs/spans.py): buffered span events,
@@ -372,6 +389,7 @@ def build_app(
     app.router.add_get("/api/v1/settings", settings_get)
     app.router.add_post("/api/v1/settings", settings_overwrite)
     app.router.add_get("/api/v1/stats", stats)
+    app.router.add_get("/api/v1/slo", slo)
     app.router.add_get("/api/v1/trace", trace)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)

@@ -171,6 +171,18 @@ class Server:
                     self.process_manager.annotation_policy_of
                 ),
             )
+            if self.engine.slo is not None:
+                # One boot line naming the live objectives: operators see
+                # what /api/v1/slo will police without reading config.
+                for name, state in sorted(
+                        self.engine.slo.snapshot()["slos"].items()):
+                    log.info(
+                        "SLO %s: %s (objective %.3g, fire burn > %.3g, "
+                        "windows %gs/%gs)", name, state["description"],
+                        state["objective"], state["fire_burn_rate"],
+                        state["windows_s"]["fast"],
+                        state["windows_s"]["slow"],
+                    )
         self.cron = CronJobs(self.cfg.buffer)
         self._grpc_port = grpc_port if grpc_port is not None else self.cfg.grpc_port
         self._rest_port = rest_port if rest_port is not None else self.cfg.port

@@ -159,6 +159,24 @@ class EngineConfig:
     # Rung 1 (shed): frames older than this at dispatch are dropped
     # oldest-first instead of occupying device batch slots.
     shed_staleness_ms: float = 500.0
+    # Device peak TFLOP/s used for the live MFU gauges (obs/perf.py).
+    # Default is the v5e bf16 dense peak — the same constant the offline
+    # tools/profile_mfu.py artifacts use, so live and offline MFU are
+    # directly comparable (BASELINE.md cross-check table).
+    peak_tflops: float = 197.0
+    # Live SLOs (obs/slo.py): p50 detect latency, aggregate fps, stream
+    # availability, each evaluated as multi-window burn rate (fast 5 m /
+    # slow 1 h). slo_warmup_s gates firing until that much wall time has
+    # been observed (also keeps short CPU test runs from tripping the
+    # fps objective, unreachable off-chip). slo_ladder feeds sustained
+    # burn into the degradation ladder as extra pressure.
+    slo: bool = True
+    slo_latency_ms: float = 40.0
+    slo_target_fps: float = 1000.0
+    slo_warmup_s: float = 60.0
+    slo_availability_window_s: float = 5.0
+    slo_eval_interval_s: float = 1.0
+    slo_ladder: bool = True
 
 
 @dataclass
