@@ -2,7 +2,7 @@
 # whole build; ours adds the native bus lib and test/bench shortcuts).
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
-	obs-smoke chaos-smoke perf-gate
+	obs-smoke chaos-smoke prof-smoke perf-gate
 
 all: proto native
 
@@ -73,6 +73,40 @@ chaos-smoke:
 		--out /tmp/vep_chaos_smoke.json
 	@python -c "import json; d=json.load(open('/tmp/vep_chaos_smoke.json')); \
 		print(json.dumps(d['soak']['resilience'], indent=2))"
+
+# Triggered-profiling smoke: a short chaos soak (CPU backend) with
+# --profile-on-burn armed — the device_stall fault escalates the ladder,
+# which must fire a real bounded jax.profiler capture (hard gate in
+# soak_replay.py: an intact triggered bundle exists on disk). Then merge
+# the newest bundle's device trace with its concurrent lineage-span
+# window into ONE Perfetto timeline (obs_export.py --merge --check) and
+# assert both the host span track and >=1 profiler device track are
+# present. ~1 min.
+prof-smoke:
+	rm -rf /tmp/vep_prof_smoke && mkdir -p /tmp/vep_prof_smoke
+	python tools/soak_replay.py --duration 20 --no-e2e \
+		--faults device_stall --profile-on-burn \
+		--prof-dir /tmp/vep_prof_smoke \
+		--out /tmp/vep_prof_smoke.json
+	@python -c "import os; \
+		d='/tmp/vep_prof_smoke'; \
+		bs=sorted(p for p in os.listdir(d) if os.path.isdir(os.path.join(d,p))); \
+		assert bs, 'no capture bundles in '+d; \
+		print('bundle:', bs[-1]); \
+		open('/tmp/vep_prof_bundle.txt','w').write(os.path.join(d,bs[-1]))"
+	python tools/obs_export.py $$(cat /tmp/vep_prof_bundle.txt) --merge \
+		--check -o /tmp/vep_prof_merged.json
+	@python -c "import json; \
+		t=json.load(open('/tmp/vep_prof_merged.json')); \
+		pids={e['pid'] for e in t['traceEvents'] if 'pid' in e}; \
+		assert 1 in pids, 'host span track (pid 1) missing'; \
+		dev=sorted(p for p in pids if p >= 1000); \
+		assert dev, 'no profiler device track in the merged timeline'; \
+		m=t['metadata']['merge']; \
+		print(json.dumps({'host_events': m['host_events'], \
+			'device_events': m['device_events'], \
+			'device_pids': m['device_pids'], \
+			'clock_anchor': m['anchor']}))"
 
 # Performance regression gate: run the bench, then compare its JSON line
 # against the committed BENCH_r*.json trajectory (tools/bench_gate.py;

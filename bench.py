@@ -240,6 +240,11 @@ def main() -> None:
         "batch_ms": round(batch_ms, 2),
         "frame_ms": round(batch_ms / streams, 3),
         "h2d_mbps": round(base.nbytes / 1e6 / h2d_s, 1),
+        # Bytes each frame ships host->device (uint8 source plane): the
+        # per-frame transfer cost the r10 vep_h2d_* live accounting also
+        # reports, and the number ROADMAP item 5's uint8-shipping /
+        # double-buffering work must shrink or hide.
+        "h2d_bytes_per_frame": base.nbytes // streams,
         "e2e_tunnel_ms": round(e2e_ms, 1),
         "fps_64stream_bucket": round(fps64, 1) if fps64 else None,
         "step_gflop": round(step_flops / 1e9, 2) if step_flops else None,

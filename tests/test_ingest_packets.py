@@ -561,6 +561,62 @@ class TestAudioCarryThrough:
         expect = GOP / FPS * 1000
         assert all(abs(dms - expect) < expect for dms in durs)
 
+    def test_archive_preserves_av_offset_for_bursty_audio(
+        self, fixture_audio_mp4, tmp_path
+    ):
+        """r10 regression: a mic that starts late (or bursty audio absent
+        from the GOP head) must keep its real A/V offset through the
+        archive. The pre-r10 per-stream rebase subtracted each stream's
+        OWN first timestamp, snapping late audio to t=0 — playback heard
+        the mic ~150 ms early. The common-epoch rebase subtracts one
+        shared wall instant from both streams."""
+        from video_edge_ai_proxy_tpu.ingest.archive import (
+            PacketGopSegment, SegmentArchiver,
+        )
+
+        with av.PacketDemuxer(fixture_audio_mp4) as d:
+            info, ainfo = d.info, d.audio_info
+            pkts = []
+            while (pkt := d.read(want_data=True)) is not None:
+                pkts.append(pkt)
+        vtb = info.time_base[0] / info.time_base[1]
+        atb = ainfo.time_base[0] / ainfo.time_base[1]
+
+        def ts(p):
+            return p.dts if p.dts is not None else p.pts
+
+        video = [p for p in pkts if not p.is_audio][:GOP]
+        gop_end_s = ts(video[-1]) * vtb
+        # Bursty mic: drop every audio packet before 0.15 s — the GOP
+        # head has video but no audio, audio joins mid-GOP.
+        audio = [p for p in pkts if p.is_audio
+                 if 0.15 <= ts(p) * atb <= gop_end_s]
+        assert audio, "fixture too short for a late-audio window"
+        offset_in = ts(audio[0]) * atb - ts(video[0]) * vtb
+        assert offset_in > 0.1          # the offset the archive must keep
+
+        seg = PacketGopSegment(
+            device_id="cam", start_ts_ms=0, info=info,
+            packets=video + audio, audio_info=ainfo,
+        )
+        out = str(tmp_path / "bursty.mp4")
+        SegmentArchiver._write_stream_copy(out, seg)
+
+        with av.PacketDemuxer(out) as d2:
+            o_vtb = d2.info.time_base[0] / d2.info.time_base[1]
+            o_atb = d2.audio_info.time_base[0] / d2.audio_info.time_base[1]
+            first_v = first_a = None
+            while (p := d2.read()) is not None:
+                if p.is_audio:
+                    first_a = first_a if first_a is not None else ts(p)
+                else:
+                    first_v = first_v if first_v is not None else ts(p)
+        assert first_v is not None and first_a is not None
+        offset_out = first_a * o_atb - first_v * o_vtb
+        # Preserved to well under one AAC frame (21 ms); the old rebase
+        # collapsed it to ~0.
+        assert offset_out == pytest.approx(offset_in, abs=0.005)
+
     def test_relay_carries_audio_track(self, fixture_audio_mp4, tmp_path):
         """Proxy toggle-on: the relayed stream contains the audio track,
         starts at a VIDEO keyframe, and AAC's all-KEY packets never reset

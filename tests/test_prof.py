@@ -1,0 +1,594 @@
+"""Triggered device profiling (obs/prof.py) + r10 satellites: capture
+bundles, once-per-episode trigger discipline under fake clocks, the
+byte-bounded retention ring, H2D accounting (obs/perf.note_h2d), the
+REST /api/v1/profile surface and its gRPC admin mirror, and the unified
+host/device timeline merge (tools/obs_export.py --merge)."""
+
+import gzip
+import importlib.util
+import json
+import os
+import sys
+import types
+
+import pytest
+
+from video_edge_ai_proxy_tpu.obs.metrics import Registry, lint_exposition
+from video_edge_ai_proxy_tpu.obs.prof import (
+    DEVICE_DIR,
+    MANIFEST,
+    SNAPSHOT,
+    SPANS,
+    Profiler,
+    find_device_trace,
+)
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _StubTracer:
+    """Stands in for the jax device tracer: writes a jax-shaped artifact
+    tree (plugins/profile/<run>/perfetto_trace.json.gz) plus optional
+    filler bytes (retention tests), and advances the fake clocks like a
+    real bounded capture would."""
+
+    def __init__(self, clocks=(), filler_bytes=0, events=None,
+                 fail=False):
+        self.clocks = clocks
+        self.filler_bytes = filler_bytes
+        self.events = events if events is not None else [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "name": "fusion.1", "pid": 7, "tid": 1,
+             "ts": 12.0, "dur": 500.0},
+        ]
+        self.fail = fail
+        self.calls = 0
+
+    def __call__(self, log_dir, ms):
+        self.calls += 1
+        for clk in self.clocks:
+            clk.advance(ms / 1000.0)
+        if self.fail:
+            raise OSError("trace backend exploded")
+        run = os.path.join(log_dir, "plugins", "profile", "run01")
+        os.makedirs(run, exist_ok=True)
+        with gzip.open(
+            os.path.join(run, "perfetto_trace.json.gz"), "wt"
+        ) as f:
+            json.dump({"displayTimeUnit": "ns",
+                       "traceEvents": self.events}, f)
+        if self.filler_bytes:
+            with open(os.path.join(run, "filler.bin"), "wb") as f:
+                f.write(b"\0" * self.filler_bytes)
+
+
+class _SpanSource:
+    def __init__(self, events):
+        self._events = events
+
+    def events(self):
+        return list(self._events)
+
+
+def _prof(tmp_path, **kw):
+    """Profiler under full fake control: fake mono+wall clocks, no-op
+    sleep, stub device tracer, fresh registry, synchronous triggers."""
+    clk = kw.pop("clock", _FakeClock())
+    wall = kw.pop("wall_clock", _FakeClock(t=1.7e9))
+    stub = kw.pop("device_tracer", None)
+    if stub is None:
+        stub = _StubTracer(clocks=(clk, wall))
+    reg = kw.pop("registry", Registry())
+    p = Profiler(
+        str(tmp_path / "ring"),
+        clock=clk, wall_clock=wall, sleep=lambda s: None,
+        device_tracer=stub, registry=reg, async_triggers=False, **kw,
+    )
+    return p, clk, wall, stub, reg
+
+
+class TestCaptureBundle:
+    def test_bundle_contents_and_manifest(self, tmp_path):
+        wall = _FakeClock(t=1.7e9)
+        spans = _SpanSource([
+            {"stream": "cam1", "stage": "device", "frame": 1,
+             "ts": wall.t + 0.05, "dur_ms": 8.0},     # inside window
+            {"stream": "cam1", "stage": "emit", "frame": 0,
+             "ts": wall.t - 50.0},                    # long before
+        ])
+        p, clk, wall, stub, reg = _prof(
+            tmp_path, wall_clock=wall, tracer=spans,
+            snapshot_fn=lambda: {"fps": 42.0},
+        )
+        man = p.capture(100, context={"slo_episode": 3})
+        assert man["trigger"] == "manual" and man["ms"] == 100
+        assert man["error"] is None
+        assert man["slo_episode"] == 3
+        assert man["wall_ms"] == pytest.approx(100.0, abs=1.0)
+        bundle = man["path"]
+        assert os.path.isfile(os.path.join(bundle, MANIFEST))
+        # Device trace located + linked relative to the bundle.
+        assert man["device_trace"] == find_device_trace(bundle)
+        assert man["device_trace"].startswith(DEVICE_DIR)
+        assert os.path.isfile(os.path.join(bundle, man["device_trace"]))
+        # Span window: only events concurrent with the capture.
+        with open(os.path.join(bundle, SPANS)) as f:
+            events = json.load(f)["events"]
+        assert [e["stage"] for e in events] == ["device"]
+        assert man["span_events"] == 1
+        with open(os.path.join(bundle, SNAPSHOT)) as f:
+            assert json.load(f) == {"fps": 42.0}
+        # Recent-manifest list + snapshot surface.
+        assert p.captures()[-1]["bundle"] == man["bundle"]
+        snap = p.snapshot()
+        assert snap["bundles"] == 1 and snap["busy"] is None
+        assert snap["retained_bytes"] > 0
+
+    def test_bad_duration_and_busy(self, tmp_path):
+        p, *_ = _prof(tmp_path, max_ms=1000)
+        with pytest.raises(ValueError):
+            p.capture(0)
+        with pytest.raises(ValueError):
+            p.capture(1001)
+        p._acquire("capture")
+        with pytest.raises(RuntimeError):
+            p.capture(10)
+        p._release()
+        assert p.capture(10)["error"] is None
+
+    def test_device_tracer_failure_is_contained(self, tmp_path):
+        clk, wall = _FakeClock(), _FakeClock(t=1.7e9)
+        stub = _StubTracer(clocks=(clk, wall), fail=True)
+        p, *_ = _prof(tmp_path, clock=clk, wall_clock=wall,
+                      device_tracer=stub)
+        man = p.capture(50)   # must not raise
+        assert "trace backend exploded" in man["error"]
+        assert man["device_trace"] is None
+        assert p.errors == 1
+        # The flag is released: the next capture runs.
+        assert p.capture(50)["bundle"].endswith("manual")
+
+
+class TestTriggerDiscipline:
+    def test_slo_episode_fires_exactly_once(self, tmp_path):
+        p, clk, _, stub, _ = _prof(tmp_path, trigger_min_interval_s=5.0)
+        assert p.poll(episodes=1) == "slo_episode"
+        assert stub.calls == 1
+        # Same episode total: no re-fire, ever.
+        for _ in range(5):
+            clk.advance(10.0)
+            assert p.poll(episodes=1) is None
+        assert stub.calls == 1
+        # A NEW episode past the rate limit fires again.
+        assert p.poll(episodes=2) == "slo_episode"
+        assert stub.calls == 2
+        assert [m["trigger"] for m in p.captures()] == \
+            ["slo_episode", "slo_episode"]
+
+    def test_ladder_escalation_fires_and_respects_rate_limit(
+        self, tmp_path
+    ):
+        p, clk, _, stub, reg = _prof(
+            tmp_path, trigger_min_interval_s=5.0)
+        assert p.poll(rung=1) == "ladder_escalation"
+        assert stub.calls == 1
+        # Escalation within the rate-limit window: suppressed AND the
+        # watermark advances — no stale capture fires later.
+        clk.advance(1.0)
+        assert p.poll(rung=2) is None
+        sup = reg.counter(
+            "vep_prof_suppressed_total", "", ("reason",))
+        assert sup.labels("rate_limit").value == 1
+        clk.advance(10.0)
+        assert p.poll(rung=2) is None      # watermark already at 2
+        assert stub.calls == 1
+        # De-escalate then re-escalate: a fresh excursion, fires again.
+        assert p.poll(rung=0) is None
+        assert p.poll(rung=1) == "ladder_escalation"
+        assert stub.calls == 2
+
+    def test_trigger_kill_switch_and_busy_suppression(self, tmp_path):
+        p, clk, _, stub, reg = _prof(tmp_path, trigger=False)
+        assert p.poll(episodes=1) is None
+        assert stub.calls == 0
+        p2, clk2, _, stub2, reg2 = _prof(tmp_path / "b")
+        p2._acquire("manual")
+        assert p2.poll(episodes=1) is None
+        sup = reg2.counter(
+            "vep_prof_suppressed_total", "", ("reason",))
+        assert sup.labels("busy").value == 1
+        p2._release()
+        clk2.advance(100.0)
+        # The episode's shot was spent while busy — watermark advanced.
+        assert p2.poll(episodes=1) is None
+        assert stub2.calls == 0
+
+    def test_trigger_context_lands_in_manifest(self, tmp_path):
+        p, *_ = _prof(tmp_path)
+        p.poll(episodes=2, context={"slo_episode": 2, "rung": "shed"})
+        man = p.captures()[-1]
+        assert man["slo_episode"] == 2
+        assert man["context"]["reason"] == "slo_episode"
+        assert man["context"]["rung"] == "shed"
+
+
+class TestRetentionRing:
+    def test_evicts_oldest_and_never_exceeds_bound(self, tmp_path):
+        clk, wall = _FakeClock(), _FakeClock(t=1.7e9)
+        stub = _StubTracer(clocks=(clk, wall), filler_bytes=4096)
+        p, *_ , reg = _prof(
+            tmp_path, clock=clk, wall_clock=wall, device_tracer=stub,
+            retention_bytes=10_000, trigger_min_interval_s=0.0,
+        )
+        names = []
+        for _ in range(4):
+            clk.advance(60.0)
+            names.append(p.capture(10)["bundle"])
+        # >4 KiB per bundle against a 10 KB bound: at most 2 survive.
+        kept = [os.path.basename(b) for b in p._bundles()]
+        assert p._retained_bytes() <= 10_000
+        assert names[-1] in kept          # newest survives
+        assert names[0] not in kept       # oldest evicted first
+        assert kept == sorted(kept)
+        evicted = reg.counter("vep_prof_evicted_total", "")
+        assert evicted.value == len(names) - len(kept)
+        gauge = reg.gauge("vep_prof_retained_bytes", "")
+        assert gauge.value == p._retained_bytes()
+
+    def test_seq_resumes_after_restart(self, tmp_path):
+        p, *_ = _prof(tmp_path)
+        p.capture(10)
+        p.capture(10)
+        # New Profiler over the same ring dir (process restart): the
+        # sequence continues, never collides with surviving bundles.
+        p2, *_ = _prof(tmp_path, registry=Registry())
+        man = p2.capture(10)
+        assert man["bundle"].startswith("00000002")
+
+
+class TestH2DAccounting:
+    def test_note_h2d_and_snapshot_section(self):
+        from video_edge_ai_proxy_tpu.obs.perf import PerfTracker
+
+        reg = Registry()
+        perf = PerfTracker(registry=reg, clock=_FakeClock())
+        nbytes = 16 * 96 * 128 * 3
+        perf.note_h2d("yolov8n", 16, nbytes, 0.004)
+        perf.note_h2d("yolov8n", 16, nbytes, 0.006)
+        perf.note_h2d("resnet50", 4, 4 * 96 * 128 * 3, 0.001)
+        h2d = {(r["model"], r["bucket"]): r
+               for r in perf.snapshot()["h2d"]}
+        rec = h2d[("yolov8n", 16)]
+        assert rec["bytes"] == 2 * nbytes and rec["batches"] == 2
+        assert rec["bytes_per_frame"] == nbytes // 16
+        assert rec["mbps"] == pytest.approx(
+            2 * nbytes / 1e6 / 0.01, rel=0.01)
+        assert ("resnet50", 4) in h2d
+        text = reg.render()
+        assert "vep_h2d_bytes" in text and "vep_h2d_seconds" in text
+        assert lint_exposition(text) == []
+
+    def test_engine_dispatch_feeds_h2d(self):
+        """One served frame through a real engine produces a positive
+        vep_h2d byte count matching the padded batch plane."""
+        import time
+
+        import numpy as np
+
+        from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+        from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+        from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+        bus = MemoryFrameBus()
+        try:
+            eng = InferenceEngine(
+                bus,
+                EngineConfig(model="tiny_mobilenet_v2",
+                             batch_buckets=(1, 2), tick_ms=5, prof=False),
+                annotations=AnnotationQueue(handler=lambda batch: True),
+            )
+            eng.warmup()
+            bus.create_stream("cam1", 32 * 32 * 3)
+            eng.start()
+            try:
+                frame = np.full((32, 32, 3), 128, np.uint8)
+                meta = FrameMeta(width=32, height=32, channels=3,
+                                 timestamp_ms=int(time.time() * 1000),
+                                 is_keyframe=True)
+                deadline = time.time() + 30
+                while (not eng.stats().get("cam1")
+                       and time.time() < deadline):
+                    bus.publish("cam1", frame, meta)
+                    time.sleep(0.05)
+            finally:
+                eng.stop()
+            assert eng.stats().get("cam1"), "engine never served a frame"
+            h2d = eng.perf.snapshot()["h2d"]
+            assert h2d, "dispatch recorded no H2D transfer"
+            rec = h2d[0]
+            assert rec["batches"] >= 1 and rec["seconds"] > 0
+            # Padded plane: bucket slots x the 32x32x3 uint8 frame.
+            assert rec["bytes_per_frame"] == 32 * 32 * 3
+        finally:
+            bus.close()
+
+
+class TestProfMetricsExposition:
+    def test_prof_families_lint_clean(self, tmp_path):
+        p, clk, _, _, reg = _prof(tmp_path, trigger_min_interval_s=5.0)
+        p.capture(10)
+        p.poll(episodes=1)                 # fires
+        p.poll(rung=1)                     # rate-limited -> suppressed
+        text = reg.render()
+        for fam in ("vep_prof_captures_total",
+                    "vep_prof_capture_wall_ms",
+                    "vep_prof_retained_bytes",
+                    "vep_prof_evicted_total",
+                    "vep_prof_suppressed_total",
+                    "vep_prof_errors_total"):
+            assert fam in text, f"{fam} missing"
+        assert lint_exposition(text) == []
+
+
+class TestProfRestSurface:
+    @pytest.fixture()
+    def bus(self):
+        from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+
+        b = MemoryFrameBus()
+        yield b
+        b.close()
+
+    class _PM:
+        def list(self):
+            return []
+
+    def _serve(self, eng):
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        srv = RestServer(self._PM(), None, host="127.0.0.1", port=0,
+                         engine=eng)
+        srv.start()
+        return srv
+
+    def test_capture_endpoint_and_stats_section(self, bus, tmp_path):
+        import urllib.error
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5,
+            prof_dir=str(tmp_path / "ring")))
+        assert eng.prof is not None
+        # Stub the device side: REST plumbing under test, not jax.
+        stub = _StubTracer()
+        eng.prof._device_tracer = stub
+        eng.prof._sleep = lambda s: None
+        srv = self._serve(eng)
+        try:
+            rest = f"http://127.0.0.1:{srv.bound_port}"
+            req = urllib.request.Request(
+                rest + "/api/v1/profile?ms=50", method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                man = json.loads(r.read())
+            assert man["ms"] == 50 and man["error"] is None
+            assert man["device_trace"]
+            assert stub.calls == 1
+            # Bad duration -> 400.
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    rest + "/api/v1/profile?ms=0", timeout=10)
+            assert ei.value.code == 400
+            # In-flight capture -> 409.
+            eng.prof._acquire("capture")
+            try:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        rest + "/api/v1/profile?ms=50", timeout=10)
+                assert ei.value.code == 409
+            finally:
+                eng.prof._release()
+            # stats() embeds the prof snapshot with the manifest list.
+            with urllib.request.urlopen(
+                    rest + "/api/v1/stats", timeout=10) as r:
+                stats = json.loads(r.read())
+            prof = stats["obs"]["prof"]
+            assert prof["bundles"] == 1
+            assert prof["captures"][0]["bundle"] == man["bundle"]
+        finally:
+            srv.stop()
+
+    def test_disabled_prof_answers_400(self, bus):
+        import urllib.error
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5,
+            prof=False))
+        assert eng.prof is None
+        with pytest.raises(RuntimeError):
+            eng.start_profile("/tmp/nowhere")
+        srv = self._serve(eng)
+        try:
+            rest = f"http://127.0.0.1:{srv.bound_port}"
+            for path, method in (
+                ("/api/v1/profile?ms=50", "POST"),
+                ("/api/v1/profile/start", "POST"),
+                ("/api/v1/profile/stop", "POST"),
+            ):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        urllib.request.Request(
+                            rest + path, method=method),
+                        timeout=10)
+                assert ei.value.code == 400, path
+        finally:
+            srv.stop()
+
+
+class TestGrpcAdminMirror:
+    def _server(self, engine):
+        from concurrent import futures
+
+        import grpc
+
+        from video_edge_ai_proxy_tpu.serve.server import make_admin_handler
+
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        server.add_generic_rpc_handlers((make_admin_handler(engine),))
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        return server, port
+
+    def _call(self, port, payload):
+        import grpc
+
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            rpc = ch.unary_unary(
+                "/vep.Admin/ProfileCapture",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            return rpc(payload, timeout=10)
+
+    def test_capture_via_grpc(self, tmp_path):
+        import grpc
+
+        p, *_ = _prof(tmp_path)
+        engine = types.SimpleNamespace(prof=p)
+        server, port = self._server(engine)
+        try:
+            man = json.loads(self._call(port, b'{"ms": 50}'))
+            assert man["ms"] == 50 and man["context"]["via"] == "grpc"
+            with pytest.raises(grpc.RpcError) as ei:
+                self._call(port, b'{"ms": 0}')
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            with pytest.raises(grpc.RpcError) as ei:
+                self._call(port, b"not json")
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            p._acquire("capture")
+            try:
+                with pytest.raises(grpc.RpcError) as ei:
+                    self._call(port, b'{"ms": 50}')
+                assert ei.value.code() == grpc.StatusCode.ABORTED
+            finally:
+                p._release()
+        finally:
+            server.stop(grace=None)
+
+    def test_disabled_prof_failed_precondition(self):
+        import grpc
+
+        server, port = self._server(types.SimpleNamespace(prof=None))
+        try:
+            with pytest.raises(grpc.RpcError) as ei:
+                self._call(port, b'{"ms": 50}')
+            assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        finally:
+            server.stop(grace=None)
+
+
+def _load_obs_export():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "obs_export.py")
+    spec = importlib.util.spec_from_file_location("vep_obs_export", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("vep_obs_export", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTimelineMerge:
+    def _bundle(self, tmp_path, with_device_span=True):
+        """Synthetic capture bundle: wall-epoch spans + a relative-clock
+        jax perfetto trace, exactly the two timebases --merge aligns."""
+        wall = 1.7e9
+        spans = [
+            {"stream": "cam1", "stage": "device", "frame": 1,
+             "ts": wall + 0.110, "dur_ms": 10.0},
+            {"stream": "cam1", "stage": "emit", "frame": 1,
+             "ts": wall + 0.112},
+        ] if with_device_span else [
+            {"stream": "cam1", "stage": "emit", "frame": 1,
+             "ts": wall + 0.112},
+        ]
+        stub = _StubTracer(events=[
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "name": "fusion.1", "pid": 7, "tid": 1,
+             "ts": 40.0, "dur": 5000.0},
+            {"ph": "X", "name": "copy.2", "pid": 8, "tid": 1,
+             "ts": 100.0, "dur": 200.0},
+        ])
+        p, clk, wclk, _, _ = _prof(
+            tmp_path, wall_clock=_FakeClock(t=wall),
+            device_tracer=stub, tracer=_SpanSource(spans))
+        return p.capture(200)
+
+    def test_merge_bundle_aligns_clocks(self, tmp_path):
+        mod = _load_obs_export()
+        man = self._bundle(tmp_path)
+        events, device, manifest = mod.load_bundle(man["path"])
+        merged = mod.merge_traces(events, device,
+                                  t_start=manifest["t_start"])
+        from video_edge_ai_proxy_tpu.obs.spans import (
+            validate_chrome_trace,
+        )
+
+        assert validate_chrome_trace(merged) == []
+        pids = {e["pid"] for e in merged["traceEvents"] if "pid" in e}
+        assert 1 in pids                       # host span track
+        assert {q for q in pids if q >= 1000}  # device track(s)
+        meta = merged["metadata"]["merge"]
+        assert meta["anchor"] == "device_span"
+        assert meta["device_pids"] == 2
+        # Clock alignment: the earliest device X event lands at the host
+        # device-span start (offset = span_start_us - min_jax_ts).
+        span_start_us = (1.7e9 + 0.110) * 1e6 - 10_000.0
+        jax_min = min(
+            e["ts"] for e in merged["traceEvents"]
+            if e.get("pid", 0) >= 1000 and e["ph"] != "M")
+        assert jax_min == pytest.approx(span_start_us, abs=0.5)
+
+    def test_merge_falls_back_to_manifest_epoch(self, tmp_path):
+        mod = _load_obs_export()
+        man = self._bundle(tmp_path, with_device_span=False)
+        events, device, manifest = mod.load_bundle(man["path"])
+        merged = mod.merge_traces(events, device,
+                                  t_start=manifest["t_start"])
+        assert merged["metadata"]["merge"]["anchor"] == \
+            "manifest_t_start"
+        jax_min = min(
+            e["ts"] for e in merged["traceEvents"]
+            if e.get("pid", 0) >= 1000 and e["ph"] != "M")
+        assert jax_min == pytest.approx(
+            manifest["t_start"] * 1e6, abs=0.5)
+
+    def test_merge_cli_end_to_end(self, tmp_path, capsys):
+        mod = _load_obs_export()
+        man = self._bundle(tmp_path)
+        out = str(tmp_path / "merged.json")
+        mod.main([man["path"], "--merge", "--check", "-o", out])
+        printed = json.loads(capsys.readouterr().out.strip())
+        assert printed["check"] == "ok"
+        with open(out) as f:
+            merged = json.load(f)
+        assert merged["metadata"]["merge"]["host_events"] > 0
+        assert merged["metadata"]["merge"]["device_events"] > 0

@@ -16,13 +16,32 @@ Modes::
   python tools/obs_export.py spans.json --breakdown      # per-leg table
   curl -s :8080/api/v1/trace | python tools/obs_export.py - -o trace.json
 
-``--check`` schema-validates the (converted) trace and exits nonzero on
-problems — ``make obs-smoke`` gates on it. Pure Python, no jax.
+  # r10 unified timeline: merge a profile capture bundle (obs/prof.py —
+  # device trace + concurrent lineage spans) into ONE Perfetto JSON with
+  # the host spans and the jax.profiler device tracks on a shared clock:
+  python tools/obs_export.py /data/prof/00000001_slo_episode --merge -o m.json
+  # or spans + a raw jax perfetto trace captured separately:
+  python tools/obs_export.py spans.json --merge \
+      --device-trace plugins/profile/run/perfetto_trace.json.gz -o m.json
+
+``--check`` schema-validates the (converted/merged) trace and exits
+nonzero on problems — ``make obs-smoke`` / ``make prof-smoke`` gate on
+it. Pure Python, no jax.
+
+Clock alignment: jax.profiler timestamps are microseconds relative to
+trace start, span timestamps are wall-clock epoch. The merge estimates
+the offset from the earliest host-side *device-stage* span inside the
+capture window (that span brackets the device work the profiler saw);
+when the window caught no device span it falls back to aligning trace
+start with the bundle manifest's ``t_start``. Good to roughly one
+host-stage duration — enough to eyeball which device ops a slow span
+covers, not for sub-ms causality.
 """
 
 from __future__ import annotations
 
 import argparse
+import gzip
 import json
 import os
 import sys
@@ -52,6 +71,106 @@ def load_events(obj):
         "({'traceEvents': [...]})")
 
 
+def _load_json_maybe_gz(path: str):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def load_bundle(bundle_dir: str):
+    """Read an obs/prof.py capture bundle -> (span_events, device_trace,
+    manifest). Raises SystemExit with a readable message on a dir that
+    is not a bundle or a bundle whose capture errored out."""
+    from video_edge_ai_proxy_tpu.obs import prof
+
+    man_path = os.path.join(bundle_dir, prof.MANIFEST)
+    if not os.path.isfile(man_path):
+        raise SystemExit(f"{bundle_dir}: no {prof.MANIFEST} (not a "
+                         "profile capture bundle)")
+    with open(man_path) as f:
+        manifest = json.load(f)
+    with open(os.path.join(bundle_dir, prof.SPANS)) as f:
+        span_events = json.load(f).get("events", [])
+    rel = manifest.get("device_trace") or prof.find_device_trace(bundle_dir)
+    if not rel:
+        raise SystemExit(
+            f"{bundle_dir}: no device trace in the bundle "
+            f"(capture error: {manifest.get('error')!r})")
+    device = _load_json_maybe_gz(os.path.join(bundle_dir, rel))
+    return span_events, device, manifest
+
+
+def merge_traces(span_events, device_trace, t_start=None) -> dict:
+    """Fuse host lineage spans + a jax.profiler Perfetto/Chrome trace
+    into one trace object on the span (wall-clock epoch µs) timeline.
+
+    Host spans keep pid 1 (to_chrome_trace); every device-trace pid is
+    remapped to 1000+ so the process tracks can never collide. Device
+    event timestamps are shifted by the estimated clock offset (module
+    docstring). Device events missing required Chrome-trace fields are
+    dropped rather than failing --check: jax owns that file's contents,
+    and one exotic event must not sink the merge.
+    """
+    host = to_chrome_trace(span_events)["traceEvents"]
+    dev_events = (device_trace or {}).get("traceEvents") or []
+
+    # Earliest host device-stage span START (µs epoch): the host-side
+    # bracket around the device work the profiler captured.
+    anchor_us = None
+    for ev in span_events:
+        if ev.get("stage") == "device" and ev.get("dur_ms") is not None:
+            start = ev["ts"] * 1e6 - float(ev["dur_ms"]) * 1000.0
+            anchor_us = start if anchor_us is None else min(anchor_us, start)
+    jax_t0 = None
+    for ev in dev_events:
+        ts = ev.get("ts")
+        if ev.get("ph") != "M" and isinstance(ts, (int, float)):
+            jax_t0 = ts if jax_t0 is None else min(jax_t0, ts)
+    if anchor_us is not None and jax_t0 is not None:
+        offset = anchor_us - jax_t0
+    elif t_start is not None and jax_t0 is not None:
+        offset = t_start * 1e6 - jax_t0
+    else:
+        offset = 0.0
+
+    pid_map: dict = {}
+    merged = list(host)
+    for ev in dev_events:
+        ev = dict(ev)
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or not ph or "name" not in ev:
+            continue
+        raw_pid = ev.get("pid", 0)
+        if not isinstance(raw_pid, (int, float)):
+            raw_pid = 0
+        if raw_pid not in pid_map:
+            pid_map[raw_pid] = 1000 + len(pid_map)
+        ev["pid"] = pid_map[raw_pid]
+        if ph != "M":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            ev["ts"] = round(ts + offset, 3)
+            if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
+                ev["dur"] = 0.0
+        merged.append(ev)
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "merge": {
+                "clock_offset_us": round(offset, 3),
+                "anchor": ("device_span" if anchor_us is not None
+                           else "manifest_t_start" if t_start is not None
+                           else "none"),
+                "host_events": len(host),
+                "device_events": len(merged) - len(host),
+                "device_pids": len(pid_map),
+            },
+        },
+    }
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("input", help="input JSON path, or - for stdin")
@@ -62,16 +181,45 @@ def main(argv=None) -> None:
     ap.add_argument("--breakdown", action="store_true",
                     help="print the per-leg latency breakdown (needs span "
                          "events, not an already-converted trace)")
+    ap.add_argument("--merge", action="store_true",
+                    help="unified timeline: input is a profile capture "
+                         "bundle dir (obs/prof.py) or a spans file used "
+                         "with --device-trace; output fuses host spans + "
+                         "jax device tracks on one clock")
+    ap.add_argument("--device-trace", default="",
+                    help="jax perfetto/Chrome trace (.json or .json.gz) "
+                         "to merge when the input is a spans file, not a "
+                         "bundle dir")
     args = ap.parse_args(argv)
 
-    if args.input == "-":
-        obj = json.load(sys.stdin)
+    if args.merge:
+        if args.input != "-" and os.path.isdir(args.input):
+            events, device, manifest = load_bundle(args.input)
+            t_start = manifest.get("t_start")
+        else:
+            if not args.device_trace:
+                raise SystemExit(
+                    "--merge with a spans file needs --device-trace "
+                    "(or pass a bundle directory)")
+            obj = (json.load(sys.stdin) if args.input == "-"
+                   else _load_json_maybe_gz(args.input))
+            events, _ready = load_events(obj)
+            if events is None:
+                raise SystemExit(
+                    "--merge needs span events on the host side, got an "
+                    "already-converted Chrome trace")
+            device = _load_json_maybe_gz(args.device_trace)
+            t_start = None
+        trace = merge_traces(events, device, t_start=t_start)
     else:
-        with open(args.input) as f:
-            obj = json.load(f)
-    events, trace = load_events(obj)
-    if trace is None:
-        trace = to_chrome_trace(events)
+        if args.input == "-":
+            obj = json.load(sys.stdin)
+        else:
+            with open(args.input) as f:
+                obj = json.load(f)
+        events, trace = load_events(obj)
+        if trace is None:
+            trace = to_chrome_trace(events)
 
     if args.breakdown:
         if events is None:
@@ -86,6 +234,9 @@ def main(argv=None) -> None:
             f.write("\n")
 
     n = len(trace.get("traceEvents") or [])
+    summary = {"events": n, "out": args.out or None}
+    if args.merge:
+        summary["merge"] = trace.get("metadata", {}).get("merge")
     if args.check:
         problems = validate_chrome_trace(trace)
         if problems:
@@ -94,10 +245,9 @@ def main(argv=None) -> None:
             raise SystemExit(
                 f"trace check FAILED: {len(problems)} problem(s) "
                 f"in {n} events")
-        print(json.dumps({"check": "ok", "events": n,
-                          "out": args.out or None}))
+        print(json.dumps({"check": "ok", **summary}))
     elif not args.breakdown:
-        print(json.dumps({"events": n, "out": args.out or None}))
+        print(json.dumps(summary))
 
 
 if __name__ == "__main__":

@@ -85,6 +85,17 @@ def main(argv=None) -> None:
                          "soak (uplink_down, bus_flap, device_stall), "
                          "scheduled in disjoint windows; omitted = the "
                          "default churn plan")
+    ap.add_argument("--profile-on-burn", action="store_true",
+                    help="arm obs/prof.py burn-triggered captures in the "
+                         "soak engine (soak-scale trigger knobs) and "
+                         "HARD-GATE that at least one triggered capture "
+                         "bundle exists on disk when faults fired — the "
+                         "'profile the excursion in the act' acceptance "
+                         "check (make prof-smoke)")
+    ap.add_argument("--prof-dir", default="",
+                    help="retention-ring directory for --profile-on-burn "
+                         "bundles (default: a fresh temp dir; printed in "
+                         "the prof leg)")
     args = ap.parse_args(argv)
 
     import jax
@@ -156,7 +167,9 @@ def main(argv=None) -> None:
                      f"omitted")
         fault_plan = FaultPlan.resilience(args.duration, kinds=kinds)
     soak = run_fleet_soak(duration_s=args.duration, src_hw=(h, w),
-                          fault_plan=fault_plan)
+                          fault_plan=fault_plan,
+                          profile_on_burn=args.profile_on_burn,
+                          prof_dir=args.prof_dir or None)
     artifact["soak"] = soak
     print(json.dumps({
         "leg": "soak",
@@ -205,6 +218,47 @@ def main(argv=None) -> None:
         "episodes": {name: s["episodes"]
                      for name, s in slo["slos"].items()} if slo else None,
     }), flush=True)
+    # r10: burn-triggered profiling. The gate is the acceptance check —
+    # when faults fired with --profile-on-burn, at least one TRIGGERED
+    # capture bundle must exist on disk with its device trace, span
+    # window and snapshot all linked from the manifest ("profile the
+    # excursion, not the average" — merge it with obs_export.py --merge).
+    if args.profile_on_burn:
+        prof = soak.get("prof") or {}
+        triggered = [
+            m for m in prof.get("captures", [])
+            if m.get("trigger") in ("slo_episode", "ladder_escalation")
+        ]
+        print(json.dumps({
+            "leg": "prof",
+            "dir": prof.get("dir"),
+            "bundles": prof.get("bundles"),
+            "retained_bytes": prof.get("retained_bytes"),
+            "errors": prof.get("errors"),
+            "triggered_captures": [
+                {k: m.get(k) for k in (
+                    "bundle", "trigger", "wall_ms", "span_events",
+                    "slo_episode", "error")}
+                for m in triggered
+            ],
+        }), flush=True)
+        if soak["faults_applied"]:
+            ok = [
+                m for m in triggered
+                if m.get("error") is None
+                and m.get("device_trace")
+                and os.path.isfile(os.path.join(m["path"], "manifest.json"))
+                and os.path.isfile(
+                    os.path.join(m["path"], m["device_trace"]))
+                and os.path.isfile(os.path.join(m["path"], m["spans"]))
+            ]
+            if not ok:
+                raise SystemExit(
+                    "prof failure: faults fired but no intact "
+                    "burn-triggered capture bundle exists (triggered="
+                    f"{len(triggered)}, errors={prof.get('errors')}, "
+                    f"dir={prof.get('dir')}) — the excursion went "
+                    "unprofiled")
     # Chaos gates (ISSUE: zero deadlocks, zero lost annotations, bounded
     # subscriber drops). Reaching this line at all is the deadlock gate's
     # first half; a drained uplink is the second.

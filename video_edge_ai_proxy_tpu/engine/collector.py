@@ -48,6 +48,15 @@ class BatchGroup:
         pooled fast paths both pad up to ``bucket``)."""
         return max(0, self.bucket - len(self.device_ids))
 
+    @property
+    def nbytes(self) -> int:
+        """Bytes this batch ships host->device when dispatched: the
+        whole padded frame plane, padding slots included (they cross the
+        PCIe/ICI link like real frames). Feeds the vep_h2d_* accounting
+        in obs/perf.py — the evidence gate for ROADMAP item 5's
+        uint8-shipping / double-buffered H2D work."""
+        return int(self.frames.nbytes)
+
 
 def pad_to_bucket(group: BatchGroup, buckets: Sequence[int]) -> BatchGroup:
     """Zero-pad the batch dim to the smallest bucket >= N. Oversized batches

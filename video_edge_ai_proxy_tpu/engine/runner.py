@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import os
 import queue
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -39,6 +40,7 @@ import numpy as np
 from ..bus.interface import FrameBus, FrameMeta
 from ..obs import registry as obs_registry, tracer
 from ..obs.perf import PerfTracker
+from ..obs.prof import Profiler
 from ..obs.slo import SLOEngine, default_slos
 from ..obs.watch import Watchdog
 from ..ops.nms import batched_nms
@@ -357,8 +359,6 @@ class InferenceEngine:
         # _emit mutates tracker/annotation state from the drain thread
         # while the tick loop GCs the same dicts — one lock covers both.
         self._state_lock = threading.Lock()
-        self._profiling = False
-        self._profile_lock = threading.Lock()
         self.ticks = 0
         self.batches = 0
         self.last_tick_monotonic = 0.0
@@ -457,6 +457,7 @@ class InferenceEngine:
         self.slo: Optional[SLOEngine] = None
         self._slo_latency = self._slo_fps = self._slo_avail = None
         self._slo_burning = False
+        self._slo_episodes = 0
         self._slo_next_eval = 0.0
         if self._cfg.slo:
             self.slo = SLOEngine(
@@ -470,6 +471,24 @@ class InferenceEngine:
             self._slo_latency = self.slo.get("detect_latency_p50")
             self._slo_fps = self.slo.get("aggregate_fps")
             self._slo_avail = self.slo.get("stream_availability")
+        # Triggered device profiling (obs/prof.py): bounded jax.profiler
+        # captures on demand (REST/gRPC) or fired once per SLO episode /
+        # ladder escalation from _watch_tick. cfg.prof=False disables the
+        # subsystem entirely (the REST endpoint answers 400).
+        self.prof: Optional[Profiler] = None
+        if self._cfg.prof:
+            self.prof = Profiler(
+                self._cfg.prof_dir
+                or os.path.join(tempfile.gettempdir(), "vep_prof"),
+                retention_bytes=self._cfg.prof_retention_bytes,
+                trigger=self._cfg.prof_trigger,
+                trigger_ms=self._cfg.prof_trigger_ms,
+                trigger_min_interval_s=(
+                    self._cfg.prof_trigger_min_interval_s),
+                max_ms=self._cfg.prof_max_ms,
+                tracer=tracer,
+                snapshot_fn=self._prof_snapshot,
+            )
 
     # -- lifecycle --
 
@@ -751,29 +770,38 @@ class InferenceEngine:
 
     # -- profiling (SURVEY.md §5.1: the reference has no tracing at all) --
 
-    def start_profile(self, log_dir: str) -> None:
-        """Begin a jax.profiler trace (view with TensorBoard/XProf)."""
-        import jax
+    def _prof_snapshot(self) -> dict:
+        """Engine state frozen into every capture bundle (obs/prof.py):
+        the perf/SLO numbers that were true while the trace ran."""
+        snap = {
+            "ticks": self.ticks,
+            "batches": self.batches,
+            "perf": self.perf.snapshot(),
+        }
+        if self.slo is not None:
+            snap["slo"] = self.slo.snapshot()
+        if self.ladder is not None:
+            snap["rung"] = self.ladder.rung
+        return snap
 
-        with self._profile_lock:
-            if self._profiling:
-                raise RuntimeError("profiler already running")
-            jax.profiler.start_trace(log_dir)
-            self._profiling = True
-        log.info("profiler tracing to %s", log_dir)
+    def start_profile(self, log_dir: str) -> None:
+        """Begin an unbounded jax.profiler trace.
+
+        Deprecated: thin delegate kept for signature compatibility; the
+        capture path lives in obs/prof.py (``self.prof``), which shares
+        one busy flag with the bounded ``/api/v1/profile?ms=N`` captures
+        and the burn triggers. Prefer ``self.prof.capture(ms)``.
+        """
+        if self.prof is None:
+            raise RuntimeError("profiling disabled (engine.prof=False)")
+        self.prof.start(log_dir)
 
     def stop_profile(self) -> None:
-        import jax
-
-        with self._profile_lock:
-            if not self._profiling:
-                raise RuntimeError("profiler not running")
-            # stop_trace flushes to disk and can raise (e.g. unwritable
-            # log_dir); jax's session is torn down either way, so clear the
-            # flag first or the profiler API wedges until restart.
-            self._profiling = False
-            jax.profiler.stop_trace()
-        log.info("profiler trace stopped")
+        """Stop the trace begun by :meth:`start_profile` (deprecated
+        delegate; see start_profile)."""
+        if self.prof is None:
+            raise RuntimeError("profiling disabled (engine.prof=False)")
+        self.prof.stop()
 
     def save_checkpoint(self, path: Optional[str] = None) -> str:
         """Persist current params (msgpack, atomic)."""
@@ -1132,7 +1160,23 @@ class InferenceEngine:
                         _, _, variables = self._ensure_model(
                             group.model or self._spec.name
                         )
-                        outputs = step(variables, self._place(group.frames))
+                        # H2D accounting (ROADMAP item 5 evidence): bytes
+                        # shipped per dispatched batch (padded uint8 frame
+                        # plane) and the wall time of the placement /
+                        # dispatch handoff. On a mesh this times the real
+                        # device_put; single-device it times the numpy
+                        # handoff (the transfer itself hides inside the
+                        # async dispatch) — either way bytes-per-frame is
+                        # exact, which is the number the uint8-shipping
+                        # work gates on.
+                        t_h2d = time.perf_counter()
+                        placed = self._place(group.frames)
+                        h2d_s = time.perf_counter() - t_h2d
+                        self.perf.note_h2d(
+                            group.model or self._spec.name, group.bucket,
+                            group.nbytes, h2d_s,
+                        )
+                        outputs = step(variables, placed)
                     except Exception:
                         for g in groups[gi:]:
                             self._collector.release(g)
@@ -1262,6 +1306,22 @@ class InferenceEngine:
         )
         if self.slo is not None:
             self._slo_tick(inferred)
+        if self.prof is not None:
+            # Burn-triggered profiling (obs/prof.py): fires at most one
+            # bounded capture per new SLO episode / ladder escalation,
+            # rate-limited, on its own thread. Idle cost: integer
+            # compares under a lock.
+            rung_idx = (self.ladder.rung_index
+                        if self.ladder is not None else 0)
+            self.prof.poll(
+                episodes=self._slo_episodes,
+                rung=rung_idx,
+                context={
+                    "slo_episode": self._slo_episodes or None,
+                    "slo_burning": self._slo_burning,
+                    "rung": RUNGS[rung_idx],
+                },
+            )
 
     def _slo_tick(self, inferred: Sequence[str]) -> None:
         """Per-tick SLO sampling + throttled evaluation (obs/slo.py).
@@ -1288,7 +1348,13 @@ class InferenceEngine:
                                        bad=0.0 if ok else 1.0)
         if now >= self._slo_next_eval:
             self._slo_next_eval = now + self._cfg.slo_eval_interval_s
-            self._slo_burning = self.slo.evaluate()["burning"]
+            verdict = self.slo.evaluate()
+            self._slo_burning = verdict["burning"]
+            # Cumulative episode count across all SLOs: the prof trigger
+            # watermark (one capture per newly-opened episode).
+            self._slo_episodes = sum(
+                s["episodes"] for s in verdict["slos"].values()
+            )
 
     def _enqueue_drain(self, inflight: _Inflight) -> None:
         """Hand a dispatched batch to the drain thread. Blocks (in short

@@ -154,12 +154,20 @@ class SegmentArchiver:
     @staticmethod
     def _write_stream_copy(path: str, seg: PacketGopSegment) -> None:
         """Mux the compressed GOP, pts/dts rebased so the segment starts
-        at 0 (reference ``python/archive.py:81-84``) — PER STREAM: audio
-        and video run different clocks, so each rebases from its own
-        first timestamp (the reference subtracted one minimum across
-        both, which only worked because its demux loop never delivered
-        audio). Audio muxes into the same MP4 when the camera has a mic
+        at 0 (reference ``python/archive.py:81-84``) — from a COMMON
+        epoch: both streams subtract the same wall instant (the earlier
+        of the two stream heads), each expressed in its own time_base.
+        Rebasing each stream from its own first timestamp (the pre-r10
+        behavior) zeroed out the real A/V offset — a camera whose mic
+        starts late, or bursty audio absent from the GOP head, played
+        back with its audio snapped to t=0 instead of its actual delay.
+        (The reference subtracted one minimum across both streams, which
+        only worked because its demux loop never delivered audio.) The
+        epoch is the min of the heads so neither stream rebases negative.
+        Audio muxes into the same MP4 when the camera has a mic
         (reference ``archive.py:78-79,95-97``). No transcode."""
+        from fractions import Fraction
+
         from .av import StreamCopyMuxer
 
         def first_ts(pkts):
@@ -176,6 +184,23 @@ class SegmentArchiver:
         is_audio = lambda p: getattr(p, "is_audio", False)  # noqa: E731
         base = first_ts([p for p in seg.packets if not is_audio(p)])
         abase = first_ts([p for p in seg.packets if is_audio(p)])
+        have_audio = (seg.audio_info is not None
+                      and any(is_audio(p) for p in seg.packets))
+        if have_audio:
+            vnum, vden = seg.info.time_base
+            anum, aden = seg.audio_info.time_base
+            if vnum > 0 and vden > 0 and anum > 0 and aden > 0:
+                # Exact rational clock math (no float drift over long
+                # segments): pick the earlier stream head as the shared
+                # epoch, then express it in each stream's time_base.
+                vtb = Fraction(vnum, vden)
+                atb = Fraction(anum, aden)
+                epoch = min(base * vtb, abase * atb)   # seconds
+                # floor(): rounding up could rebase the epoch-defining
+                # head packet to -1. The sub-tick truncation (< one
+                # time_base unit) is far below audible A/V skew.
+                base = int(epoch // vtb)
+                abase = int(epoch // atb)
         mux = StreamCopyMuxer(path, seg.info, audio_info=seg.audio_info)
         with mux:
             for pkt in seg.packets:

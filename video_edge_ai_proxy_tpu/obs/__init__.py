@@ -2,8 +2,8 @@
 stall/watermark detection (ISSUE r7 tentpole), live device-performance
 attribution and SLO burn-rate evaluation (ISSUE r9 tentpole).
 
-Pure-Python, jax-free, importable from control-plane and worker code alike.
-Five modules:
+Pure-Python, jax-free at import, importable from control-plane and worker
+code alike. Six modules:
 
 - :mod:`metrics` — process-wide counters/gauges/log2-histograms, rendered
   once by ``/metrics`` (Prometheus 0.0.4) and ``/api/v1/stats`` (JSON).
@@ -18,10 +18,15 @@ Five modules:
 - :mod:`slo` — declarative SLOs (p50 detect latency, aggregate fps,
   stream availability) with multi-window burn-rate episodes, served at
   ``/api/v1/slo`` and feeding the resilience degradation ladder.
+- :mod:`prof` — duration-bounded jax.profiler captures (on-demand via
+  ``/api/v1/profile`` + gRPC admin mirror, or fired automatically when an
+  SLO episode opens / the degradation ladder escalates) written as
+  self-contained bundles into a byte-bounded retention ring.
 """
 
 from .metrics import Registry, registry
 from .perf import PerfTracker, cost_summary, mfu_pct
+from .prof import Profiler
 from .slo import BurnRateSLO, SLOEngine, SLOSpec, default_slos
 from .spans import SpanRecorder, stage_breakdown, to_chrome_trace, tracer
 from .watch import Watchdog
@@ -30,6 +35,7 @@ __all__ = [
     "Registry",
     "registry",
     "PerfTracker",
+    "Profiler",
     "cost_summary",
     "mfu_pct",
     "BurnRateSLO",

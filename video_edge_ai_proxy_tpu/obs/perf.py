@@ -125,6 +125,24 @@ class _RateWindow:
         return self._total / min(max(span, 0.5), self._window_s)
 
 
+class _H2DCell:
+    """Per-(model, bucket) host->device transfer accounting: pre-resolved
+    counter children + running totals, same fixed-allocation discipline
+    as :class:`_BatchCell` (``note_h2d`` runs on the engine tick thread,
+    once per dispatched batch)."""
+
+    __slots__ = ("bytes_child", "seconds_child", "bytes", "seconds",
+                 "batches", "slots")
+
+    def __init__(self, bytes_child, seconds_child):
+        self.bytes_child = bytes_child
+        self.seconds_child = seconds_child
+        self.bytes = 0
+        self.seconds = 0.0
+        self.batches = 0
+        self.slots = 0
+
+
 class _BatchCell:
     """Per-(model, geometry, bucket) hot-path state: pre-resolved metric
     children + EMA accumulator, so ``note_batch`` is lookups and float
@@ -168,6 +186,8 @@ class PerfTracker:
         self._compiles: Dict[Tuple[str, str, int], dict] = {}
         # (model, geometry, bucket) -> hot-path cell
         self._cells: Dict[Tuple[str, str, int], _BatchCell] = {}
+        # (model, bucket) -> H2D transfer cell
+        self._h2d: Dict[Tuple[str, int], _H2DCell] = {}
         self._fps = _RateWindow(window_s=fps_window_s)
 
         self._m_compile_s = reg.histogram(
@@ -213,6 +233,14 @@ class PerfTracker:
         self._m_fps = reg.gauge(
             "vep_perf_fps",
             "Aggregate emitted frames/second (sliding window)")
+        self._m_h2d_bytes = reg.counter(
+            "vep_h2d_bytes",
+            "Host->device bytes shipped per dispatched batch (uint8 "
+            "frames incl. bucket padding)", ("model", "bucket"))
+        self._m_h2d_seconds = reg.counter(
+            "vep_h2d_seconds",
+            "Wall seconds spent placing batches on device (device_put / "
+            "dispatch handoff)", ("model", "bucket"))
 
     # -- compile-time attribution ----------------------------------------
 
@@ -284,6 +312,35 @@ class PerfTracker:
         self._fps.add(frames, now)
         self._m_fps.set(self._fps.rate(now))
 
+    def note_h2d(self, model: str, bucket: int, nbytes: int,
+                 seconds: float) -> None:
+        """Record one host->device batch placement: ``nbytes`` on the wire
+        (the full padded uint8 batch) taking ``seconds`` of tick-thread
+        wall time. Runs once per dispatched batch on the tick loop, so it
+        follows the same fixed-allocation cell discipline as
+        ``note_batch`` — the direct measurement behind ROADMAP item 5's
+        bytes-per-frame gate."""
+        key = (model, bucket)
+        cell = self._h2d.get(key)
+        if cell is None:
+            cell = self._make_h2d_cell(key)
+        cell.bytes_child.inc(nbytes)
+        cell.seconds_child.inc(seconds)
+        cell.bytes += int(nbytes)
+        cell.seconds += float(seconds)
+        cell.batches += 1
+        cell.slots += int(bucket)
+
+    def _make_h2d_cell(self, key: Tuple[str, int]) -> _H2DCell:
+        model, bucket = key
+        b = str(bucket)
+        cell = _H2DCell(
+            bytes_child=self._m_h2d_bytes.labels(model, b),
+            seconds_child=self._m_h2d_seconds.labels(model, b),
+        )
+        with self._lock:
+            return self._h2d.setdefault(key, cell)
+
     def _make_cell(self, key: Tuple[str, str, int]) -> _BatchCell:
         model, _geometry, bucket = key
         b = str(bucket)
@@ -325,6 +382,18 @@ class PerfTracker:
                                         2) if slots else 0.0,
                     "mfu_pct": round(util, 3) if util is not None else None,
                 })
+            h2d = []
+            for (model, bucket), cell in sorted(self._h2d.items()):
+                h2d.append({
+                    "model": model, "bucket": bucket,
+                    "bytes": cell.bytes,
+                    "seconds": round(cell.seconds, 6),
+                    "batches": cell.batches,
+                    "bytes_per_frame": (cell.bytes // cell.slots
+                                        if cell.slots else None),
+                    "mbps": (round(cell.bytes / 1e6 / cell.seconds, 1)
+                             if cell.seconds > 0 else None),
+                })
         return {
             "peak_tflops": self.peak_tflops,
             "fps": round(self.fps(), 1),
@@ -332,4 +401,5 @@ class PerfTracker:
                 compiles, key=lambda r: (r["model"], r["geometry"],
                                          r["bucket"])),
             "buckets": buckets,
+            "h2d": h2d,
         }

@@ -267,8 +267,17 @@ def run_fleet_soak(
     trace_path: Optional[str] = None, fault_plan: Optional[FaultPlan] = None,
     warmup_timeout_s: float = 1800.0, sample_every_s: float = 2.0,
     timeline_bin_s: float = 10.0, trace_sample_every: int = 4,
+    profile_on_burn: bool = False, prof_dir: Optional[str] = None,
 ) -> dict:
-    """The >=120 s chaos soak. Returns the artifact's "soak" section."""
+    """The >=120 s chaos soak. Returns the artifact's "soak" section.
+
+    ``profile_on_burn`` arms the r10 trigger path (obs/prof.py): the
+    engine fires a bounded jax.profiler capture when an SLO episode
+    opens or the ladder escalates, at soak-scale settings (200 ms
+    captures, 5 s rate limit — a 20 s smoke must be able to catch its
+    own excursion). The bundle manifests land in the artifact's "prof"
+    section; tools/soak_replay.py --profile-on-burn hard-gates on them.
+    """
     import shutil
     import tempfile
 
@@ -338,12 +347,28 @@ def run_fleet_soak(
     )
     ann_q.start()
 
+    if profile_on_burn and prof_dir is None:
+        prof_dir = tempfile.mkdtemp(prefix="vep_soak_prof_")
     eng = InferenceEngine(
         bus,
         EngineConfig(
             model=default_model, tick_ms=tick_ms, stage_trace=True,
             batch_buckets=(1, 2, 4, 8, 16), track=False,
             annotation_emit="all",   # firehose: conservation needs volume
+            # Profiling is opt-in for the soak: a capture pauses ~200 ms
+            # of wall inside the measured window, so only the
+            # --profile-on-burn legs pay it. Soak-scale trigger knobs:
+            # small capture, short rate limit, and an SLO warmup shorter
+            # than the smoke duration so episode triggers can fire too.
+            prof=profile_on_burn,
+            prof_dir=prof_dir or "",
+            # The replay soak forks nothing, so the fork hazard behind
+            # the EngineConfig prof_trigger=False default does not
+            # apply here — arm the trigger path explicitly.
+            prof_trigger=profile_on_burn,
+            prof_trigger_ms=200,
+            prof_trigger_min_interval_s=5.0,
+            slo_warmup_s=(10.0 if profile_on_burn else 60.0),
         ),
         model_resolver=lambda d: assignment.get(d, ""),
         annotations=ann_q,
@@ -519,6 +544,12 @@ def run_fleet_soak(
     # CPU backend — the artifact records it; the chaos gates don't care).
     perf_section = eng.perf.snapshot()
     slo_section = eng.slo.snapshot() if eng.slo is not None else None
+    # r10: let an in-flight burn-triggered capture finish flushing its
+    # bundle, then freeze the manifest list into the artifact.
+    prof_section = None
+    if eng.prof is not None:
+        eng.prof.join_trigger()
+        prof_section = eng.prof.snapshot()
     eng.stop()
     sink_thread.join(timeout=5)
     inner_bus.close()
@@ -610,6 +641,7 @@ def run_fleet_soak(
         "resilience": resilience_section,
         "perf": perf_section,
         "slo": slo_section,
+        "prof": prof_section,
     }
 
 

@@ -198,6 +198,11 @@ def build_app(
             else None,
             "slo": engine.slo.snapshot()
             if engine is not None and engine.slo is not None else None,
+            # r10 triggered profiling: retention-ring state + recent
+            # capture manifests (bundle paths an operator can fetch and
+            # merge with tools/obs_export.py --merge).
+            "prof": engine.prof.snapshot()
+            if engine is not None and engine.prof is not None else None,
         }
         return web.json_response(out)
 
@@ -304,9 +309,41 @@ def build_app(
             text=text, content_type="text/plain", charset="utf-8",
         )
 
-    async def profile_start(request: web.Request) -> web.Response:
+    async def profile_capture(request: web.Request) -> web.Response:
+        """Duration-bounded device capture (obs/prof.py): hold a
+        jax.profiler trace open for ``?ms=N`` and return the bundle
+        manifest (device trace + concurrent lineage-span window +
+        perf/SLO snapshot in one directory). 400 when profiling is
+        disabled (engine.prof config, same kill-switch convention as
+        /api/v1/slo) or the duration is out of range; 409 when a capture
+        or manual trace is already in flight."""
         if engine is None:
             return _error(400, "engine not running")
+        if engine.prof is None:
+            return _error(400, "profiling disabled (engine.prof config)")
+        try:
+            ms = int(request.query.get("ms", "500"))
+        except ValueError:
+            return _error(400, "ms must be an integer")
+        try:
+            manifest = await asyncio.to_thread(
+                engine.prof.capture, ms, trigger="manual",
+                context={"via": "rest"},
+            )
+        except ValueError as exc:
+            return _error(400, str(exc))
+        except RuntimeError as exc:
+            return _error(409, str(exc))
+        return web.json_response(manifest)
+
+    async def profile_start(request: web.Request) -> web.Response:
+        """Legacy unbounded trace (start/stop pair). Delegates to the
+        same obs/prof.py capture path as /api/v1/profile — the two
+        cannot overlap."""
+        if engine is None:
+            return _error(400, "engine not running")
+        if engine.prof is None:
+            return _error(400, "profiling disabled (engine.prof config)")
         try:
             body = await request.json()
         except Exception:
@@ -323,6 +360,8 @@ def build_app(
     async def profile_stop(_request: web.Request) -> web.Response:
         if engine is None:
             return _error(400, "engine not running")
+        if engine.prof is None:
+            return _error(400, "profiling disabled (engine.prof config)")
         try:
             await asyncio.to_thread(engine.stop_profile)
         except RuntimeError as exc:
@@ -394,6 +433,8 @@ def build_app(
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/v1/rtspscan", rtspscan)
+    app.router.add_get("/api/v1/profile", profile_capture)
+    app.router.add_post("/api/v1/profile", profile_capture)
     app.router.add_post("/api/v1/profile/start", profile_start)
     app.router.add_post("/api/v1/profile/stop", profile_stop)
 

@@ -35,6 +35,57 @@ from .storage import Storage
 log = get_logger("serve.server")
 
 
+def make_admin_handler(engine) -> grpc.GenericRpcHandler:
+    """gRPC admin mirror of ``POST /api/v1/profile?ms=N`` (obs/prof.py).
+
+    Implemented as a generic handler with JSON-bytes serializers rather
+    than a .proto service: the deploy image carries no protoc, and an
+    admin-only unary call does not justify regenerating stubs. Call it
+    raw: ``channel.unary_unary("/vep.Admin/ProfileCapture")(b'{"ms":500}')``
+    -> bundle manifest JSON. Status mapping mirrors the REST endpoint:
+    INVALID_ARGUMENT for a bad duration (=400), FAILED_PRECONDITION when
+    profiling is disabled (=the 400 kill-switch answer), ABORTED when a
+    capture is already in flight (=409).
+    """
+    import json
+
+    def profile_capture(request: bytes, context):
+        if engine is None or engine.prof is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "profiling disabled (engine.prof config)",
+            )
+        try:
+            body = json.loads(request) if request else {}
+            ms = int(body.get("ms", 500)) if isinstance(body, dict) else None
+        except (ValueError, TypeError):
+            ms = None
+        if ms is None:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                'expected a JSON object body like {"ms": 500}',
+            )
+        try:
+            manifest = engine.prof.capture(
+                ms, trigger="manual", context={"via": "grpc"}
+            )
+        except ValueError as exc:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        except RuntimeError as exc:
+            context.abort(grpc.StatusCode.ABORTED, str(exc))
+        return json.dumps(manifest).encode()
+
+    # Identity serializers: the wire format IS the JSON bytes.
+    rpc = grpc.unary_unary_rpc_method_handler(
+        profile_capture,
+        request_deserializer=lambda b: b,
+        response_serializer=lambda b: b,
+    )
+    return grpc.method_handlers_generic_handler(
+        "vep.Admin", {"ProfileCapture": rpc}
+    )
+
+
 class Server:
     def __init__(
         self,
@@ -164,6 +215,16 @@ class Server:
                     engine_cfg,
                     compile_cache_dir=os.path.join(data_dir, "compile_cache"),
                 )
+            if engine_cfg.prof and not engine_cfg.prof_dir:
+                # Capture bundles persist under the data dir (like the
+                # registry and spool) instead of the runner's tempdir
+                # fallback — an operator fetching a bundle after a crash
+                # expects it next to the rest of the state.
+                import dataclasses
+
+                engine_cfg = dataclasses.replace(
+                    engine_cfg, prof_dir=os.path.join(data_dir, "prof")
+                )
             self.engine = InferenceEngine(
                 self.bus, engine_cfg, annotations=self.annotations,
                 model_resolver=self.process_manager.inference_model_of,
@@ -222,13 +283,26 @@ class Server:
             ],
         )
         pb_grpc.add_ImageServicer_to_server(servicer, server)
+        # Admin mirror of /api/v1/profile (generic handler, JSON bytes —
+        # see make_admin_handler for why there is no .proto service).
+        server.add_generic_rpc_handlers((make_admin_handler(self.engine),))
         self.bound_grpc_port = server.add_insecure_port(f"0.0.0.0:{self._grpc_port}")
         server.start()
         self._grpc_server = server
         log.info(
-            "gRPC Image service on :%d, REST on :%d",
+            "gRPC Image service on :%d (admin: /vep.Admin/ProfileCapture), "
+            "REST on :%d",
             self.bound_grpc_port, self._rest.bound_port,
         )
+        if self.engine is not None and self.engine.prof is not None:
+            log.info(
+                "profiler ready: bundles under %s (trigger=%s, %d ms, "
+                "min interval %gs)",
+                self.engine.prof.directory,
+                self.engine.prof.trigger_enabled,
+                self.engine.prof.trigger_ms,
+                self.engine.prof.trigger_min_interval_s,
+            )
 
     def wait(self) -> None:
         self._stopped.wait()

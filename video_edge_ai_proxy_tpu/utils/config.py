@@ -177,6 +177,27 @@ class EngineConfig:
     slo_availability_window_s: float = 5.0
     slo_eval_interval_s: float = 1.0
     slo_ladder: bool = True
+    # Triggered device profiling (obs/prof.py): duration-bounded
+    # jax.profiler captures on demand (/api/v1/profile?ms=N, gRPC admin
+    # mirror) and fired automatically once per SLO episode / ladder
+    # escalation, written as self-contained bundles (device trace +
+    # lineage-span window + perf/SLO snapshot) into a byte-bounded
+    # retention ring. prof=False disables the subsystem and the REST
+    # endpoint answers 400 (same kill-switch convention as slo above).
+    prof: bool = True
+    prof_dir: str = ""                 # "" = <tempdir>/vep_prof (server
+                                       # wires <data_dir>/prof instead)
+    # Trigger-driven capture is OPT-IN: the serving process forks camera
+    # workers (process manager restarts, soak chaos), and jax's profiler
+    # segfaults when a trace overlaps a fork (observed: tools/soak.py
+    # chaos run, SIGSEGV the tick a ladder escalation fired a capture).
+    # Arm it where the engine runs fork-free (replay soaks via
+    # --profile-on-burn) or the operator isolates the engine process.
+    prof_trigger: bool = False         # auto-capture on burn/escalation
+    prof_trigger_ms: int = 500         # duration of triggered captures
+    prof_trigger_min_interval_s: float = 60.0  # rate limit between them
+    prof_retention_bytes: int = 256 << 20      # ring bound, oldest evicted
+    prof_max_ms: int = 10_000          # cap on ?ms= (400 above this)
 
 
 @dataclass
