@@ -2,7 +2,7 @@
 # whole build; ours adds the native bus lib and test/bench shortcuts).
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
-	obs-smoke chaos-smoke prof-smoke perf-gate
+	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate
 
 all: proto native
 
@@ -107,6 +107,25 @@ prof-smoke:
 			'device_events': m['device_events'], \
 			'device_pids': m['device_pids'], \
 			'clock_anchor': m['anchor']}))"
+
+# Output-quality smoke: a short replay soak (CPU backend, tiny twins)
+# under the three scripted quality faults — lens-cap black frames, a
+# frozen decoder, and a silent score drift — gated on every fault being
+# DETECTED (verdict transition within the latency bound; canary
+# checksum mismatch + watchdog episode for the drift) with ZERO false
+# positives over the clean remainder of the window. Deterministic
+# schedule (replay/faults.py _QUALITY_WINDOWS); gates in
+# tools/soak_replay.py exit non-zero on breach; writes the
+# QUALITY_r07.json attribution artifact. ~1 min.
+quality-smoke:
+	python tools/soak_replay.py --duration 20 --no-e2e \
+		--faults black_frame,frozen_frame,score_drift \
+		--out /tmp/vep_quality_smoke.json \
+		--quality-out /tmp/vep_quality_r07.json
+	@python -c "import json; d=json.load(open('/tmp/vep_quality_r07.json')); \
+		assert all(f['detected'] for f in d['faults']), d['faults']; \
+		assert not d['false_positives'], d['false_positives']; \
+		print(json.dumps(d['faults'], indent=2))"
 
 # Performance regression gate: run the bench, then compare its JSON line
 # against the committed BENCH_r*.json trajectory (tools/bench_gate.py;

@@ -81,6 +81,7 @@ def timed_min(fn, good_s, backend, deadline, sleep_s=25.0):
 # re-exported here because it is part of the bench methodology and tests
 # exercise it as bench.zero_class_prior.
 from video_edge_ai_proxy_tpu.replay.checksum import (  # noqa: E402
+    CHECKSUM_MASK,
     check_golden,
     fold_checksum,
     zero_class_prior,
@@ -161,6 +162,43 @@ def main() -> None:
     frames_done = streams * iters
     fps = frames_done / elapsed
     batch_ms = elapsed / iters * 1000.0
+
+    # r10 quality-stats overhead: the same serving program with the
+    # device frame-statistics path fused in (engine default:
+    # quality_thumb=32 — luma mean/variance + inter-frame diff energy vs
+    # a per-stream thumbnail carried across ticks). Same megastep shape,
+    # the thumbnail state rides the scan carry exactly like the engine
+    # carries it across ticks; the stats fold into the checksum so the
+    # extra work cannot be DCE'd. Reported as a delta against batch_ms —
+    # the committed answer to "what does always-on quality cost the hot
+    # path" (BASELINE.md round 7).
+    serving_step_q = build_serving_step(
+        model, spec, quality_thumb=32)
+
+    @jax.jit
+    def megastep_quality(base_u8):
+        def body(carry, i):
+            c, thumbs = carry
+            frames = base_u8 + i.astype(jnp.uint8)
+            out = serving_step_q(variables, frames, thumbs)
+            c = fold_checksum(c, out)
+            c = (c + jnp.sum(out["quality_stats"]).astype(jnp.int32)) \
+                & CHECKSUM_MASK
+            return (c, out["quality_thumbs"]), None
+
+        (total_q, _), _ = jax.lax.scan(
+            body,
+            (jnp.zeros((), jnp.int32),
+             jnp.zeros((streams, 32, 32), jnp.float32)),
+            jnp.arange(iters),
+        )
+        return total_q
+
+    np.asarray(megastep_quality(base_dev))
+    elapsed_q, _, q_contended = timed_best(
+        lambda: megastep_quality(base_dev), iters, backend,
+        good_batch_ms + 2.0, time.monotonic() + 120.0)
+    quality_batch_ms = elapsed_q / iters * 1000.0
 
     # honest tunnel-bound end-to-end single batch (upload + step + fetch),
     # contention-guarded like every other leg (r1-r3 recorded 1.8-2.3 s;
@@ -246,6 +284,8 @@ def main() -> None:
         # double-buffering work must shrink or hide.
         "h2d_bytes_per_frame": base.nbytes // streams,
         "e2e_tunnel_ms": round(e2e_ms, 1),
+        "quality_batch_ms": round(quality_batch_ms, 2),
+        "quality_stats_overhead_ms": round(quality_batch_ms - batch_ms, 3),
         "fps_64stream_bucket": round(fps64, 1) if fps64 else None,
         "step_gflop": round(step_flops / 1e9, 2) if step_flops else None,
         "live_tflops": (round(step_flops / (batch_ms * 1e-3) / 1e12, 2)
@@ -256,6 +296,8 @@ def main() -> None:
         "checksum_key": golden_key,
         "checksum_golden": golden,
     }
+    if q_contended:
+        out["quality_contended"] = True
     if contended:
         # Retries never found an uncontended window: the number below is a
         # co-tenant artifact, not this program's speed (BASELINE.md notes).

@@ -1,0 +1,484 @@
+"""Output-quality observability tests (ISSUE r7): the black/frozen/
+flatline hysteresis state machines and drift scorer under a fake clock,
+the canary integrity checker's cycle accounting + watchdog episodes, the
+device-side frame-statistics path, the serving-step integration (extra
+keys, untouched result signature), log-context correlation, and the
+disabled-endpoint convention for /api/v1/quality."""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.obs.metrics import Registry, lint_exposition
+from video_edge_ai_proxy_tpu.obs.quality import (
+    CanaryChecker,
+    QualityTracker,
+    VERDICTS,
+)
+from video_edge_ai_proxy_tpu.obs.watch import Watchdog
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _tracker(clk, reg=None, **kw):
+    kw.setdefault("enter_s", 2.0)
+    kw.setdefault("exit_s", 2.0)
+    kw.setdefault("window_s", 5.0)
+    return QualityTracker(
+        clock=clk, registry=reg if reg is not None else Registry(), **kw)
+
+
+#: A healthy sample: mid-grey, textured, moving.
+_OK = dict(luma_mean=0.5, luma_var=0.02, diff_energy=0.01)
+#: Lens-cap sample: dark AND flat (a dark textured night scene stays ok).
+_BLACK = dict(luma_mean=0.01, luma_var=1e-5, diff_energy=0.01)
+#: Wedged-decoder sample: normal content, zero inter-frame energy.
+_FROZEN = dict(luma_mean=0.5, luma_var=0.02, diff_energy=0.0)
+
+
+class TestHysteresis:
+    def test_black_enters_only_after_sustained_window(self):
+        clk = _FakeClock()
+        q = _tracker(clk)
+        assert q.observe("cam", **_OK) == "ok"
+        for _ in range(3):          # first black at +0.5: run spans 1.0 s
+            clk.advance(0.5)
+            assert q.observe("cam", **_BLACK) == "ok"
+        clk.advance(1.0)            # run reaches the 2 s enter window
+        assert q.observe("cam", **_BLACK) == "black"
+        assert q.unhealthy() == frozenset({"cam"})
+
+    def test_boundary_oscillation_never_enters(self):
+        # Condition flapping at the enter boundary: every clear sample
+        # resets the run, so the verdict never leaves ok.
+        clk = _FakeClock()
+        q = _tracker(clk)
+        q.observe("cam", **_OK)
+        for _ in range(10):
+            clk.advance(1.9)        # just under enter_s of black...
+            q.observe("cam", **_BLACK)
+            clk.advance(0.1)        # ...then one clear sample
+            assert q.observe("cam", **_OK) == "ok"
+
+    def test_boundary_oscillation_never_exits(self):
+        # The mirror image: once black, a condition blip during the
+        # all-clear run restarts exit_s — no flap back to ok.
+        clk = _FakeClock()
+        q = _tracker(clk)
+        q.observe("cam", **_BLACK)      # run starts here
+        clk.advance(2.5)
+        assert q.observe("cam", **_BLACK) == "black"
+        for _ in range(10):
+            clk.advance(1.9)        # just under exit_s clear...
+            q.observe("cam", **_OK)
+            clk.advance(0.1)        # ...then the condition re-appears
+            assert q.observe("cam", **_BLACK) == "black"
+        # sustained clear finally exits
+        clk.advance(2.1)
+        q.observe("cam", **_OK)
+        clk.advance(2.1)
+        assert q.observe("cam", **_OK) == "ok"
+        snap = q.snapshot()
+        trans = [v for _, v in snap["streams"]["cam"]["transitions"]]
+        # exactly one round trip — no flapping despite 10 boundary blips
+        assert trans == ["black", "ok"]
+
+    def test_frozen_verdict_and_first_sample_diff_discarded(self):
+        clk = _FakeClock()
+        q = _tracker(clk)
+        # First sample's diff is vs the zero init thumbnail — even a
+        # zero diff (which would look frozen) must not arm the condition.
+        q.observe("cam", **_FROZEN)
+        clk.advance(2.5)
+        # Second frozen sample starts the run NOW; enter_s hasn't passed.
+        assert q.observe("cam", **_FROZEN) == "ok"
+        clk.advance(2.1)
+        assert q.observe("cam", **_FROZEN) == "frozen"
+
+    def test_black_wins_over_frozen(self):
+        # A black frame is also frozen (zero diff); priority order says
+        # black explains more.
+        clk = _FakeClock()
+        q = _tracker(clk)
+        both = dict(luma_mean=0.01, luma_var=1e-5, diff_energy=0.0)
+        q.observe("cam", **both)        # both runs start here
+        clk.advance(2.5)
+        assert q.observe("cam", **both) == "black"
+        assert VERDICTS.index("black") < VERDICTS.index("frozen")
+
+    def test_flatline_needs_history_and_stays_servable(self):
+        clk = _FakeClock()
+        q = _tracker(clk, flatline_s=10.0)
+        # "idle" never detected anything: no flatline however long quiet.
+        # "busy" historically detects, then its head goes silent.
+        for _ in range(60):
+            clk.advance(0.5)
+            q.observe("idle", **_OK)
+            q.observe("busy", **_OK, classes=[1, 2], scores=[0.9, 0.8])
+        for _ in range(25):         # 12.5 s of zero detections
+            clk.advance(0.5)
+            q.observe("idle", **_OK)
+            q.observe("busy", **_OK)
+        assert q.verdict("idle") == "ok"
+        assert q.verdict("busy") == "flatline"
+        # flatline = head went quiet, frames still fine: NOT shed-first
+        assert q.unhealthy() == frozenset()
+
+
+class TestDrift:
+    def _feed_window(self, q, clk, classes, scores, seconds=6.0, fps=4):
+        for _ in range(int(seconds * fps)):
+            clk.advance(1.0 / fps)
+            q.observe("cam", **_OK, classes=classes, scores=scores)
+
+    def test_shift_moves_score_clean_does_not(self):
+        clk = _FakeClock()
+        reg = Registry()
+        q = _tracker(clk, reg=reg, drift_threshold=0.35)
+        # window 1 self-adopts the baseline distribution
+        self._feed_window(q, clk, [0, 0, 1], [0.9, 0.8, 0.7])
+        # window 2: same distribution -> no drift
+        self._feed_window(q, clk, [0, 0, 1], [0.9, 0.8, 0.7])
+        snap = q.snapshot()["streams"]["cam"]
+        assert snap["baseline"] and snap["drift"] < 0.1
+        assert not snap["drifting"]
+        # windows 3+: confidences collapse three log2 bins and a class
+        # vanishes — the silent-regression shape the scorer must catch.
+        # 12 s guarantees at least one PURE shifted 5 s window (the first
+        # roll after the switch still mixes leftover clean samples).
+        self._feed_window(q, clk, [0], [0.12], seconds=12.0)
+        snap = q.snapshot()["streams"]["cam"]
+        assert snap["drift"] > 0.35
+        assert snap["drifting"] and snap["drift_events"]
+        # recovery: the original distribution pulls the score back down
+        self._feed_window(q, clk, [0, 0, 1], [0.9, 0.8, 0.7], seconds=12.0)
+        assert q.snapshot()["streams"]["cam"]["drift"] < 0.1
+
+    def test_committed_baseline_preempts_adoption(self):
+        clk = _FakeClock()
+        base = {"hist": [1.0] + [0.0] * 7, "rate": {0: 1.0}}
+        q = _tracker(clk, baselines={"cam": base}, drift_threshold=0.35)
+        # First window immediately scores against the committed baseline
+        # (no self-adoption window of blindness): all detections two
+        # bins lower + a new class.
+        self._feed_window(q, clk, [5], [0.2])
+        assert q.snapshot()["streams"]["cam"]["drift"] > 0.35
+
+
+class TestCanary:
+    def _mk(self, clk, golden=None):
+        reg = Registry()
+        wd = Watchdog()
+
+        class _SLO:
+            good = bad = 0.0
+
+            def record(self, good=0.0, bad=0.0):
+                self.good += good
+                self.bad += bad
+
+        slo = _SLO()
+        c = CanaryChecker(loop_len=4, golden=golden, registry=reg,
+                          watchdog=wd, slo=slo, clock=clk)
+        return c, wd, slo
+
+    def _cycle(self, c, values):
+        for p, v in enumerate(values):
+            c.note(p, v)
+
+    def test_adopt_then_exactly_one_episode_per_mismatch_run(self):
+        clk = _FakeClock()
+        c, wd, slo = self._mk(clk)
+        good = [11, 22, 33, 44]
+        self._cycle(c, good)            # fills cycle 1
+        self._cycle(c, good)            # wrap closes cycle 1 -> adopt+match
+        assert c.adopted and c.golden is not None
+        self._cycle(c, good)            # closes cycle 2 -> match
+        assert c.match_cycles == 2 and slo.good == 2.0
+        bad = [11, 22, 33, 999]
+        self._cycle(c, bad)             # closes cycle 3 (good) -> match
+        self._cycle(c, bad)             # closes cycle 4 (bad) -> mismatch
+        self._cycle(c, bad)             # -> mismatch again, same episode
+        assert c.mismatch_cycles == 2 and slo.bad == 2.0
+        assert wd.snapshot()["episodes"]["canary_integrity"] == 1
+        assert "canary_integrity" in wd.active()
+        self._cycle(c, good)            # closes last bad cycle -> mismatch
+        self._cycle(c, good)            # closes a good cycle -> recovery
+        assert wd.active() == {}        # episode closed
+        self._cycle(c, bad)
+        self._cycle(c, bad)             # a NEW mismatch run
+        assert wd.snapshot()["episodes"]["canary_integrity"] == 2
+
+    def test_dropped_frame_voids_cycle_instead_of_mismatching(self):
+        clk = _FakeClock()
+        c, wd, slo = self._mk(clk, golden=123)
+        c.note(0, 11)
+        c.note(1, 22)
+        c.note(3, 44)                   # packet 2 dropped
+        c.note(0, 11)                   # wrap: incomplete cycle closes
+        assert c.void_cycles == 1
+        assert c.mismatch_cycles == 0 and slo.bad == 0.0
+        assert wd.snapshot()["episodes"] == {}
+
+    def test_duplicate_packet_voids_cycle(self):
+        clk = _FakeClock()
+        c, _, _ = self._mk(clk, golden=123)
+        c.note(0, 11)
+        c.note(1, 22)
+        c.note(1, 22)                   # duplicate wraps (p <= last)
+        assert c.void_cycles == 1
+
+    def test_loop_len_validated(self):
+        with pytest.raises(ValueError):
+            CanaryChecker(loop_len=0, registry=Registry())
+
+
+class TestExposition:
+    def test_quality_families_lint_clean(self):
+        reg = Registry()
+        clk = _FakeClock()
+        q = QualityTracker(clock=clk, registry=reg, enter_s=0.5,
+                           exit_s=0.5, window_s=1.0)
+        q.observe("cam", **_OK, classes=[1], scores=[0.9])
+        clk.advance(1.0)
+        q.observe("cam", **_BLACK)
+        clk.advance(1.0)
+        q.observe("cam", **_BLACK)
+        c = CanaryChecker(loop_len=2, registry=reg, clock=clk)
+        c.note(0, 1)
+        c.note(1, 2)
+        c.note(0, 1)
+        text = reg.render()
+        for fam in ("vep_quality_state", "vep_quality_transitions_total",
+                    "vep_quality_luma", "vep_quality_diff_energy",
+                    "vep_quality_unhealthy_streams",
+                    "vep_quality_canary_cycles_total",
+                    "vep_quality_canary_ok"):
+            assert fam in text, f"{fam} missing from exposition"
+        assert lint_exposition(text) == []
+
+    def test_snapshot_json_able_and_schema_valid(self):
+        import os
+        import sys
+
+        clk = _FakeClock()
+        q = _tracker(clk)
+        q.observe("cam", **_OK, classes=[1], scores=[0.9])
+        snap = q.snapshot()
+        json.dumps(snap)
+        tools = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools")
+        sys.path.insert(0, tools)
+        try:
+            from obs_export import find_quality, validate_quality
+        finally:
+            sys.path.remove(tools)
+        # every payload shape obs_export --check accepts resolves to the
+        # same snapshot, and the snapshot passes its own schema
+        for payload in (snap, {"obs": {"quality": snap}},
+                        {"soak": {"obs": {"quality": snap}}}):
+            assert find_quality(payload) == snap
+        assert validate_quality(snap) == []
+        assert find_quality({"traceEvents": []}) is None
+
+
+class TestDeviceStats:
+    def test_frame_quality_stats_signals(self):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from video_edge_ai_proxy_tpu.ops.preprocess import (
+            frame_quality_stats,
+        )
+
+        rng = np.random.default_rng(0)
+        tex = rng.integers(0, 256, (1, 32, 48, 3), dtype=np.uint8)
+        black = np.zeros((1, 32, 48, 3), dtype=np.uint8)
+        frames = jnp.asarray(np.concatenate([black, tex, tex]))
+        zero_thumbs = jnp.zeros((3, 8, 8), jnp.float32)
+        stats, thumbs = frame_quality_stats(frames, zero_thumbs, (8, 8))
+        stats = np.asarray(stats)
+        assert stats.shape == (3, 3) and thumbs.shape == (3, 8, 8)
+        # black frame: luma and variance at zero
+        assert stats[0, 0] < 1e-3 and stats[0, 1] < 1e-6
+        # textured frame: mid luma, positive variance (thumbnail-domain —
+        # the 4x6 downsample averages noise out, so well under the source
+        # variance but orders over black's), big diff vs the zero thumb
+        assert 0.2 < stats[1, 0] < 0.8 and stats[1, 1] > 1e-4
+        assert stats[1, 2] > 1e-3
+        # identical frame vs its own thumbnail: diff energy collapses
+        stats2, _ = frame_quality_stats(frames, thumbs, (8, 8))
+        stats2 = np.asarray(stats2)
+        assert stats2[2, 2] < 1e-9
+        assert stats2[1, 2] < 1e-9
+
+    def test_serving_step_quality_keys_do_not_touch_results(self):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
+        from video_edge_ai_proxy_tpu.models import registry
+        from video_edge_ai_proxy_tpu.replay.checksum import device_checksum
+
+        spec = registry.get("tiny_yolov8")
+        model, variables = spec.init_params(jax.random.PRNGKey(0))
+        plain = build_serving_step(model, spec)
+        with_q = build_serving_step(model, spec, quality_thumb=8)
+        rng = np.random.default_rng(1)
+        frames = jnp.asarray(rng.integers(
+            0, 256, (2, 32, 32, 3), dtype=np.uint8))
+        thumbs = jnp.zeros((2, 8, 8), jnp.float32)
+        out0 = plain(variables, frames)
+        out1 = with_q(variables, frames, thumbs)
+        assert {"quality_stats", "quality_thumbs"} <= set(out1)
+        assert out1["quality_stats"].shape == (2, 3)
+        # the result signature is bit-identical: committed checksums and
+        # goldens survive the quality path being fused in
+        assert int(np.asarray(device_checksum(out0))) == \
+            int(np.asarray(device_checksum(out1)))
+        for k in out0:
+            np.testing.assert_array_equal(
+                np.asarray(out0[k]), np.asarray(out1[k]))
+
+
+class TestLogContext:
+    def test_records_carry_stream_and_seq(self):
+        from video_edge_ai_proxy_tpu.utils import logging as vlog
+
+        logger = vlog.get_logger("test.ctx")
+        handler = logging.getLogger("vep_tpu").handlers[0]
+        records = []
+
+        class _Probe(logging.Handler):
+            def emit(self, record):
+                # run the real handler's filters (context injection) and
+                # format string against the captured record
+                for f in handler.filters:
+                    f.filter(record)
+                records.append(handler.format(record))
+
+        probe = _Probe()
+        logger.addHandler(probe)
+        # An in-process ingest worker run earlier in the session leaves
+        # its per-packet context armed (worker threads are stream-dedicated
+        # and never reset, ingest/worker.py) — clear it so this test sees
+        # the outside-any-context baseline regardless of ordering.
+        clear = vlog.set_log_context()
+        try:
+            with vlog.log_context(stream="cam7", seq=42):
+                logger.warning("inside")
+            logger.warning("outside")
+        finally:
+            vlog.reset_log_context(clear)
+            logger.removeHandler(probe)
+        assert "[stream=cam7 seq=42]\tinside" in records[0]
+        assert "stream=" not in records[1]
+
+
+class TestQualityEndpointConvention:
+    def test_disabled_quality_answers_400_envelope(self):
+        """r9 disabled-endpoint convention: /api/v1/quality kill-switched
+        (engine.quality=False) answers the same {code, message} 400
+        envelope as /api/v1/slo and /api/v1/profile."""
+        import urllib.error
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+        from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+        eng = InferenceEngine(MemoryFrameBus(), EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5,
+            quality=False, slo=False, prof=False))
+        assert eng.quality is None and eng.canary is None
+
+        class _PM:
+            def list(self):
+                return []
+
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            envelopes = {}
+            for path in ("/api/v1/quality", "/api/v1/slo",
+                         "/api/v1/profile"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(base + path)
+                assert ei.value.code == 400, path
+                envelopes[path] = json.loads(ei.value.read())
+            for path, body in envelopes.items():
+                assert set(body) == {"code", "message"}, path
+                assert body["code"] == 400
+                assert "disabled" in body["message"], path
+            assert "engine.quality" in envelopes["/api/v1/quality"]["message"]
+        finally:
+            srv.stop()
+
+    def test_grpc_admin_quality_mirror(self):
+        """The gRPC Admin mirror follows the same convention:
+        FAILED_PRECONDITION when kill-switched, the snapshot JSON when
+        enabled."""
+        grpc = pytest.importorskip("grpc")
+
+        from concurrent import futures
+
+        from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.serve.server import make_admin_handler
+        from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+        def serve(eng):
+            server = grpc.server(
+                futures.ThreadPoolExecutor(max_workers=2))
+            server.add_generic_rpc_handlers((make_admin_handler(eng),))
+            port = server.add_insecure_port("127.0.0.1:0")
+            server.start()
+            return server, port
+
+        off = InferenceEngine(MemoryFrameBus(), EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5,
+            quality=False, slo=False, prof=False))
+        server, port = serve(off)
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+                call = ch.unary_unary("/vep.Admin/Quality")
+                with pytest.raises(grpc.RpcError) as ei:
+                    call(b"")
+                assert ei.value.code() == \
+                    grpc.StatusCode.FAILED_PRECONDITION
+                assert "engine.quality" in ei.value.details()
+        finally:
+            server.stop(None)
+
+        on = InferenceEngine(MemoryFrameBus(), EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5,
+            slo=False, prof=False))
+        assert on.quality is not None
+        on.quality.observe("cam", **_OK)
+        server, port = serve(on)
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+                raw = ch.unary_unary("/vep.Admin/Quality")(b"")
+            snap = json.loads(raw)
+            assert snap["streams"]["cam"]["verdict"] == "ok"
+            assert snap["canary"] is None
+        finally:
+            server.stop(None)

@@ -28,6 +28,15 @@ Modes::
 nonzero on problems — ``make obs-smoke`` / ``make prof-smoke`` gate on
 it. Pure Python, no jax.
 
+``--check`` also understands the r10 output-quality payloads and
+schema-validates those instead: a ``/api/v1/quality`` response, an
+``/api/v1/stats`` response (its ``obs.quality`` section), a soak
+artifact (``soak.obs.quality``), or a bare QualityTracker snapshot —
+verdicts must be in the known set, transitions well-formed, and the
+unhealthy list consistent with the per-stream verdicts::
+
+  curl -s :8080/api/v1/quality | python tools/obs_export.py - --check
+
 Clock alignment: jax.profiler timestamps are microseconds relative to
 trace start, span timestamps are wall-clock epoch. The merge estimates
 the offset from the earliest host-side *device-stage* span inside the
@@ -69,6 +78,82 @@ def load_events(obj):
         "unrecognized input: expected a span-event list, an /api/v1/trace "
         "response ({'events': [...]}), or a Chrome trace "
         "({'traceEvents': [...]})")
+
+
+#: Verdicts obs/quality.py can emit — the exposition contract the
+#: dashboards key on; an unknown verdict is a schema break, not a new
+#: feature.
+QUALITY_VERDICTS = ("ok", "black", "frozen", "flatline")
+
+_QUALITY_CONFIG_KEYS = (
+    "black_luma", "black_var", "freeze_diff", "enter_s", "exit_s",
+    "flatline_s", "window_s", "drift_threshold",
+)
+
+
+def find_quality(obj):
+    """Locate an obs.quality snapshot in any of the payload shapes that
+    carry one (module docstring), or None when the input is trace-like."""
+    if not isinstance(obj, dict):
+        return None
+    if isinstance(obj.get("soak"), dict):
+        obj = obj["soak"]
+    if isinstance(obj.get("obs"), dict):
+        obj = obj["obs"]
+    q = obj.get("quality", obj)
+    if isinstance(q, dict) and "streams" in q and "config" in q:
+        return q
+    return None
+
+
+def validate_quality(q) -> list:
+    """Schema problems in a QualityTracker snapshot (empty = valid)."""
+    problems = []
+    cfg = q.get("config")
+    if not isinstance(cfg, dict):
+        problems.append("config: missing or not an object")
+    else:
+        for k in _QUALITY_CONFIG_KEYS:
+            if not isinstance(cfg.get(k), (int, float)):
+                problems.append(f"config.{k}: missing or non-numeric")
+    streams = q.get("streams")
+    if not isinstance(streams, dict):
+        return problems + ["streams: missing or not an object"]
+    for name, st in streams.items():
+        if not isinstance(st, dict):
+            problems.append(f"streams.{name}: not an object")
+            continue
+        if st.get("verdict") not in QUALITY_VERDICTS:
+            problems.append(
+                f"streams.{name}.verdict: {st.get('verdict')!r} not in "
+                f"{QUALITY_VERDICTS}")
+        if not isinstance(st.get("samples"), int) or st["samples"] < 0:
+            problems.append(f"streams.{name}.samples: not a count")
+        for field in ("transitions", "drift_events"):
+            rows = st.get(field)
+            if not isinstance(rows, list) or any(
+                    not (isinstance(r, list) and len(r) == 2
+                         and isinstance(r[0], (int, float)))
+                    for r in rows):
+                problems.append(
+                    f"streams.{name}.{field}: not a [[t, value], ...] list")
+                continue
+            if field == "transitions" and any(
+                    r[1] not in QUALITY_VERDICTS for r in rows):
+                problems.append(
+                    f"streams.{name}.transitions: unknown verdict")
+    unhealthy = q.get("unhealthy")
+    if not isinstance(unhealthy, list):
+        problems.append("unhealthy: missing or not a list")
+    elif isinstance(streams, dict):
+        expect = sorted(n for n, st in streams.items()
+                        if isinstance(st, dict)
+                        and st.get("verdict") != "ok")
+        if sorted(unhealthy) != expect:
+            problems.append(
+                f"unhealthy: {sorted(unhealthy)} inconsistent with "
+                f"per-stream verdicts {expect}")
+    return problems
 
 
 def _load_json_maybe_gz(path: str):
@@ -217,6 +302,25 @@ def main(argv=None) -> None:
         else:
             with open(args.input) as f:
                 obj = json.load(f)
+        quality = find_quality(obj)
+        if quality is not None:
+            if not args.check:
+                raise SystemExit(
+                    "input is an obs.quality payload — it only supports "
+                    "--check (nothing to convert to a Chrome trace)")
+            problems = validate_quality(quality)
+            if problems:
+                for p in problems:
+                    print(f"PROBLEM: {p}", file=sys.stderr)
+                raise SystemExit(
+                    f"quality check FAILED: {len(problems)} problem(s) "
+                    f"in {len(quality.get('streams') or {})} streams")
+            print(json.dumps({
+                "check": "ok", "kind": "quality",
+                "streams": len(quality["streams"]),
+                "unhealthy": quality["unhealthy"],
+            }))
+            return
         events, trace = load_events(obj)
         if trace is None:
             trace = to_chrome_trace(events)

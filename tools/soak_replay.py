@@ -39,6 +39,15 @@ conservation (delivered + explicit spool evictions == published — zero
 silent loss), a fully-drained uplink at exit (zero deadlocks), and
 subscriber drops bounded by the frame budget. ``make chaos-smoke`` runs
 all three kinds deterministically.
+
+``--faults`` also accepts the r10 output-quality kinds (black_frame,
+frozen_frame, score_drift): the soak then arms the quality tracker at
+soak-scale hysteresis plus a live canary loop and HARD-GATES that every
+injected quality fault was detected (verdict transition within the
+latency bound; canary mismatch + watchdog episode for score_drift) with
+ZERO false-positive verdicts over the clean remainder of the window. The
+quality attribution section is written to ``--quality-out``
+(``QUALITY_r07.json``). ``make quality-smoke`` runs all three.
 """
 
 from __future__ import annotations
@@ -81,10 +90,14 @@ def main(argv=None) -> None:
                          "chrome://tracing; validate with "
                          "tools/obs_export.py --check)")
     ap.add_argument("--faults", default="",
-                    help="comma list of resilience fault kinds for the "
-                         "soak (uplink_down, bus_flap, device_stall), "
-                         "scheduled in disjoint windows; omitted = the "
-                         "default churn plan")
+                    help="comma list of resilience (uplink_down, bus_flap, "
+                         "device_stall) and/or quality (black_frame, "
+                         "frozen_frame, score_drift) fault kinds for the "
+                         "soak, scheduled in disjoint windows; omitted = "
+                         "the default churn plan")
+    ap.add_argument("--quality-out", default="QUALITY_r07.json",
+                    help="quality attribution artifact path (written only "
+                         "when --faults selects quality kinds)")
     ap.add_argument("--profile-on-burn", action="store_true",
                     help="arm obs/prof.py burn-triggered captures in the "
                          "soak engine (soak-scale trigger knobs) and "
@@ -150,26 +163,36 @@ def main(argv=None) -> None:
 
     # -- leg 2: chaos soak ------------------------------------------------
     fault_plan = None
+    quality_kinds: tuple = ()
     if args.faults:
         from video_edge_ai_proxy_tpu.replay.faults import (
-            KINDS, RESILIENCE_KINDS, FaultPlan,
+            KINDS, QUALITY_KINDS, RESILIENCE_KINDS, FaultPlan,
         )
         kinds = [k.strip() for k in args.faults.split(",") if k.strip()]
         bad = sorted(set(kinds) - set(KINDS))
         if bad:
-            ap.error(f"unknown fault kind(s) {bad}; "
-                     f"choose from {sorted(RESILIENCE_KINDS)}")
-        churn = sorted(set(kinds) - set(RESILIENCE_KINDS))
+            ap.error(f"unknown fault kind(s) {bad}; choose from "
+                     f"{sorted(RESILIENCE_KINDS + QUALITY_KINDS)}")
+        churn = sorted(
+            set(kinds) - set(RESILIENCE_KINDS) - set(QUALITY_KINDS))
         if churn:
-            ap.error(f"--faults selects resilience kinds only "
-                     f"({sorted(RESILIENCE_KINDS)}); the churn kinds "
-                     f"{churn} run in the default plan when --faults is "
-                     f"omitted")
-        fault_plan = FaultPlan.resilience(args.duration, kinds=kinds)
+            ap.error(f"--faults selects resilience/quality kinds only "
+                     f"({sorted(RESILIENCE_KINDS + QUALITY_KINDS)}); the "
+                     f"churn kinds {churn} run in the default plan when "
+                     f"--faults is omitted")
+        rkinds = [k for k in kinds if k in RESILIENCE_KINDS]
+        quality_kinds = tuple(k for k in kinds if k in QUALITY_KINDS)
+        if rkinds:
+            fault_plan = FaultPlan.resilience(args.duration, kinds=rkinds)
+        # quality kinds ride through run_fleet_soak(quality_kinds=...),
+        # which schedules them and arms the tracker + canary; with no
+        # resilience kinds selected, fault_plan stays None and the
+        # harness suppresses the churn plan for a clean quality window.
     soak = run_fleet_soak(duration_s=args.duration, src_hw=(h, w),
                           fault_plan=fault_plan,
                           profile_on_burn=args.profile_on_burn,
-                          prof_dir=args.prof_dir or None)
+                          prof_dir=args.prof_dir or None,
+                          quality_kinds=quality_kinds)
     artifact["soak"] = soak
     print(json.dumps({
         "leg": "soak",
@@ -259,6 +282,66 @@ def main(argv=None) -> None:
                     f"{len(triggered)}, errors={prof.get('errors')}, "
                     f"dir={prof.get('dir')}) — the excursion went "
                     "unprofiled")
+    # r10 quality gates: every injected quality fault detected within the
+    # latency bound, ZERO false-positive verdicts anywhere in the soak
+    # window outside the fault windows, and the canary integrity loop
+    # fired (>=1 watchdog episode) iff score_drift was injected.
+    if quality_kinds:
+        quality = soak.get("quality")
+        if not quality:
+            raise SystemExit(
+                "quality failure: quality kinds were requested but the "
+                "soak produced no quality section — tracker never armed")
+        # Bound: soak-scale enter hysteresis (0.6 s) + observation
+        # cadence + verdict-window lag, with CPU-soak scheduling slack.
+        latency_bound_s = 5.0
+        quality["latency_bound_s"] = latency_bound_s
+        print(json.dumps({
+            "leg": "quality",
+            "faults": [
+                {k: f.get(k) for k in (
+                    "kind", "device_id", "detected", "latency_s",
+                    "latency_ticks", "mismatch_cycles")}
+                for f in quality["faults"]
+            ],
+            "false_positives": quality["false_positives"],
+            "canary": {k: (quality["canary"] or {}).get(k) for k in (
+                "loop_len", "match_cycles", "mismatch_cycles",
+                "void_cycles")},
+            "canary_watchdog_episodes":
+                quality["canary_watchdog_episodes"],
+            "latency_bound_s": latency_bound_s,
+        }), flush=True)
+        with open(args.quality_out, "w") as f:
+            json.dump(quality, f, indent=2)
+            f.write("\n")
+        for rep in quality["faults"]:
+            if not rep["detected"]:
+                raise SystemExit(
+                    f"quality failure: injected {rep['kind']} on "
+                    f"{rep['device_id'] or '<global>'} at "
+                    f"{rep['at_s']}s went undetected")
+            if rep["latency_s"] is not None and \
+                    rep["latency_s"] > latency_bound_s:
+                raise SystemExit(
+                    f"quality failure: {rep['kind']} detected but "
+                    f"{rep['latency_s']}s late (bound "
+                    f"{latency_bound_s}s)")
+        if quality["false_positives"]:
+            raise SystemExit(
+                "quality failure: verdict transitions outside every "
+                f"fault window: {quality['false_positives']} — the "
+                "hysteresis is flapping on healthy streams")
+        drift_armed = "score_drift" in quality_kinds
+        episodes = quality["canary_watchdog_episodes"]
+        if drift_armed and episodes < 1:
+            raise SystemExit(
+                "quality failure: score_drift injected but the canary "
+                "integrity loop opened no watchdog episode")
+        if not drift_armed and episodes:
+            raise SystemExit(
+                f"quality failure: {episodes} canary_integrity episodes "
+                "without score_drift injected — false integrity alarm")
     # Chaos gates (ISSUE: zero deadlocks, zero lost annotations, bounded
     # subscriber drops). Reaching this line at all is the deadlock gate's
     # first half; a drained uplink is the second.

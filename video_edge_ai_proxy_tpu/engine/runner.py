@@ -45,13 +45,13 @@ from ..obs.slo import SLOEngine, default_slos
 from ..obs.watch import Watchdog
 from ..ops.nms import batched_nms
 from ..ops.preprocess import (
-    preprocess_classify, preprocess_clip, preprocess_letterbox,
-    unletterbox_boxes,
+    frame_quality_stats, preprocess_classify, preprocess_clip,
+    preprocess_letterbox, unletterbox_boxes,
 )
 from ..proto import pb
 from ..resilience.ladder import RUNGS, DegradationLadder
 from ..utils.config import EngineConfig
-from ..utils.logging import get_logger
+from ..utils.logging import get_logger, reset_log_context, set_log_context
 from .classes import class_name
 from .collector import BatchGroup, Collector
 
@@ -75,11 +75,23 @@ def _rebox(template, values):
     )
 
 
-def build_serving_step(model, spec):
+def build_serving_step(model, spec, *, quality_thumb: int = 0):
     """The per-tick device program for one model kind: uint8 frames in,
     postprocessed results out. SINGLE source of truth — the engine compiles
     it per (geometry, bucket), bench.py times it, __graft_entry__ exposes
-    it, so all three always run the identical program."""
+    it, so all three always run the identical program.
+
+    With ``quality_thumb`` > 0 (engine.quality_thumb config) the returned
+    step takes an optional third argument — the previous tick's [N, th, tw]
+    f32 luma thumbnails (omitted → zeros, so two-arg callers still work) —
+    and its output gains ``quality_stats`` / ``quality_thumbs``
+    (ops/preprocess.py frame_quality_stats), so per-frame health statistics
+    ride the existing result transfer. The default two-argument signature
+    is byte-identical to before, which keeps bench.py, __graft_entry__ and
+    the replay goldens pinning the same program; ``device_checksum`` keys
+    off the detect/embed/classify signature keys and ignores the extras.
+    Clip-input specs (5-d frames) never carry stats — their streams get
+    detections-only verdicts (obs/quality.py)."""
     import jax
 
     size = spec.input_size
@@ -119,22 +131,51 @@ def build_serving_step(model, spec):
             )
             return {"top_probs": top_p, "top_ids": top_i.astype(jnp.int32)}
 
-    return raw
+    if not quality_thumb or spec.clip_len:
+        return raw
+
+    thumb_hw = (quality_thumb, quality_thumb)
+
+    def with_stats(variables, frames_u8, prev_thumbs=None, _raw=raw):
+        import jax.numpy as jnp
+
+        out = dict(_raw(variables, frames_u8))
+        if prev_thumbs is None:
+            # Two-arg call (existing callers, warm-start): diff against a
+            # zero thumbnail; the host tracker discards the first diff
+            # sample anyway (obs/quality.py first-sample rule).
+            prev_thumbs = jnp.zeros(
+                (frames_u8.shape[0],) + thumb_hw, jnp.float32
+            )
+        stats, thumbs = frame_quality_stats(frames_u8, prev_thumbs, thumb_hw)
+        out["quality_stats"] = stats
+        out["quality_thumbs"] = thumbs
+        return out
+
+    return with_stats
 
 
 _RUNG_IDX = {r: i for i, r in enumerate(RUNGS)}
 
 
-def admitted_streams(inferred: Sequence[str]) -> List[str]:
+def admitted_streams(
+    inferred: Sequence[str], deprioritized: Sequence[str] = (),
+) -> List[str]:
     """Degradation-ladder rung 3 (admission_pause): admit a deterministic
     half of the streams — the first half of the sorted id list, so the
     SAME streams stay admitted across ticks (stable batches, no
     membership thrash) and recovery resumes the rest. One stream never
-    pauses (shedding the whole fleet is an outage, not a degradation)."""
-    ids = sorted(inferred)
+    pauses (shedding the whole fleet is an outage, not a degradation).
+
+    ``deprioritized`` streams (quality-unhealthy: black/frozen per
+    obs/quality.py — their frames carry no recoverable signal) sort
+    BEHIND every healthy stream, making them the first-shed candidates;
+    with no deprioritized set the result is byte-identical to before."""
+    dep = set(deprioritized)
+    ids = sorted(inferred, key=lambda d: (d in dep, d))
     if len(ids) <= 1:
         return ids
-    return ids[: (len(ids) + 1) // 2]
+    return sorted(ids[: (len(ids) + 1) // 2])
 
 
 def shed_stale(group: BatchGroup, now_ms: float, max_staleness_ms: float,
@@ -265,18 +306,18 @@ class _TimedStep:
         self._src_hw = src_hw
         self._bucket = bucket
 
-    def __call__(self, variables, frames):
+    def __call__(self, variables, *args):
         if self._aot is None:
             t0 = time.perf_counter()
             try:
-                compiled = self._jit.lower(variables, frames).compile()
+                compiled = self._jit.lower(variables, *args).compile()
             except Exception:
                 # No AOT on this backend/version: time the first jit call
                 # instead (includes one execution — an upper bound, still
                 # the right order of magnitude for compile-storm triage).
                 self._aot = False
                 t0 = time.perf_counter()
-                out = self._jit(variables, frames)
+                out = self._jit(variables, *args)
                 self._perf.note_compile(
                     self._model, self._src_hw, self._bucket,
                     time.perf_counter() - t0, cost={})
@@ -287,10 +328,10 @@ class _TimedStep:
             self._aot = compiled
         if self._aot is not False:
             try:
-                return self._aot(variables, frames)
+                return self._aot(variables, *args)
             except Exception:
                 self._aot = False
-        return self._jit(variables, frames)
+        return self._jit(variables, *args)
 
 
 class InferenceEngine:
@@ -489,6 +530,40 @@ class InferenceEngine:
                 tracer=tracer,
                 snapshot_fn=self._prof_snapshot,
             )
+        # Output-quality observability (obs/quality.py): host verdict
+        # state machines + drift scores fed from _emit; the device side
+        # (frame statistics folded into the serving step) additionally
+        # needs per-stream thumbnail state, which the mesh path does not
+        # shard — single-chip serving only, detections-only verdicts
+        # otherwise. cfg.quality=False disables the whole plane (the
+        # REST endpoint answers 400, same kill-switch convention as
+        # slo/prof).
+        self.quality = None
+        self.canary = None
+        self._canary_thread: Optional[threading.Thread] = None
+        self._thumbs: Dict[str, Any] = {}   # device_id -> [th, tw] f32
+        self._quality_device = False
+        if self._cfg.quality:
+            from ..obs.quality import QualityTracker
+
+            self.quality = QualityTracker(
+                black_luma=self._cfg.quality_black_luma,
+                black_var=self._cfg.quality_black_var,
+                freeze_diff=self._cfg.quality_freeze_diff,
+                enter_s=self._cfg.quality_enter_s,
+                exit_s=self._cfg.quality_exit_s,
+                flatline_s=self._cfg.quality_flatline_s,
+                window_s=self._cfg.quality_window_s,
+                drift_threshold=self._cfg.quality_drift_threshold,
+                on_transition=self._on_quality_transition,
+            )
+            self._quality_device = (
+                self._cfg.quality_thumb > 0 and not self._cfg.mesh)
+            if self._cfg.mesh:
+                log.info(
+                    "quality: device frame stats disabled on mesh "
+                    "serving (thumbnail state is not sharded); "
+                    "detections-only verdicts remain")
 
     # -- lifecycle --
 
@@ -867,11 +942,19 @@ class InferenceEngine:
             target=self._run, name="tpu-engine", daemon=True
         )
         self._thread.start()
+        if self.quality is not None and self._cfg.quality_canary:
+            try:
+                self._start_canary()
+            except Exception:
+                log.exception(
+                    "canary start failed; integrity loop disabled")
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self._canary_thread is not None:
+            self._canary_thread.join(timeout=10)
         if self._drain_thread is not None:
             # Sentinel AFTER the tick loop stops producing: everything
             # queued before it still drains (no result is dropped on a
@@ -898,6 +981,103 @@ class InferenceEngine:
                 q.put(None)
             self._subscribers.clear()
 
+    # -- output-quality plane (obs/quality.py) --
+
+    def _start_canary(self) -> None:
+        """Arm the canary integrity loop: an engine-owned publisher
+        replays the committed golden trace (cfg.quality_canary) into the
+        bus at low cadence under cfg.quality_canary_stream, and the drain
+        thread folds each emitted slot's host checksum into the
+        CanaryChecker, which compares once per trace loop. The canary
+        rides the normal serving path end to end — bus, collector,
+        device program, NMS, drain — so a silent numerics regression
+        anywhere on that path moves the fold and fires the
+        ``canary_integrity`` SLO + watchdog."""
+        from ..obs.quality import CanaryChecker
+        from ..obs.slo import BurnRateSLO, integrity_slo
+        from ..replay.player import TracePlayer
+
+        player = TracePlayer(self._cfg.quality_canary)
+        if not player.devices:
+            raise ValueError(
+                f"canary trace {self._cfg.quality_canary!r} has no streams")
+        events = player.frame_events(player.devices[0])
+        if not events:
+            raise ValueError(
+                f"canary trace {self._cfg.quality_canary!r} has no frames")
+        slo = None
+        if self.slo is not None:
+            slo = self.slo.add(BurnRateSLO(
+                integrity_slo(warmup_s=self._cfg.slo_warmup_s)))
+        self.canary = CanaryChecker(
+            loop_len=len(events),
+            stream=self._cfg.quality_canary_stream,
+            golden=self._cfg.quality_canary_golden or None,
+            watchdog=self.watchdog,
+            slo=slo,
+        )
+        self._canary_thread = threading.Thread(
+            target=self._canary_loop, args=(events,),
+            name="tpu-engine-canary", daemon=True,
+        )
+        self._canary_thread.start()
+
+    def _canary_loop(self, events: list) -> None:
+        """Low-cadence golden-replay publisher (dedicated thread). Frames
+        re-enter through the public bus API like any camera's; publish
+        failures (bus flap, ring full) are logged once per failure run
+        and otherwise skipped — the checker voids incomplete cycles, so
+        dropped canary frames can never manufacture a false mismatch."""
+        from ..replay.player import meta_for
+        from ..replay.trace import decode_frame
+
+        name = self._cfg.quality_canary_stream
+        period = 1.0 / max(self._cfg.quality_canary_fps, 0.1)
+        frame0 = decode_frame(events[0])
+        i = 0
+        alive = False
+        warned = False
+        while not self._stop.wait(period):
+            ev = events[i % len(events)]
+            i += 1
+            try:
+                if not alive:
+                    self._bus.create_stream(name, frame0.nbytes)
+                    alive = True
+                frame = decode_frame(ev)
+                meta = meta_for(
+                    ev, frame, timestamp_ms=int(time.time() * 1000))
+                self._bus.publish(name, frame, meta)
+                warned = False
+            except Exception as exc:
+                alive = False
+                if not warned:
+                    log.warning("canary publish failed: %s", exc)
+                    warned = True
+
+    def _on_quality_transition(self, stream: str, old: str,
+                               new: str) -> None:
+        """Verdict transitions become uplink alert events on the same
+        AnnotateRequest channel the reference's cloud consumes
+        (examples/annotation.py shape): type="quality", the verdict as
+        object_type — black/frozen/flatline onsets AND recoveries reach
+        the cloud side without anything scraping /metrics."""
+        if self._annotations is None:
+            return
+        req = pb.AnnotateRequest(
+            device_name=stream,
+            type="quality",
+            start_timestamp=int(time.time() * 1000),
+            object_type=new,
+            confidence=1.0,
+            ml_model="obs.quality",
+            ml_model_version=old,
+        )
+        try:
+            self._annotations.publish(req.SerializeToString())
+        except Exception:
+            log.exception("quality alert publish failed")
+
     # -- results fan-out --
 
     def _stream_interest(self, device_id: str) -> bool:
@@ -906,7 +1086,12 @@ class InferenceEngine:
         producer, feeding the cloud the reference's clients fed,
         examples/annotation.py); otherwise a live subscriber must cover
         the stream. With neither, inferring would compute results nobody
-        reads — the collector gates the stream out (SURVEY §2.3 P6)."""
+        reads — the collector gates the stream out (SURVEY §2.3 P6).
+        The canary stream's consumer is the integrity checker itself:
+        always of interest while the loop is armed, or its golden-replay
+        frames would never reach the device on a quiet engine."""
+        if self.canary is not None and device_id == self.canary.stream:
+            return True
         if self._annotations is not None:
             return True
         with self._sub_lock:
@@ -1060,9 +1245,11 @@ class InferenceEngine:
         shape = (bucket,) + (
             (self._spec.clip_len,) if self._spec.clip_len else ()
         ) + tuple(src_hw) + (3,)
-        self._step(src_hw, bucket)(
-            self._variables, self._place(np.zeros(shape, np.uint8))
-        )
+        args = [self._place(np.zeros(shape, np.uint8))]
+        if self._quality_device and not self._spec.clip_len:
+            side = self._cfg.quality_thumb
+            args.append(np.zeros((bucket, side, side), np.float32))
+        self._step(src_hw, bucket)(self._variables, *args)
 
     def _place(self, frames: np.ndarray):
         """Shard the batch dim over dp when serving on a mesh; pass through
@@ -1075,6 +1262,21 @@ class InferenceEngine:
 
         return jax.device_put(frames, batch_sharding(self._mesh, frames.ndim))
 
+    def _gather_thumbs(self, group: BatchGroup):
+        """Previous-tick [bucket, th, tw] f32 luma thumbnails for a
+        group's streams, in slot order. First-seen streams (and padded
+        slots) get zeros — the host tracker discards the first diff, so
+        the zero reference never reads as a frozen/unfrozen signal. The
+        per-slot rows are lazy device slices stored at dispatch; stacking
+        stays on device (no host round-trip of thumbnail state)."""
+        import jax.numpy as jnp
+
+        side = self._cfg.quality_thumb
+        zero = np.zeros((side, side), np.float32)
+        rows = [self._thumbs.get(d, zero) for d in group.device_ids]
+        rows.extend([zero] * (group.bucket - len(rows)))
+        return jnp.stack(rows)
+
     def _step(self, src_hw: tuple, bucket: int, model: Optional[str] = None):
         model = model or self._spec.name
         key = (model, src_hw, bucket)
@@ -1086,16 +1288,20 @@ class InferenceEngine:
             import jax
 
             spec, mod, _ = self._ensure_model(model)
-            raw = build_serving_step(mod, spec)
+            raw = build_serving_step(
+                mod, spec,
+                quality_thumb=(self._cfg.quality_thumb
+                               if self._quality_device else 0),
+            )
             if self._cfg.quantize:
                 from ..models.quantize import dequantize_tree
 
                 base = raw
 
-                def raw(qv, frames_u8, _base=base):
+                def raw(qv, *args, _base=base):
                     # Dequantize inside the program: XLA fuses int8*scale
                     # into each weight's first consumer, HBM stays int8.
-                    return _base(dequantize_tree(qv), frames_u8)
+                    return _base(dequantize_tree(qv), *args)
             # Compile attribution (obs/perf.py): the wrapper AOT-compiles
             # on first call, recording wall time + XLA cost analysis per
             # (model, geometry, bucket) — this is the only cache-miss
@@ -1137,8 +1343,24 @@ class InferenceEngine:
                 if rung == "admission_pause":
                     # Rung 3: only the admitted half competes for device
                     # slots; the paused half's workers stop decoding too
-                    # (keep_streams_hot skips them).
-                    inferred = admitted_streams(inferred)
+                    # (keep_streams_hot skips them). Quality-unhealthy
+                    # streams (black/frozen — frames with no recoverable
+                    # signal) are the first-shed candidates; the canary
+                    # is never shed (shedding the integrity probe during
+                    # degradation is when its signal matters most).
+                    dep: frozenset = frozenset()
+                    if (self.quality is not None
+                            and self._cfg.quality_ladder):
+                        dep = self.quality.unhealthy()
+                    canary = (self.canary.stream
+                              if self.canary is not None else None)
+                    if canary is not None:
+                        dep = dep - {canary}
+                    admitted = admitted_streams(inferred, dep)
+                    if (canary is not None and canary in inferred
+                            and canary not in admitted):
+                        admitted.append(canary)
+                    inferred = admitted
                 self._collector.keep_streams_hot(device_ids=inferred)
                 groups = self._collector.collect(device_ids=inferred)
                 if rung != "normal" and groups:
@@ -1176,7 +1398,21 @@ class InferenceEngine:
                             group.model or self._spec.name, group.bucket,
                             group.nbytes, h2d_s,
                         )
-                        outputs = step(variables, placed)
+                        if self._quality_device and group.frames.ndim == 4:
+                            # Quality-carrying step (3-arg): feed last
+                            # tick's per-stream thumbnails, keep this
+                            # tick's on device for the next diff. The
+                            # pop keeps the thumbnails out of _emit's
+                            # D2H fetch — they never cross back to host.
+                            outputs = dict(step(
+                                variables, placed,
+                                self._gather_thumbs(group),
+                            ))
+                            thumbs = outputs.pop("quality_thumbs")
+                            for si, did in enumerate(group.device_ids):
+                                self._thumbs[did] = thumbs[si]
+                        else:
+                            outputs = step(variables, placed)
                     except Exception:
                         for g in groups[gi:]:
                             self._collector.release(g)
@@ -1204,7 +1440,7 @@ class InferenceEngine:
                 # re-creates its ring unlink-then-create — one sample in
                 # that window must not reset the stream's track-id
                 # numbering (invariant in _assign_tracks).
-                if self._trackers or self._ann_state:
+                if self._trackers or self._ann_state or self._thumbs:
                     now = time.monotonic()
                     # GC keys on bus PRESENCE, not on inference_streams():
                     # a live stream gated >grace (inference_model toggled
@@ -1213,7 +1449,8 @@ class InferenceEngine:
                     # already uplinked for other objects.
                     present = set(present)
                     with self._state_lock:
-                        for d in set(self._trackers) | set(self._ann_state):
+                        for d in (set(self._trackers) | set(self._ann_state)
+                                  | set(self._thumbs)):
                             if d in present:
                                 self._tracker_absent.pop(d, None)
                                 continue
@@ -1226,6 +1463,14 @@ class InferenceEngine:
                                 # state, but a re-added stream must not
                                 # diff against a months-old signature.
                                 self._ann_state.pop(d, None)
+                                # Quality state too: the device-resident
+                                # thumbnail and the verdict machine both
+                                # restart cleanly when the stream does
+                                # (the tracker re-discards its first
+                                # zero-reference diff).
+                                self._thumbs.pop(d, None)
+                                if self.quality is not None:
+                                    self.quality.forget(d)
                                 del self._tracker_absent[d]
             except Exception:
                 log.exception("engine tick failed; continuing")
@@ -1410,59 +1655,103 @@ class InferenceEngine:
         now_ms = int(t_drained * 1000)
         for i, device_id in enumerate(group.device_ids):
             meta = group.metas[i]
-            detections = self._to_detections(host, i, spec)
-            if self._cfg.track and spec.kind == "detect":
-                # Unconditionally — empty frames MUST reach the tracker so
-                # misses accumulate and stale tracks expire; skipping them
-                # would freeze old tracks and hand their ids to the next
-                # object that appears nearby.
-                self._assign_tracks(device_id, spec.name, detections)
-            latency = max(0.0, now_ms - meta.timestamp_ms) if meta.timestamp_ms else 0.0
-            result = pb.InferenceResult(
-                device_id=device_id,
-                timestamp=meta.timestamp_ms,
-                model=spec.name,
-                model_version="0",
-                detections=detections,
-                latency_ms=latency,
-                batch_size=group.bucket,
-                frame_packet=meta.packet,
-            )
-            self._publish(result)
-            if self._cfg.stage_trace:
-                self.stage_records.append({
-                    "device_id": device_id,
-                    "ts_pub_ms": meta.timestamp_ms,
-                    "t_collect": inflight.t_collect,
-                    "t_submit": inflight.t_submit,
-                    "t_drain0": t_drain0,
-                    "t_drained": t_drained,
-                    "t_emitted": time.time(),
-                    "bucket": group.bucket,
-                })
-            self._annotate(device_id, meta, detections, spec)
-            st = self._stats.setdefault(device_id, StreamStats())
-            st.frames += 1
-            st.note_latency(latency)
-            st.last_batch = group.bucket
-            st.note_device(device_ms, group.padded_slots)
-            st.last_emit_mono = time.monotonic()
-            if slo_latency is not None and meta.timestamp_ms:
-                # p50 detect-latency SLI: one good/bad event per emitted
-                # detect frame (objective 0.5 == the p50 target).
-                ok = latency <= self._cfg.slo_latency_ms
-                slo_latency.record(good=1.0 if ok else 0.0,
-                                   bad=0.0 if ok else 1.0)
-            self._m_frames.labels(device_id).inc()
-            self._m_latency.labels(device_id).observe(latency)
-            if latency > self._cfg.obs_late_ms:
-                self._m_late.labels(device_id).inc()
-            if tracer.sampled(meta.packet):
-                tracer.record(
-                    device_id, "device", meta.packet, ts=t_drained,
-                    dur_ms=device_ms, bucket=group.bucket,
+            # Structured log correlation: every record logged while this
+            # slot emits (tracker, annotate, publish, quality) carries
+            # stream=<id> seq=<packet> (utils/logging.py injector).
+            ctx = set_log_context(stream=device_id, seq=meta.packet)
+            try:
+                self._emit_slot(
+                    inflight, host, i, device_id, meta, spec, now_ms,
+                    device_ms, slo_latency, t_drain0, t_drained,
                 )
-                tracer.record(device_id, "emit", meta.packet)
+            finally:
+                reset_log_context(ctx)
+
+    def _emit_slot(self, inflight, host, i, device_id, meta, spec, now_ms,
+                   device_ms, slo_latency, t_drain0, t_drained) -> None:
+        group = inflight.group
+        detections = self._to_detections(host, i, spec)
+        if self._cfg.track and spec.kind == "detect":
+            # Unconditionally — empty frames MUST reach the tracker so
+            # misses accumulate and stale tracks expire; skipping them
+            # would freeze old tracks and hand their ids to the next
+            # object that appears nearby.
+            self._assign_tracks(device_id, spec.name, detections)
+        if self.quality is not None:
+            self._observe_quality(host, i, device_id, meta, detections)
+        latency = max(0.0, now_ms - meta.timestamp_ms) if meta.timestamp_ms else 0.0
+        result = pb.InferenceResult(
+            device_id=device_id,
+            timestamp=meta.timestamp_ms,
+            model=spec.name,
+            model_version="0",
+            detections=detections,
+            latency_ms=latency,
+            batch_size=group.bucket,
+            frame_packet=meta.packet,
+        )
+        self._publish(result)
+        if self._cfg.stage_trace:
+            self.stage_records.append({
+                "device_id": device_id,
+                "ts_pub_ms": meta.timestamp_ms,
+                "t_collect": inflight.t_collect,
+                "t_submit": inflight.t_submit,
+                "t_drain0": t_drain0,
+                "t_drained": t_drained,
+                "t_emitted": time.time(),
+                "bucket": group.bucket,
+            })
+        self._annotate(device_id, meta, detections, spec)
+        st = self._stats.setdefault(device_id, StreamStats())
+        st.frames += 1
+        st.note_latency(latency)
+        st.last_batch = group.bucket
+        st.note_device(device_ms, group.padded_slots)
+        st.last_emit_mono = time.monotonic()
+        if slo_latency is not None and meta.timestamp_ms:
+            # p50 detect-latency SLI: one good/bad event per emitted
+            # detect frame (objective 0.5 == the p50 target).
+            ok = latency <= self._cfg.slo_latency_ms
+            slo_latency.record(good=1.0 if ok else 0.0,
+                               bad=0.0 if ok else 1.0)
+        self._m_frames.labels(device_id).inc()
+        self._m_latency.labels(device_id).observe(latency)
+        if latency > self._cfg.obs_late_ms:
+            self._m_late.labels(device_id).inc()
+        if tracer.sampled(meta.packet):
+            tracer.record(
+                device_id, "device", meta.packet, ts=t_drained,
+                dur_ms=device_ms, bucket=group.bucket,
+            )
+            tracer.record(device_id, "emit", meta.packet)
+
+    def _observe_quality(self, host: dict, i: int, device_id: str,
+                         meta: FrameMeta, detections) -> None:
+        """Fold one emitted slot into the quality plane: the device
+        frame statistics (when the step carried them — mesh/clip paths
+        don't), the detection set for flatline + drift scoring, and —
+        for the canary stream — the host-side content checksum into the
+        integrity checker (replay/checksum.py host_slot_checksum)."""
+        kwargs = {}
+        qs = host.get("quality_stats")
+        if qs is not None:
+            kwargs = {
+                "luma_mean": float(qs[i, 0]),
+                "luma_var": float(qs[i, 1]),
+                "diff_energy": float(qs[i, 2]),
+            }
+        self.quality.observe(
+            device_id,
+            classes=[d.class_id for d in detections],
+            scores=[d.confidence for d in detections],
+            **kwargs,
+        )
+        if (self.canary is not None and device_id == self.canary.stream
+                and "boxes" in host):
+            from ..replay.checksum import host_slot_checksum
+
+            self.canary.note(meta.packet, host_slot_checksum(host, i))
 
     def _assign_tracks(self, device_id: str, model: str, detections) -> None:
         """Per-stream SORT-style association (engine/tracker.py): fills

@@ -41,7 +41,7 @@ from typing import Optional
 
 from ..bus import FrameBus, FrameMeta, RingSlotTooSmall, open_bus
 from ..obs import registry as obs_registry, tracer
-from ..utils.logging import get_logger
+from ..utils.logging import get_logger, set_log_context
 from .archive import GopSegment, PacketGopSegment, SegmentArchiver
 from .sources import VideoSource, open_source
 
@@ -326,6 +326,7 @@ class IngestWorker:
 
     def run(self) -> None:
         cfg = self.cfg
+        set_log_context(stream=cfg.device_id)
         try:
             self.source.open()
         except ConnectionError as exc:
@@ -439,6 +440,12 @@ class IngestWorker:
 
                 self._packets += 1
                 self._m_packets.inc()
+                # Log correlation (utils/logging.py): every record logged
+                # while this packet is handled — decode, archive, publish,
+                # ring growth — carries stream=<id> seq=<packet>. The
+                # worker thread is dedicated to this stream, so the
+                # context is overwritten per packet, never reset.
+                set_log_context(stream=cfg.device_id, seq=pkt.packet)
                 if pkt.is_corrupt:
                     self._m_corrupt.inc()
                 if pkt.is_keyframe:

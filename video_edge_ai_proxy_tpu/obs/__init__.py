@@ -3,7 +3,7 @@ stall/watermark detection (ISSUE r7 tentpole), live device-performance
 attribution and SLO burn-rate evaluation (ISSUE r9 tentpole).
 
 Pure-Python, jax-free at import, importable from control-plane and worker
-code alike. Six modules:
+code alike. Seven modules:
 
 - :mod:`metrics` — process-wide counters/gauges/log2-histograms, rendered
   once by ``/metrics`` (Prometheus 0.0.4) and ``/api/v1/stats`` (JSON).
@@ -22,12 +22,19 @@ code alike. Six modules:
   ``/api/v1/profile`` + gRPC admin mirror, or fired automatically when an
   SLO episode opens / the degradation ladder escalates) written as
   self-contained bundles into a byte-bounded retention ring.
+- :mod:`quality` — output-quality observability: per-stream black /
+  frozen / flatline verdict state machines fed by device-computed frame
+  statistics, detection drift scores vs committed baselines, and the
+  canary golden-replay integrity check (``vep_quality_*`` /
+  ``/api/v1/quality``), feeding the degradation ladder's first-shed set
+  and the ``canary_integrity`` SLO.
 """
 
 from .metrics import Registry, registry
 from .perf import PerfTracker, cost_summary, mfu_pct
 from .prof import Profiler
-from .slo import BurnRateSLO, SLOEngine, SLOSpec, default_slos
+from .quality import CanaryChecker, QualityTracker
+from .slo import BurnRateSLO, SLOEngine, SLOSpec, default_slos, integrity_slo
 from .spans import SpanRecorder, stage_breakdown, to_chrome_trace, tracer
 from .watch import Watchdog
 
@@ -36,12 +43,15 @@ __all__ = [
     "registry",
     "PerfTracker",
     "Profiler",
+    "CanaryChecker",
+    "QualityTracker",
     "cost_summary",
     "mfu_pct",
     "BurnRateSLO",
     "SLOEngine",
     "SLOSpec",
     "default_slos",
+    "integrity_slo",
     "SpanRecorder",
     "stage_breakdown",
     "to_chrome_trace",

@@ -252,6 +252,23 @@ def default_slos(*, latency_ms: float = 40.0, target_fps: float = 1000.0,
     )
 
 
+def integrity_slo(*, warmup_s: float = 60.0) -> SLOSpec:
+    """The canary-integrity objective (obs/quality.py CanaryChecker):
+    virtually every golden-replay cycle must reproduce the committed
+    result checksum. Cycles are rare events (one per trace loop, a
+    handful per fast window), so a single mismatch burns far above 1.0
+    and fires as soon as the window is covered — integrity failures are
+    binary, not budgeted like latency."""
+    return SLOSpec(
+        name="canary_integrity",
+        objective=0.99,
+        description="canary golden-replay cycles matching the committed "
+                    "result checksum",
+        fire_burn_rate=1.0,
+        warmup_s=warmup_s,
+    )
+
+
 class SLOEngine:
     """A set of burn-rate SLOs with one evaluate/snapshot surface.
 

@@ -166,3 +166,47 @@ def unletterbox_boxes(
         dtype=boxes_xyxy.dtype,
     )
     return (boxes_xyxy - shift) / params.scale
+
+
+# BT.601 luma weights in the bus frame's BGR plane order (channel 0 = B,
+# see module docstring — frames cross the bus as raw BGR24).
+_LUMA_BGR = (0.114, 0.587, 0.299)
+
+
+def frame_quality_stats(
+    frames_u8: jnp.ndarray,
+    prev_thumbs: jnp.ndarray,
+    thumb_hw: tuple[int, int],
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Device-side frame-health statistics for obs/quality.py.
+
+    [N, H, W, 3] uint8 BGR + the previous tick's [N, th, tw] f32 luma
+    thumbnails -> (stats [N, 3] f32, thumbs [N, th, tw] f32) where the
+    stats columns are (luma_mean, luma_var, diff_energy):
+
+    - ``luma_mean`` / ``luma_var`` — mean and variance of the downsampled
+      luma plane in [0, 1] (black-frame detection; thumbnail-domain, so
+      the variance is a smoothed lower bound of the full-res one — the
+      host thresholds in utils/config.py are calibrated to that).
+    - ``diff_energy`` — MSE between this frame's thumbnail and the
+      per-stream thumbnail carried as device state across ticks
+      (frozen-feed detection, and the per-stream motion-gating signal
+      MOSAIC-style ROI multiplexing needs, ROADMAP item 1).
+
+    Folded into the serving step (engine/runner.py build_serving_step)
+    so the stats ride the existing result transfer: all f32 (norm-stat
+    convention), static shapes per (geometry, bucket), the luma
+    reduction fuses into the MXU resize matmuls (resize_bilinear_mxu),
+    and the [N, th, tw] thumbnail is the only extra device-resident
+    state. The previous thumbnail of a stream's first frame is zeros;
+    the host tracker discards that first diff.
+    """
+    w = jnp.asarray(_LUMA_BGR, jnp.float32)
+    y = jnp.einsum("nhwc,c->nhw", frames_u8.astype(jnp.float32), w)
+    y = y * (1.0 / 255.0)
+    thumbs = resize_bilinear_mxu(y[..., None], thumb_hw)[..., 0]
+    mean = jnp.mean(thumbs, axis=(1, 2))
+    var = jnp.var(thumbs, axis=(1, 2))
+    diff = jnp.mean(
+        jnp.square(thumbs - prev_thumbs.astype(jnp.float32)), axis=(1, 2))
+    return jnp.stack([mean, var, diff], axis=-1), thumbs

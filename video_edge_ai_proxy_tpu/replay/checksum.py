@@ -89,6 +89,32 @@ def finalize_checksum(total) -> int:
     return int(total) & CHECKSUM_MASK
 
 
+def host_slot_checksum(host: dict, i: int) -> int:
+    """One batch slot of an already-fetched detect output -> masked int.
+
+    Host-side (numpy) twin of the detect branch of ``device_checksum``,
+    used by the canary integrity loop (obs/quality.py CanaryChecker) on
+    the engine's drain thread: same quantization (boxes rounded to px,
+    scores to 1e-3) and weights, accumulated in Python ints and masked
+    to 2^31. The canary golden is DEFINED by this fold (recorded and
+    compared through the same code path), so it does not need to match a
+    device-folded value bit-for-bit — only to be deterministic for
+    identical results, which integer math is.
+    """
+    import numpy as np
+
+    valid = np.asarray(host["valid"][i]).astype(bool)
+    boxes = np.round(
+        np.asarray(host["boxes"][i], np.float64)[valid]).astype(np.int64)
+    cls = np.asarray(host["classes"][i], np.int64)[valid]
+    scores = np.round(
+        np.asarray(host["scores"][i], np.float64)[valid] * 1000.0
+    ).astype(np.int64)
+    s = int((boxes * np.asarray(_BOX_W, np.int64)).sum()
+            + (_CLS_W * cls + _SCORE_W * scores).sum())
+    return s & CHECKSUM_MASK
+
+
 def zero_class_prior(variables):
     """Zero the detection head's class-prior biases for BENCH programs.
 

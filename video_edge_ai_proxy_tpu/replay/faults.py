@@ -26,6 +26,15 @@ them (replay/harness.py):
 - ``device_stall`` — every device step call slows for ``duration_s``
   (a contended/thermal-throttled chip: sustained tick-budget overrun
   must walk the engine's degradation ladder, then recover).
+- ``black_frame`` — one camera publishes all-zero frames for
+  ``duration_s`` (lens cap / dead sensor: obs/quality.py must verdict
+  the stream "black" within the hysteresis bound, then recover it).
+- ``frozen_frame`` — one camera republishes the same frame for
+  ``duration_s`` (a wedged decoder/DVR loop: the device diff-energy
+  signal must drive a "frozen" verdict, then recover).
+- ``score_drift`` — every detect step's scores are scaled down for
+  ``duration_s`` (silent model/numerics regression: the drift scorer
+  must move and the canary checksum must mismatch while it lasts).
 
 JSON round-trip so plans can be committed next to artifacts.
 """
@@ -38,6 +47,7 @@ from dataclasses import asdict, dataclass, field
 KINDS = (
     "camera_kill", "camera_restore", "frame_gap", "bus_stall",
     "slow_subscriber", "uplink_down", "bus_flap", "device_stall",
+    "black_frame", "frozen_frame", "score_drift",
 )
 
 #: Schedule template for the resilience kinds (fraction of the soak
@@ -52,6 +62,24 @@ _RESILIENCE_WINDOWS = {
 #: The kinds `tools/soak_replay.py --faults` may select (the churn kinds
 #: need per-device scheduling and run via default_churn instead).
 RESILIENCE_KINDS = tuple(_RESILIENCE_WINDOWS)
+
+#: Schedule template for the output-quality kinds (ISSUE r10): black and
+#: frozen run on DISTINCT cameras (per-device targeting), drift is
+#: global (a step-wrapper perturbation), so their windows may overlap —
+#: but they stay disjoint anyway so the detection-latency gate in
+#: tools/soak_replay.py attributes each verdict to one cause, and each
+#: window leaves recovery slack for the exit-hysteresis to clear.
+#: score_drift gets the widest slot: the canary judges integrity one
+#: full checksum cycle at a time (loop_len / canary fps ≈ 3 s in the
+#: soak harness), so the drift must stay up long enough for at least
+#: one complete cycle — ideally two — to close inside it.
+_QUALITY_WINDOWS = {
+    "black_frame": (0.10, 0.20),
+    "frozen_frame": (0.35, 0.20),
+    "score_drift": (0.58, 0.35),
+}
+
+QUALITY_KINDS = tuple(_QUALITY_WINDOWS)
 
 
 @dataclass(order=True)
@@ -142,6 +170,38 @@ class FaultPlan:
             frac, dur = _RESILIENCE_WINDOWS[kind]
             ev.append(FaultEvent(
                 at_s=duration_s * frac, kind=kind,
+                duration_s=max(1.0, duration_s * dur),
+            ))
+        return cls(ev)
+
+    @classmethod
+    def quality(
+        cls, duration_s: float, device_ids,
+        kinds=QUALITY_KINDS,
+    ) -> "FaultPlan":
+        """The quality-smoke script: black on the first camera, frozen
+        on the second (distinct targets — both verdicts must fire
+        independently), score_drift global, each in its _QUALITY_WINDOWS
+        slot scaled to the soak length."""
+        devs = sorted(device_ids)
+        if not devs:
+            raise ValueError("quality fault plan needs at least one camera")
+        target = {
+            "black_frame": devs[0],
+            "frozen_frame": devs[1 % len(devs)],
+            "score_drift": "",
+        }
+        ev = []
+        for kind in kinds:
+            if kind not in _QUALITY_WINDOWS:
+                raise ValueError(
+                    f"not a quality fault kind: {kind!r} "
+                    f"(choose from {sorted(_QUALITY_WINDOWS)})"
+                )
+            frac, dur = _QUALITY_WINDOWS[kind]
+            ev.append(FaultEvent(
+                at_s=duration_s * frac, kind=kind,
+                device_id=target[kind],
                 duration_s=max(1.0, duration_s * dur),
             ))
         return cls(ev)

@@ -41,7 +41,7 @@ from .checksum import (
     finalize_checksum,
     zero_class_prior,
 )
-from .faults import FaultPlan
+from .faults import QUALITY_KINDS, FaultPlan
 from .player import TracePlayer, meta_for
 from .recorder import record_synthetic_trace
 from .trace import decode_frame
@@ -207,6 +207,15 @@ class _ReplayCamera(threading.Thread):
         self.stop_ev = stop
         self.killed = threading.Event()
         self.gap_until = 0.0
+        # Output-quality faults (ISSUE r10): while black_until is open the
+        # camera publishes all-zero frames (lens cap / dead sensor); while
+        # frozen_until is open it republishes the window's first frame (a
+        # wedged decoder). Both keep the publish cadence — the stream
+        # stays live, only its CONTENT degrades, which is exactly the
+        # failure class obs/quality.py exists to see.
+        self.black_until = 0.0
+        self.frozen_until = 0.0
+        self._frozen_frame = None
         self.published = 0
         self.suppressed = 0
 
@@ -242,6 +251,15 @@ class _ReplayCamera(threading.Thread):
                 self.suppressed += 1
                 continue
             frame = decode_frame(ev)
+            now_mono = time.monotonic()
+            if now_mono < self.black_until:
+                frame = np.zeros_like(frame)
+            elif now_mono < self.frozen_until:
+                if self._frozen_frame is None:
+                    self._frozen_frame = frame
+                frame = self._frozen_frame
+            else:
+                self._frozen_frame = None
             meta = meta_for(ev, frame, timestamp_ms=int(time.time() * 1000))
             try:
                 self.bus.publish(self.device_id, frame, meta)
@@ -268,6 +286,7 @@ def run_fleet_soak(
     warmup_timeout_s: float = 1800.0, sample_every_s: float = 2.0,
     timeline_bin_s: float = 10.0, trace_sample_every: int = 4,
     profile_on_burn: bool = False, prof_dir: Optional[str] = None,
+    quality_kinds: tuple = (),
 ) -> dict:
     """The >=120 s chaos soak. Returns the artifact's "soak" section.
 
@@ -277,6 +296,22 @@ def run_fleet_soak(
     captures, 5 s rate limit — a 20 s smoke must be able to catch its
     own excursion). The bundle manifests land in the artifact's "prof"
     section; tools/soak_replay.py --profile-on-burn hard-gates on them.
+
+    ``quality_kinds`` (ISSUE r11) schedules output-quality faults
+    (replay/faults.py QUALITY_KINDS: black_frame on the first camera,
+    frozen_frame on the second, a global score_drift) and arms the full
+    quality plane at soak scale: tight verdict hysteresis (0.6 s), a
+    recorded canary golden-replay trace wired into the live engine
+    (adopt-first-cycle golden), the detect class prior zeroed so the
+    fleet produces real detections (bench.py's measured-regime
+    transform — a random-init detector would otherwise emit nothing
+    and neither drift nor the canary fold would have signal). The
+    artifact gains a "quality" section: per-fault detection latency
+    (first matching verdict transition / canary integrity episode after
+    injection, in seconds and engine ticks) and the false-positive
+    count over everything outside the fault windows. Without quality
+    faults the tracker still runs (engine default) — the plain soak
+    doubles as the zero-false-positive clean window.
     """
     import shutil
     import tempfile
@@ -349,6 +384,32 @@ def run_fleet_soak(
 
     if profile_on_burn and prof_dir is None:
         prof_dir = tempfile.mkdtemp(prefix="vep_soak_prof_")
+    has_quality = bool(quality_kinds)
+    qcfg = {}
+    if has_quality:
+        # Soak-scale quality knobs: verdicts must enter/exit within a
+        # 20 s smoke, and the drift window must roll several times. The
+        # canary trace shares the fleet geometry so its batches slot
+        # into already-compiled programs (and already-warm buckets).
+        canary_trace = os.path.join(
+            "/tmp", f"vep_canary_{os.getpid()}.vtrace")
+        record_synthetic_trace(
+            canary_trace, ["_canary"], width=w, height=h, fps=fps,
+            gop=6, frames=6)
+        qcfg = dict(
+            quality_enter_s=0.6,
+            quality_exit_s=0.6,
+            quality_window_s=2.0,
+            quality_canary=canary_trace,
+            # Slow deliberately: the canary is an integrity probe, not a
+            # throughput probe. Injected faster than the loaded engine's
+            # effective tick, frames overwrite in the collector slot and
+            # every cycle voids (a dropped packet makes the checksum
+            # meaningless, so the checker refuses to judge it). 2 fps
+            # over a 6-frame loop = one integrity verdict every 3 s,
+            # which even the saturated CPU soak serves losslessly.
+            quality_canary_fps=2.0,
+        )
     eng = InferenceEngine(
         bus,
         EngineConfig(
@@ -368,7 +429,9 @@ def run_fleet_soak(
             prof_trigger=profile_on_burn,
             prof_trigger_ms=200,
             prof_trigger_min_interval_s=5.0,
-            slo_warmup_s=(10.0 if profile_on_burn else 60.0),
+            slo_warmup_s=(
+                10.0 if (profile_on_burn or has_quality) else 60.0),
+            **qcfg,
         ),
         model_resolver=lambda d: assignment.get(d, ""),
         annotations=ann_q,
@@ -378,7 +441,12 @@ def run_fleet_soak(
     # call eats ~50 ms of fake device time. Per-call (not one long
     # block) so consecutive over-budget ticks build the SUSTAINED
     # pressure the ladder's escalate hysteresis requires.
+    # score_drift fault: while its window is open every detect batch's
+    # post-NMS scores are scaled ×0.75 — a SILENT numerics regression
+    # (boxes intact, counts intact, just confidences off), the failure
+    # class only the canary checksum + drift scorer can see.
     stall = {"until": 0.0}
+    drift = {"until": 0.0}
     _orig_step = eng._step
 
     def _stalled_step(src_hw, bucket, model=None):
@@ -387,12 +455,29 @@ def run_fleet_soak(
         def slow(*a, **k):
             if time.monotonic() < stall["until"]:
                 time.sleep(0.05)
-            return fn(*a, **k)
+            out = fn(*a, **k)
+            if time.monotonic() < drift["until"] and "scores" in out:
+                out = dict(out)
+                out["scores"] = out["scores"] * 0.75
+            return out
 
         return slow
 
     eng._step = _stalled_step
     eng.warmup()
+    if has_quality:
+        # Measured-regime transform (replay/checksum.py zero_class_prior,
+        # the bench.py idiom): random-init detect scores sit at ~1e-5,
+        # below the NMS floor — zero detections means no drift signal
+        # and an all-zero canary fold. Zeroing the class-prior biases
+        # saturates the candidate sets so scores/classes carry real,
+        # content-dependent numerics for the canary to pin.
+        entry = eng._models.get(default_model)
+        if entry is not None and entry[0].kind == "detect":
+            spec0, mod0, vars0 = entry
+            vars0 = zero_class_prior(vars0)
+            eng._models[default_model] = (spec0, mod0, vars0)
+            eng._variables = vars0
     eng.start()
 
     stop = threading.Event()
@@ -444,14 +529,53 @@ def run_fleet_soak(
             break
         time.sleep(1.0)
     warmup_s = warmup_timeout_s - (warm_deadline - time.monotonic())
+    # Prewarm every bucket the degradation ladder can downshift to. The
+    # warmup traffic only compiles each model's nominal bucket; the first
+    # downshift then pays a mid-soak CPU compile that stalls the tick
+    # loop for seconds — blanking quality sampling exactly when the
+    # overload (and the scripted faults) hit. Compile them all now, in
+    # the window the measurement already excludes.
+    model_counts: dict = {}
+    for mname in assignment.values():
+        model_counts[mname] = model_counts.get(mname, 0) + 1
+    for mname, count in model_counts.items():
+        spec_m, _, vars_m = eng._ensure_model(mname)
+        if spec_m.clip_len:
+            continue
+        for b in eng._cfg.batch_buckets:
+            args = [np.zeros((b, h, w, 3), np.uint8)]
+            if eng._quality_device:
+                side = eng._cfg.quality_thumb
+                args.append(np.zeros((b, side, side), np.float32))
+            eng._step((h, w), b, mname)(vars_m, *args)
+            if b >= count:
+                break
     eng.stage_records.clear()
     # The measured window starts clean: warmup compiles would otherwise
     # register as recompile-storm episodes and skew the span breakdown.
     tracer.clear()
     eng.watchdog.reset()
+    if eng.quality is not None:
+        # Warmup frames (one per camera, then silence) would otherwise
+        # seep into the measured window as flatline/freeze priors. The
+        # canary is NOT reset: the golden it adopted from warmup cycles
+        # is exactly the reference the measured window checks against.
+        eng.quality.reset()
 
-    plan = fault_plan if fault_plan is not None else \
-        FaultPlan.default_churn(sorted(assignment), duration_s)
+    if fault_plan is not None:
+        events = list(fault_plan.events)
+    elif has_quality:
+        # Quality smoke runs without the churn script: camera kills and
+        # bus stalls would starve the very streams whose verdicts the
+        # detection-latency gate is timing.
+        events = []
+    else:
+        events = list(
+            FaultPlan.default_churn(sorted(assignment), duration_s).events)
+    if has_quality:
+        events += FaultPlan.quality(
+            duration_s, sorted(assignment), quality_kinds).events
+    plan = FaultPlan(events)
     plan.reset()
 
     measuring.set()
@@ -508,6 +632,14 @@ def run_fleet_soak(
                 bus.flap_for(ev.duration_s)
             elif ev.kind == "device_stall":
                 stall["until"] = time.monotonic() + ev.duration_s
+            elif ev.kind == "black_frame":
+                cams[ev.device_id].black_until = \
+                    time.monotonic() + ev.duration_s
+            elif ev.kind == "frozen_frame":
+                cams[ev.device_id].frozen_until = \
+                    time.monotonic() + ev.duration_s
+            elif ev.kind == "score_drift":
+                drift["until"] = time.monotonic() + ev.duration_s
         if now_s >= next_sample:
             step_cache_samples.append(
                 {"t_s": round(now_s, 1), "programs": len(eng._step_cache)})
@@ -534,7 +666,11 @@ def run_fleet_soak(
             "events": len(span_events),
             "streams": len(tracer.streams()),
         },
+        "quality": eng.quality.snapshot() if eng.quality is not None
+        else None,
     }
+    canary_snapshot = eng.canary.snapshot() if eng.canary is not None \
+        else None
     tracer.configure(enabled=prev_trace[0], sample_every=prev_trace[1])
     ladder_snapshot = eng.ladder.snapshot() if eng.ladder is not None else None
     shed_frames = eng.shed_frames
@@ -568,6 +704,11 @@ def run_fleet_soak(
     ann_q.stop()
     spool_snapshot = ann_spool.snapshot()
     shutil.rmtree(spool_dir, ignore_errors=True)
+    if has_quality:
+        try:
+            os.unlink(canary_trace)
+        except OSError:
+            pass
     # Conservation: everything the engine enqueued was delivered exactly
     # once, minus only explicit spool evictions (bounded spool) — no
     # silent loss anywhere in queue -> handler -> spool -> drain.
@@ -592,6 +733,72 @@ def run_fleet_soak(
             "conserved": conserved,
         },
     }
+
+    # Quality-fault attribution (ISSUE r10): for each injected quality
+    # fault, find the verdict transition (or canary mismatch) that
+    # answers it, and time it in ticks. Transitions carry the tracker's
+    # monotonic clock, faults_applied carries offsets from t0 — same
+    # clock, so the subtraction is exact. Any non-ok transition outside
+    # every expected window is a false positive (the clean remainder of
+    # the soak doubles as the zero-false-positive window).
+    quality_section = None
+    if has_quality and obs_section["quality"] is not None:
+        qsnap = obs_section["quality"]
+        enter_s = qcfg["quality_enter_s"]
+        exit_s = qcfg["quality_exit_s"]
+        verdict_for = {"black_frame": "black", "frozen_frame": "frozen"}
+        expected: dict[str, list] = {}
+        fault_reports = []
+        episodes = obs_section["watch"].get("episodes", {})
+        canary_episodes = episodes.get("canary_integrity", 0)
+        for f in faults_applied:
+            if f["kind"] not in verdict_for and f["kind"] != "score_drift":
+                continue
+            fault_mono = t0 + f["at_s"]
+            report = dict(f)
+            if f["kind"] == "score_drift":
+                # Untimestamped by design (cycle accounting, not wall
+                # time): detection = the canary mismatched and opened a
+                # watchdog episode while the window was live.
+                mism = (canary_snapshot or {}).get("mismatch_cycles", 0)
+                report["detected"] = bool(mism and canary_episodes)
+                report["mismatch_cycles"] = mism
+                report["latency_s"] = None
+                report["latency_ticks"] = None
+            else:
+                want = verdict_for[f["kind"]]
+                trans = qsnap["streams"].get(
+                    f["device_id"], {}).get("transitions", [])
+                hit = next(
+                    (t for t, v in trans
+                     if v == want and t >= fault_mono - 0.5), None)
+                report["detected"] = hit is not None
+                report["latency_s"] = (
+                    round(hit - fault_mono, 3) if hit is not None else None)
+                report["latency_ticks"] = (
+                    int(round((hit - fault_mono) / (tick_ms / 1000.0)))
+                    if hit is not None else None)
+                expected.setdefault(f["device_id"], []).append(
+                    (fault_mono - 0.5,
+                     fault_mono + f["duration_s"] + enter_s + exit_s + 3.0))
+            fault_reports.append(report)
+        false_positives = []
+        for name, st in qsnap["streams"].items():
+            for t, v in st["transitions"]:
+                if v == "ok":
+                    continue
+                if any(lo <= t <= hi for lo, hi in expected.get(name, ())):
+                    continue
+                false_positives.append(
+                    {"stream": name, "verdict": v,
+                     "at_s": round(t - t0, 2)})
+        quality_section = {
+            "faults": fault_reports,
+            "false_positives": false_positives,
+            "canary": canary_snapshot,
+            "canary_watchdog_episodes": canary_episodes,
+            "tick_ms": tick_ms,
+        }
 
     bucket_fill_timeline = [
         {
@@ -642,6 +849,7 @@ def run_fleet_soak(
         "perf": perf_section,
         "slo": slo_section,
         "prof": prof_section,
+        "quality": quality_section,
     }
 
 

@@ -203,6 +203,11 @@ def build_app(
             # merge with tools/obs_export.py --merge).
             "prof": engine.prof.snapshot()
             if engine is not None and engine.prof is not None else None,
+            # r10 output-quality: per-stream verdicts + drift state (the
+            # same snapshot /api/v1/quality serves; validate with
+            # tools/obs_export.py --check).
+            "quality": engine.quality.snapshot()
+            if engine is not None and engine.quality is not None else None,
         }
         return web.json_response(out)
 
@@ -215,6 +220,23 @@ def build_app(
         if engine.slo is None:
             return _error(400, "SLO engine disabled (engine.slo config)")
         return web.json_response(engine.slo.snapshot())
+
+    async def quality(_request: web.Request) -> web.Response:
+        """Per-stream output-quality verdicts (obs/quality.py): frame
+        health state machines (black/frozen/flatline with hysteresis),
+        detection-drift scores, and the live canary integrity loop's
+        cycle accounting. 400 when quality tracking is disabled
+        (engine.quality config, same kill-switch convention as
+        /api/v1/slo and /api/v1/profile)."""
+        if engine is None:
+            return _error(400, "engine not running")
+        if engine.quality is None:
+            return _error(
+                400, "quality tracking disabled (engine.quality config)")
+        out = await asyncio.to_thread(engine.quality.snapshot)
+        out["canary"] = (engine.canary.snapshot()
+                        if engine.canary is not None else None)
+        return web.json_response(out)
 
     async def trace(request: web.Request) -> web.Response:
         """Live frame-lineage query (obs/spans.py): buffered span events,
@@ -429,6 +451,7 @@ def build_app(
     app.router.add_post("/api/v1/settings", settings_overwrite)
     app.router.add_get("/api/v1/stats", stats)
     app.router.add_get("/api/v1/slo", slo)
+    app.router.add_get("/api/v1/quality", quality)
     app.router.add_get("/api/v1/trace", trace)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)

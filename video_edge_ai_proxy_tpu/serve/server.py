@@ -36,16 +36,19 @@ log = get_logger("serve.server")
 
 
 def make_admin_handler(engine) -> grpc.GenericRpcHandler:
-    """gRPC admin mirror of ``POST /api/v1/profile?ms=N`` (obs/prof.py).
+    """gRPC admin mirror of the REST admin endpoints.
 
     Implemented as a generic handler with JSON-bytes serializers rather
-    than a .proto service: the deploy image carries no protoc, and an
-    admin-only unary call does not justify regenerating stubs. Call it
+    than a .proto service: the deploy image carries no protoc, and
+    admin-only unary calls do not justify regenerating stubs. Call them
     raw: ``channel.unary_unary("/vep.Admin/ProfileCapture")(b'{"ms":500}')``
-    -> bundle manifest JSON. Status mapping mirrors the REST endpoint:
-    INVALID_ARGUMENT for a bad duration (=400), FAILED_PRECONDITION when
-    profiling is disabled (=the 400 kill-switch answer), ABORTED when a
-    capture is already in flight (=409).
+    -> bundle manifest JSON (= ``POST /api/v1/profile?ms=N``), or
+    ``channel.unary_unary("/vep.Admin/Quality")(b"")`` -> the quality
+    snapshot JSON (= ``GET /api/v1/quality``). Status mapping mirrors
+    REST: INVALID_ARGUMENT for a bad duration (=400),
+    FAILED_PRECONDITION when the subsystem is kill-switched (=the 400
+    disabled-endpoint answer), ABORTED when a capture is already in
+    flight (=409).
     """
     import json
 
@@ -75,14 +78,28 @@ def make_admin_handler(engine) -> grpc.GenericRpcHandler:
             context.abort(grpc.StatusCode.ABORTED, str(exc))
         return json.dumps(manifest).encode()
 
+    def quality(request: bytes, context):
+        if engine is None or engine.quality is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "quality tracking disabled (engine.quality config)",
+            )
+        out = engine.quality.snapshot()
+        out["canary"] = (engine.canary.snapshot()
+                        if engine.canary is not None else None)
+        return json.dumps(out).encode()
+
     # Identity serializers: the wire format IS the JSON bytes.
-    rpc = grpc.unary_unary_rpc_method_handler(
-        profile_capture,
-        request_deserializer=lambda b: b,
-        response_serializer=lambda b: b,
-    )
+    def _rpc(fn):
+        return grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
+
     return grpc.method_handlers_generic_handler(
-        "vep.Admin", {"ProfileCapture": rpc}
+        "vep.Admin", {"ProfileCapture": _rpc(profile_capture),
+                      "Quality": _rpc(quality)}
     )
 
 

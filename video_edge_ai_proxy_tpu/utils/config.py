@@ -198,6 +198,34 @@ class EngineConfig:
     prof_trigger_min_interval_s: float = 60.0  # rate limit between them
     prof_retention_bytes: int = 256 << 20      # ring bound, oldest evicted
     prof_max_ms: int = 10_000          # cap on ?ms= (400 above this)
+    # Output-quality observability (obs/quality.py): device-computed
+    # per-frame luma mean/variance + inter-frame diff energy folded into
+    # the serving step (ops/preprocess.py frame_quality_stats; single-chip
+    # only — the mesh path doesn't shard the thumbnail state yet), host
+    # black/frozen/flatline verdict state machines with time hysteresis,
+    # detection drift scoring, and the degradation ladder's first-shed
+    # set. quality=False disables the subsystem and /api/v1/quality
+    # answers 400 (same kill-switch convention as slo/prof above).
+    quality: bool = True
+    quality_thumb: int = 32            # luma thumbnail side (device state)
+    quality_black_luma: float = 0.04   # black: thumb luma mean below this
+    quality_black_var: float = 5e-4    #   ... AND luma variance below this
+    quality_freeze_diff: float = 1e-6  # frozen: inter-frame MSE below this
+    quality_enter_s: float = 2.0       # condition must hold this long
+    quality_exit_s: float = 2.0        # all-clear must hold this long
+    quality_flatline_s: float = 10.0   # zero detections for this long
+    quality_window_s: float = 5.0      # drift scoring window
+    quality_drift_threshold: float = 0.35
+    quality_ladder: bool = True        # black/frozen streams shed first
+    # Canary integrity loop: a golden trace (recorder.py) replayed into
+    # the live engine at low cadence by an engine-owned publisher; each
+    # completed loop's host result checksums fold and compare against the
+    # golden (0 = adopt the first complete cycle), feeding the
+    # canary_integrity SLO + watchdog. "" = no canary.
+    quality_canary: str = ""           # trace path ("" = off)
+    quality_canary_stream: str = "_canary"
+    quality_canary_fps: float = 2.0
+    quality_canary_golden: int = 0     # committed fold; 0 = record-only
 
 
 @dataclass

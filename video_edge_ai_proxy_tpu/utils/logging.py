@@ -6,16 +6,65 @@ used throughout as ``g.Log.*``; worker containers print unbuffered to stdout
 process-wide structured logger, plain stdout lines so a supervising process
 manager can capture them (our ProcessManager tails worker stdout the way the
 reference tails container logs, ``rtsp_process_manager.go:283-335``).
+
+Log correlation (ISSUE r10 satellite): hot-path threads (engine drain,
+worker publish loops) set a per-thread/task context — ``stream=<id>
+seq=<packet>`` — via :func:`set_log_context` / :func:`log_context`; a
+logging.Filter injects it into every record emitted while the context is
+set, so a WARNING fired three calls deep (tracker, annotate, quality)
+still says which frame it was about. ContextVar-backed: thread-safe and
+correct under asyncio handlers too, with zero cost on records logged
+outside any context.
 """
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import sys
+from contextvars import ContextVar
+from typing import Iterator, Optional
 
-_FORMAT = "%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s"
+_FORMAT = "%(asctime)s\t%(levelname)s\t%(name)s\t%(vep_ctx)s%(message)s"
 _configured = False
+
+_LOG_CTX: ContextVar[str] = ContextVar("vep_log_ctx", default="")
+
+
+def set_log_context(stream: Optional[str] = None,
+                    seq: Optional[int] = None):
+    """Arm the correlation fields for records logged by this thread/task
+    until :func:`reset_log_context` is called with the returned token."""
+    parts = []
+    if stream is not None:
+        parts.append(f"stream={stream}")
+    if seq is not None:
+        parts.append(f"seq={seq}")
+    return _LOG_CTX.set("[" + " ".join(parts) + "]\t" if parts else "")
+
+
+def reset_log_context(token) -> None:
+    _LOG_CTX.reset(token)
+
+
+@contextlib.contextmanager
+def log_context(stream: Optional[str] = None,
+                seq: Optional[int] = None) -> Iterator[None]:
+    token = set_log_context(stream=stream, seq=seq)
+    try:
+        yield
+    finally:
+        reset_log_context(token)
+
+
+class _ContextFilter(logging.Filter):
+    """Injects ``vep_ctx`` into every record (empty string outside any
+    context) so the one format string works for all records."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.vep_ctx = _LOG_CTX.get()
+        return True
 
 
 def _configure() -> None:
@@ -24,6 +73,7 @@ def _configure() -> None:
         return
     handler = logging.StreamHandler(sys.stdout)
     handler.setFormatter(logging.Formatter(_FORMAT))
+    handler.addFilter(_ContextFilter())
     root = logging.getLogger("vep_tpu")
     root.addHandler(handler)
     root.setLevel(os.environ.get("VEP_TPU_LOG_LEVEL", "INFO").upper())
