@@ -2,7 +2,7 @@
 # whole build; ours adds the native bus lib and test/bench shortcuts).
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
-	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate
+	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke
 
 all: proto native
 
@@ -126,6 +126,23 @@ quality-smoke:
 		assert all(f['detected'] for f in d['faults']), d['faults']; \
 		assert not d['false_positives'], d['false_positives']; \
 		print(json.dumps(d['faults'], indent=2))"
+
+# H2D prefetch overlap smoke: a short two-geometry lockstep serve on a
+# MemoryFrameBus (CPU backend, tiny twin) proving the transfer stage
+# hides copy time behind dispatch/compute. Gates (in tools/h2d_smoke.py,
+# exit non-zero on breach): >=3 served batches per geometry, aggregate
+# h2d_hidden_pct > 0, and the vep_h2d_* metric families (including the
+# round-8 vep_h2d_hidden_seconds counter) render lint-clean Prometheus
+# exposition. ~15 s.
+h2d-smoke:
+	python tools/h2d_smoke.py | tee /tmp/vep_h2d_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_h2d_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); \
+		assert d['h2d_hidden_pct'] and d['h2d_hidden_pct'] > 0, d; \
+		assert not d['exposition_problems'], d['exposition_problems']; \
+		print('h2d overlap: %.1f%% of transfer wall hidden (%d batches/geometry)' \
+			% (d['h2d_hidden_pct'], d['batches_per_geometry']))"
 
 # Performance regression gate: run the bench, then compare its JSON line
 # against the committed BENCH_r*.json trajectory (tools/bench_gate.py;

@@ -982,6 +982,146 @@ class TestEngine:
             eng.stop()
 
 
+class TestPrefetch:
+    """Round-8 device-resident hot path (ROADMAP item 5): the H2D
+    transfer thread, donated input slots, and the device-side thumbnail
+    carry. Direct-drive: only the transfer thread is started, so each
+    test steps the tick pipeline by hand (collect -> _dispatch -> drain)
+    without racing the tick loop."""
+
+    def _drain_one(self, eng):
+        """What the drain thread does per batch, minus _emit: return the
+        pooled lease and close the in-flight window the prefetch stage's
+        busy signal reads."""
+        inflight = eng._drain_q.get(timeout=10)
+        eng._collector.release(inflight.group)
+        eng._drain_q.task_done()
+        return inflight
+
+    def test_thumb_pool_carries_previous_tick(self, bus, monkeypatch):
+        """Three prefetched ticks: each tick's device-side gather must
+        return the PREVIOUS tick's thumbnail (t/t-1 carry) — the zero
+        row on first sight, then each prior frame's luma."""
+        from video_edge_ai_proxy_tpu.engine.runner import _ThumbPool
+
+        bus.create_stream("cam1", 64 * 64 * 3)
+        eng = _engine(bus, "tiny_yolov8")
+        assert eng._quality_device and eng._xfer is not None
+
+        gathered = []
+        orig_gather = _ThumbPool.gather
+
+        def spy(pool, idx):
+            out = orig_gather(pool, idx)
+            gathered.append(np.asarray(out))
+            return out
+
+        monkeypatch.setattr(_ThumbPool, "gather", spy)
+        eng._xfer.start()
+        try:
+            for value in (40, 80, 120):
+                _publish(bus, "cam1", value=value)
+                groups = eng._collector.collect()
+                assert len(groups) == 1
+                eng._dispatch(groups, time.perf_counter())
+                self._drain_one(eng)
+        finally:
+            eng._xfer.stop()
+        # A uniform BGR frame of value v downsamples to a uniform luma
+        # thumbnail of v/255.
+        assert len(gathered) == 3
+        np.testing.assert_allclose(gathered[0][0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(gathered[1][0], 40 / 255.0, atol=1e-3)
+        np.testing.assert_allclose(gathered[2][0], 80 / 255.0, atol=1e-3)
+        row = eng._thumbs._slots["cam1"]
+        assert row >= 1                     # row 0 is the permanent zero row
+        pool = np.asarray(eng._thumbs._pool)
+        np.testing.assert_allclose(pool[row], 120 / 255.0, atol=1e-3)
+        np.testing.assert_allclose(pool[0], 0.0, atol=1e-6)
+        # every tick crossed the transfer thread and was accounted
+        snap = eng.perf.snapshot()
+        assert sum(r["batches"] for r in snap["h2d"]) == 3
+
+    def test_prefetch_and_donation_keep_replay_bit_identical(self):
+        """The same frame sequence through the engine dispatch path with
+        the transfer thread + donated frames vs the synchronous path
+        must fold to the same content checksum: the hot-path rework is
+        allowed to move bytes, never results."""
+        from video_edge_ai_proxy_tpu.replay.checksum import (
+            CHECKSUM_MASK,
+            device_checksum,
+            finalize_checksum,
+        )
+
+        def run(prefetch, donate):
+            b = MemoryFrameBus()
+            try:
+                eng = _engine(b, "tiny_yolov8", prefetch=prefetch,
+                              donate_frames=donate)
+                b.create_stream("cam1", 64 * 64 * 3)
+                if eng._xfer is not None:
+                    eng._xfer.start()
+                carry = 0
+                try:
+                    for value in (15, 60, 105, 150):
+                        _publish(b, "cam1", value=value)
+                        groups = eng._collector.collect()
+                        eng._dispatch(groups, time.perf_counter())
+                        inflight = self._drain_one(eng)
+                        part = int(np.asarray(
+                            device_checksum(inflight.outputs)))
+                        carry = (carry + part) & CHECKSUM_MASK
+                finally:
+                    if eng._xfer is not None:
+                        eng._xfer.stop()
+                return finalize_checksum(carry)
+            finally:
+                b.close()
+
+        assert run(True, "on") == run(False, "off")
+
+    def test_dispatch_failure_returns_every_lease(self, bus, monkeypatch):
+        """Two geometries -> both groups prefetched up front; when group
+        0's step raises, group 1's batch is still in flight on the
+        transfer thread — BOTH leases must come back (after the copy
+        resolves) or a failing model leaks one pooled buffer per tick."""
+        bus.create_stream("cam1", 64 * 64 * 3)
+        bus.create_stream("cam2", 64 * 48 * 3)
+        eng = _engine(bus, "tiny_yolov8")
+        _publish(bus, "cam1", w=64, h=64)
+        _publish(bus, "cam2", w=64, h=48)
+        groups = eng._collector.collect()
+        assert len(groups) == 2
+
+        def boom(src_hw, bucket, model=None):
+            raise RuntimeError("compile exploded")
+
+        monkeypatch.setattr(eng, "_step", boom)
+        eng._xfer.start()
+        try:
+            with pytest.raises(RuntimeError, match="compile exploded"):
+                eng._dispatch(groups, time.perf_counter())
+        finally:
+            eng._xfer.stop()
+        assert all(g.lease is None for g in groups)
+        with eng._collector._pool_lock:
+            assert all(not slot["leased"]
+                       for slot in eng._collector._pool.values())
+
+    def test_prewarm_four_element_entry_compiles_named_model(self, bus):
+        cfg = EngineConfig(
+            model="tiny_yolov8", batch_buckets=(1, 2), tick_ms=1000,
+            prewarm=[[32, 32, 1, "tiny_mobilenet_v2"], [64, 64, 1]],
+        )
+        eng = InferenceEngine(bus, cfg)
+        eng.start()
+        try:
+            assert ("tiny_mobilenet_v2", (32, 32), 1) in eng._step_cache
+            assert ("tiny_yolov8", (64, 64), 1) in eng._step_cache
+        finally:
+            eng.stop()
+
+
 class TestAnnotationPolicy:
     """Annotation emit policies (VERDICT r2 weak #3): the engine is a
     firehose the reference never was (its clients chose what to annotate,

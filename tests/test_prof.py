@@ -319,8 +319,9 @@ class TestH2DAccounting:
             assert h2d, "dispatch recorded no H2D transfer"
             rec = h2d[0]
             assert rec["batches"] >= 1 and rec["seconds"] > 0
-            # Padded plane: bucket slots x the 32x32x3 uint8 frame.
-            assert rec["bytes_per_frame"] == 32 * 32 * 3
+            # Padded plane (bucket slots x the 32x32x3 uint8 frame) plus
+            # the per-slot int32 thumbnail index the quality path ships.
+            assert rec["bytes_per_frame"] == 32 * 32 * 3 + 4
         finally:
             bus.close()
 

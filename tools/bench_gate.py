@@ -113,6 +113,12 @@ def gate(current: dict, trajectory: list, tolerance: float,
             for t in matched
         ],
     }
+    # Informational carry-through (round 8): the H2D overlap evidence
+    # rides the report so perf-gate logs show it, but it never gates —
+    # older artifacts predate the field and a first TPU run must keep its
+    # metric-matched first-run pass.
+    if current.get("h2d_hidden_pct") is not None:
+        report["h2d_hidden_pct"] = current["h2d_hidden_pct"]
     if not usable:
         report.update(passed=True, reason="no committed baseline for "
                       f"metric {metric!r} (first run records the bar)")

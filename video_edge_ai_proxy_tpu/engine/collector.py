@@ -50,11 +50,15 @@ class BatchGroup:
 
     @property
     def nbytes(self) -> int:
-        """Bytes this batch ships host->device when dispatched: the
-        whole padded frame plane, padding slots included (they cross the
-        PCIe/ICI link like real frames). Feeds the vep_h2d_* accounting
-        in obs/perf.py — the evidence gate for ROADMAP item 5's
-        uint8-shipping / double-buffered H2D work."""
+        """Bytes of frame plane this batch ships host->device when
+        dispatched: the whole padded uint8 plane, padding slots included
+        (they cross the PCIe/ICI link like real frames). Aux tensors that
+        ride along per dispatch (e.g. the int32 thumbnail slot-index
+        vector for 3-arg quality steps) are accounted at the dispatch
+        site in engine/runner.py, which adds them to this figure before
+        feeding the vep_h2d_* accounting in obs/perf.py — the evidence
+        gate for ROADMAP item 5's uint8-shipping / double-buffered H2D
+        work."""
         return int(self.frames.nbytes)
 
 
@@ -271,7 +275,8 @@ class Collector:
         with self._pool_lock:
             slot = self._pool.get(shape)
             if slot is None:
-                slot = {"bufs": [], "prev": set(), "cur": [], "leased": []}
+                slot = {"bufs": [], "prev": set(), "cur": [], "leased": [],
+                        "fill": {}}
                 self._pool[shape] = slot
             busy = set(slot["prev"])
             busy.update(slot["cur"])
@@ -339,6 +344,36 @@ class Collector:
                     slot["leased"].remove(idx)
                 except ValueError:
                     pass   # double release / unknown lease: stay robust
+
+    def _zero_pad_rows(self, buf: np.ndarray, shape: tuple, idx,
+                       n: int, touched: int) -> None:
+        """Zero only the pooled buffer rows that may actually be dirty,
+        instead of memset-ing the full pad tail every tick: the pool
+        tracks a per-buffer dirty high-water mark ("fill"), so a steady
+        16-stream batch re-zeroes nothing and the ~100 MB/tick frame
+        plane is touched exactly once (the bus copy). ``touched`` is the
+        caller's per-tick attempt high-water — one past the highest slot
+        any read_latest_into call targeted, including calls that did NOT
+        join the batch: a drifted/raced read may leave a partial write in
+        its target slot before the geometry check fails (bus/shm_bus.py
+        seqlock reader copies before validating). Invariant after this
+        call: rows >= n of ``buf`` are zero and fill[idx] == n. ``idx``
+        None = one-off failsafe buffer, freshly np.zeros — nothing to
+        do."""
+        if idx is None:
+            return
+        touched = min(max(touched, n), buf.shape[0])
+        with self._pool_lock:
+            slot = self._pool.get(shape)
+            if slot is None:                 # defensive: shape evicted
+                dirty = buf.shape[0]
+            else:
+                fill = slot["fill"]
+                # Fresh pool buffers are np.zeros => default high-water 0.
+                dirty = max(fill.get(idx, 0), touched)
+                fill[idx] = n
+        if dirty > n:
+            buf[n:dirty] = 0
 
     # -- incremental batch assembly (between ticks) --
 
@@ -413,6 +448,7 @@ class Collector:
                     "model": model, "geom": geom, "shape": shape,
                     "buf": buf, "idx": bidx,
                     "ids": [], "metas": [], "slot": {},
+                    "hw": 0,   # attempt high-water for _zero_pad_rows
                 }
                 for device_id in chunk:
                     of[device_id] = key
@@ -435,9 +471,10 @@ class Collector:
                 continue   # idle ring: one cheap load, no read setup
             g = win["groups"][key]
             slot = g["slot"].get(device_id)
-            target = g["buf"][slot if slot is not None else len(g["ids"])]
+            t = slot if slot is not None else len(g["ids"])
+            g["hw"] = max(g["hw"], t + 1)   # slot t may get partial bytes
             res = self._bus.read_latest_into(
-                device_id, target, min_seq=cursor,
+                device_id, g["buf"][t], min_seq=cursor,
             )
             if res is None:
                 continue
@@ -502,9 +539,9 @@ class Collector:
                 # was allocated before a cap could land, and its alloc is
                 # always a member of the full list >= n.
                 bucket = next(b for b in self._buckets if b >= n)
+                self._zero_pad_rows(g["buf"], g["shape"], g["idx"], n,
+                                    g["hw"])
                 view = g["buf"][:bucket]
-                if bucket != n:
-                    view[n:] = 0
                 group = BatchGroup(
                     src_hw=g["geom"][:2], device_ids=g["ids"],
                     frames=view, metas=g["metas"], bucket=bucket,
@@ -532,7 +569,9 @@ class Collector:
                 batch, bidx = self._pooled((alloc,) + geom)
                 ids: List[str] = []
                 metas: List[FrameMeta] = []
+                hw = 0   # attempt high-water for _zero_pad_rows
                 for device_id in chunk:
+                    hw = max(hw, len(ids) + 1)
                     res = self._bus.read_latest_into(
                         device_id, batch[len(ids)],
                         min_seq=self._cursors.get(device_id, 0),
@@ -559,9 +598,8 @@ class Collector:
                         self._unrotate((alloc,) + geom)
                     continue
                 bucket = next(b for b in buckets if b >= n)
+                self._zero_pad_rows(batch, (alloc,) + geom, bidx, n, hw)
                 view = batch[:bucket]
-                if bucket != n:
-                    view[n:] = 0
                 group = BatchGroup(
                     src_hw=geom[:2], device_ids=ids, frames=view,
                     metas=metas, bucket=bucket, model=model,

@@ -334,6 +334,203 @@ class _TimedStep:
         return self._jit(variables, *args)
 
 
+class _ThumbPool:
+    """Device-resident per-stream quality-thumbnail state (ROADMAP item
+    5 host-work fold): one [capacity, th, tw] f32 device array plus a
+    host slot map, replacing the per-dispatch host ``jnp.stack`` of
+    zero rows the old ``_gather_thumbs`` built. The previous tick's
+    thumbnails for a batch are a device-side ``jnp.take`` keyed by slot
+    indices; this tick's rows scatter back with ``.at[idx].set`` —
+    thumbnail state never crosses back to host, and the dispatch loop
+    ships only a [bucket] int32 index vector.
+
+    Row 0 is a permanent zero row: first-seen streams (and padded batch
+    slots) gather it, preserving the zero-reference/first-diff contract
+    ``frame_quality_stats`` documents. Dict-like surface (``__iter__``/
+    ``__len__``/``pop``) so the tick loop's debounced per-stream GC
+    treats it exactly like the tracker/annotation state dicts. All
+    methods run on the tick thread (same single-writer discipline the
+    old per-stream dict had).
+    """
+
+    __slots__ = ("side", "_slots", "_free", "_pool", "_capacity", "_high")
+
+    _GROW = 64    # rows added per capacity growth (keeps re-pads rare)
+
+    def __init__(self, side: int):
+        self.side = int(side)
+        self._slots: Dict[str, int] = {}   # device_id -> pool row (>= 1)
+        self._free: List[int] = []
+        self._pool = None                  # lazy: jax import stays off the
+        self._capacity = 0                 # control plane (CLAUDE.md)
+        self._high = 0                     # highest row ever assigned
+
+    def __bool__(self) -> bool:
+        return bool(self._slots)
+
+    def __iter__(self):
+        return iter(list(self._slots))
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def pop(self, device_id: str, default=None):
+        """Forget a stream (tick-loop GC): its row returns to the free
+        list. The stale row contents are unreachable — nothing gathers a
+        row until scatter() reassigns it, which overwrites it first."""
+        row = self._slots.pop(device_id, None)
+        if row is not None:
+            self._free.append(row)
+        return default
+
+    def _ensure(self, rows: int) -> None:
+        import jax.numpy as jnp
+
+        if self._pool is None:
+            cap = max(self._GROW, rows)
+            self._pool = jnp.zeros((cap, self.side, self.side), jnp.float32)
+            self._capacity = cap
+        elif rows > self._capacity:
+            grow = -(-(rows - self._capacity) // self._GROW) * self._GROW
+            self._pool = jnp.pad(self._pool, ((0, grow), (0, 0), (0, 0)))
+            self._capacity += grow
+
+    def gather_indices(self, device_ids, bucket: int) -> np.ndarray:
+        """[bucket] int32 gather rows for a batch, slot order: each
+        known stream's row, row 0 (zeros) for first-seen streams and
+        padded slots. This vector is the only host->device bytes the
+        quality path still ships per batch."""
+        idx = np.zeros(bucket, np.int32)
+        for i, did in enumerate(device_ids):
+            idx[i] = self._slots.get(did, 0)
+        return idx
+
+    def gather(self, idx: np.ndarray):
+        """Previous-tick [bucket, th, tw] rows as a device-side gather."""
+        import jax.numpy as jnp
+
+        self._ensure(1)
+        return jnp.take(self._pool, jnp.asarray(idx), axis=0)
+
+    def scatter(self, device_ids, thumbs) -> None:
+        """Store this tick's [>=n, th, tw] device rows (the step output,
+        still async) for next tick's diff; assigns rows on first sight."""
+        import jax.numpy as jnp
+
+        rows = []
+        for did in device_ids:
+            row = self._slots.get(did)
+            if row is None:
+                row = self._free.pop() if self._free else self._high + 1
+                self._high = max(self._high, row)
+                self._slots[did] = row
+            rows.append(row)
+        if not rows:
+            return
+        self._ensure(max(rows) + 1)
+        idx = jnp.asarray(np.asarray(rows, np.int32))
+        self._pool = self._pool.at[idx].set(thumbs[:len(rows)])
+
+
+class _Prefetched:
+    """Handle for one batch placement in flight on the transfer thread."""
+
+    __slots__ = ("group", "ready", "placed", "error", "transfer_s",
+                 "overlapped_s", "slot")
+
+    def __init__(self, group: BatchGroup):
+        self.group = group
+        self.ready = threading.Event()
+        self.placed = None
+        self.error: Optional[BaseException] = None
+        self.transfer_s = 0.0
+        self.overlapped_s = 0.0   # transfer wall time with >=1 batch in flight
+        self.slot = 0             # which of the key's two input slots
+
+
+class _PrefetchStage:
+    """Dedicated H2D transfer stage (ROADMAP item 5 tentpole): a
+    depth-2 in-queue — the per-(model, geometry, bucket) double-buffered
+    input slots — feeding one transfer thread that places each collected
+    batch with a real async ``jax.device_put``. The copy of batch t+1
+    runs while the tick thread dispatches batch t and the device
+    computes it, instead of serializing inside the dispatch loop (the
+    pre-r12 behavior: single-device placement was a passthrough and the
+    whole uint8 plane crossed synchronously inside the step call).
+    ``block_until_ready`` on the placed array bounds the transfer window
+    AND guarantees the pooled host buffer is no longer being read when
+    the handle resolves — the lease-return failure path relies on that.
+
+    Slot parity per key is bookkeeping for attribution (at most DEPTH
+    placements of a key are ever outstanding); the HBM itself is
+    recycled by XLA through the donated frames argument (see ``_step``).
+    """
+
+    DEPTH = 2
+
+    def __init__(self, place_fn, busy_fn):
+        self._place = place_fn       # host frames -> device array
+        self._busy = busy_fn         # True when >=1 dispatched batch in flight
+        self._q: "queue.Queue[Optional[_Prefetched]]" = queue.Queue(
+            maxsize=self.DEPTH)
+        self._thread: Optional[threading.Thread] = None
+        self._slots: Dict[tuple, int] = {}
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="tpu-engine-xfer", daemon=True)
+        self._thread.start()
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        try:
+            self._q.put(None, timeout=5)
+        except queue.Full:
+            log.warning("transfer queue full at stop; abandoning thread")
+        self._thread.join(timeout=10)
+
+    def submit(self, group: BatchGroup, stop_event) -> Optional[_Prefetched]:
+        """Queue a placement; blocks (in interruptible slices) while both
+        slots are occupied — same bounded-pipeline stance as the drain
+        queue. Returns None on shutdown (caller returns the lease)."""
+        pre = _Prefetched(group)
+        key = (group.model, group.src_hw, group.bucket)
+        pre.slot = self._slots.get(key, 0)
+        self._slots[key] = pre.slot ^ 1
+        while not stop_event.is_set():
+            try:
+                self._q.put(pre, timeout=0.1)
+                return pre
+            except queue.Full:
+                continue
+        return None
+
+    def _loop(self) -> None:
+        while True:
+            pre = self._q.get()
+            if pre is None:
+                return
+            busy = self._busy()
+            t0 = time.perf_counter()
+            try:
+                placed = self._place(pre.group.frames)
+                if hasattr(placed, "block_until_ready"):
+                    placed.block_until_ready()
+                pre.placed = placed
+            except BaseException as exc:   # surfaced on the tick thread
+                pre.error = exc
+            pre.transfer_s = time.perf_counter() - t0
+            if busy or self._busy():
+                # Device work was in flight while this copy ran: the
+                # whole window was hidden behind compute.
+                pre.overlapped_s = pre.transfer_s
+            pre.ready.set()
+
+
 class InferenceEngine:
     """Owns the model, the compiled step cache, and the engine thread."""
 
@@ -486,6 +683,16 @@ class InferenceEngine:
             "Frames shed by the degradation ladder (stale at dispatch)",
         ).labels()
         self._last_tick_dur_s = 0.0
+        # Backpressure discriminator for the prefetch pipeline (tick
+        # thread only): with cfg.prefetch the depth-2 drain queue is
+        # legitimately FULL in healthy saturated serving (that is the
+        # double buffer doing its job), so raw qsize no longer means
+        # "device behind". The signal that still does is the tick
+        # thread having had to BLOCK handing a batch to the drain
+        # thread (_enqueue_drain found the queue full) — a device that
+        # keeps up absorbs the handoff without blocking.
+        self._drain_blocked = False
+        self._bp_depth = 0
         # Live device-performance attribution (obs/perf.py): compile
         # cost per (model, geometry, bucket) fed from _step misses,
         # per-batch device time / padding waste / MFU fed from _emit.
@@ -541,8 +748,22 @@ class InferenceEngine:
         self.quality = None
         self.canary = None
         self._canary_thread: Optional[threading.Thread] = None
-        self._thumbs: Dict[str, Any] = {}   # device_id -> [th, tw] f32
+        # Device-resident thumbnail pool (dict-like: stream -> pool row).
+        self._thumbs = _ThumbPool(self._cfg.quality_thumb)
         self._quality_device = False
+        # H2D prefetch stage (cfg.prefetch): placement of collected
+        # batches moves off the tick thread onto a dedicated transfer
+        # thread, double-buffered at depth 2 to match the drain pipeline.
+        # "busy" (the hidden-transfer attribution signal) keys off the
+        # drain queue's unfinished-task count: put in _enqueue_drain,
+        # task_done after _emit — exactly the submitted-but-not-yet-
+        # drained window during which device compute is in flight.
+        self._xfer: Optional[_PrefetchStage] = None
+        if self._cfg.prefetch:
+            self._xfer = _PrefetchStage(
+                self._place_device,
+                lambda: self._drain_q.unfinished_tasks > 0,
+            )
         if self._cfg.quality:
             from ..obs.quality import QualityTracker
 
@@ -923,17 +1144,25 @@ class InferenceEngine:
             # prewarm entry must not abort server boot, and buckets must be
             # ones the collector can actually dispatch (post mesh filter).
             try:
-                h, w, bucket = (int(v) for v in geom)
+                # [h, w, bucket] or [h, w, bucket, model]: the optional
+                # 4th element prewarms a non-default model's program.
+                model = None
+                if len(geom) == 4:
+                    model = str(geom[3])
+                h, w, bucket = (int(v) for v in geom[:3])
                 if bucket not in self._buckets:
                     log.warning(
                         "prewarm bucket %d not in effective buckets %s; "
                         "skipping", bucket, self._buckets,
                     )
                     continue
-                log.info("prewarming program for %dx%d bucket=%d", h, w, bucket)
-                self.compile_for((h, w), bucket)
+                log.info("prewarming program for %dx%d bucket=%d model=%s",
+                         h, w, bucket, model or self._spec.name)
+                self.compile_for((h, w), bucket, model)
             except Exception:
                 log.exception("prewarm entry %r failed; continuing", geom)
+        if self._xfer is not None:
+            self._xfer.start()
         self._drain_thread = threading.Thread(
             target=self._drain_loop, name="tpu-engine-drain", daemon=True
         )
@@ -955,6 +1184,10 @@ class InferenceEngine:
             self._thread.join(timeout=10)
         if self._canary_thread is not None:
             self._canary_thread.join(timeout=10)
+        if self._xfer is not None:
+            # After the tick thread: nothing submits anymore, and any
+            # handle the tick thread abandoned mid-wait has resolved.
+            self._xfer.stop()
         if self._drain_thread is not None:
             # Sentinel AFTER the tick loop stops producing: everything
             # queued before it still drains (no result is dropped on a
@@ -1181,10 +1414,12 @@ class InferenceEngine:
         drain_alive = (
             self._drain_thread is not None and self._drain_thread.is_alive()
         )
-        # Both halves of the pipeline must live: a dead drain thread backs
+        # Every stage of the pipeline must live: a dead drain thread backs
         # the queue up and silently stops every emission even while ticks
-        # keep completing.
-        alive = tick_alive and drain_alive
+        # keep completing; a dead transfer thread starves every dispatch
+        # at the placement pop the same way.
+        xfer_alive = self._xfer is None or self._xfer.alive()
+        alive = tick_alive and drain_alive and xfer_alive
         now = time.monotonic()
         age = (now - self.last_tick_monotonic) if self.last_tick_monotonic else None
         with self._probe_spawn_lock:
@@ -1228,6 +1463,8 @@ class InferenceEngine:
             "healthy": bool(alive and ok and not stale),
             "engine_thread_alive": tick_alive,
             "drain_thread_alive": drain_alive,
+            "transfer_thread_alive": (
+                self._xfer.alive() if self._xfer is not None else None),
             "tick_age_s": round(age, 3) if age is not None else None,
             "tick_stale": stale,
             "device_ok": bool(ok),
@@ -1240,20 +1477,29 @@ class InferenceEngine:
 
     # -- compiled step construction --
 
-    def compile_for(self, src_hw: tuple, bucket: int) -> None:
-        """Prewarm the program for one (source geometry, bucket)."""
+    def compile_for(self, src_hw: tuple, bucket: int,
+                    model: Optional[str] = None) -> None:
+        """Prewarm the program for one (source geometry, bucket) — of
+        the default model, or of any registry model a stream resolves to
+        (``model``; 4-element cfg.prewarm entries). Multi-family fleets
+        otherwise pay each extra model's compile stall on its first
+        mid-soak frame (the stall r11's harness worked around by
+        prewarming downshift buckets for the default model only)."""
+        spec, _, variables = self._ensure_model(model or self._spec.name)
         shape = (bucket,) + (
-            (self._spec.clip_len,) if self._spec.clip_len else ()
+            (spec.clip_len,) if spec.clip_len else ()
         ) + tuple(src_hw) + (3,)
         args = [self._place(np.zeros(shape, np.uint8))]
-        if self._quality_device and not self._spec.clip_len:
+        if self._quality_device and not spec.clip_len:
             side = self._cfg.quality_thumb
             args.append(np.zeros((bucket, side, side), np.float32))
-        self._step(src_hw, bucket)(self._variables, *args)
+        self._step(src_hw, bucket, model)(variables, *args)
 
     def _place(self, frames: np.ndarray):
         """Shard the batch dim over dp when serving on a mesh; pass through
-        numpy (implicit single-device transfer) otherwise."""
+        numpy (implicit single-device transfer) otherwise. Tick-thread
+        fallback path — with cfg.prefetch the transfer thread uses
+        `_place_device` instead, which always performs the real copy."""
         if self._mesh is None:
             return frames
         import jax
@@ -1262,20 +1508,18 @@ class InferenceEngine:
 
         return jax.device_put(frames, batch_sharding(self._mesh, frames.ndim))
 
-    def _gather_thumbs(self, group: BatchGroup):
-        """Previous-tick [bucket, th, tw] f32 luma thumbnails for a
-        group's streams, in slot order. First-seen streams (and padded
-        slots) get zeros — the host tracker discards the first diff, so
-        the zero reference never reads as a frozen/unfrozen signal. The
-        per-slot rows are lazy device slices stored at dispatch; stacking
-        stays on device (no host round-trip of thumbnail state)."""
-        import jax.numpy as jnp
+    def _place_device(self, frames: np.ndarray):
+        """Real async H2D placement for the prefetch stage: single-chip
+        batches device_put explicitly (the legacy passthrough deferred
+        the copy into the step call, serializing it on the tick thread),
+        mesh batches shard over dp as before."""
+        import jax
 
-        side = self._cfg.quality_thumb
-        zero = np.zeros((side, side), np.float32)
-        rows = [self._thumbs.get(d, zero) for d in group.device_ids]
-        rows.extend([zero] * (group.bucket - len(rows)))
-        return jnp.stack(rows)
+        if self._mesh is None:
+            return jax.device_put(frames)
+        from ..parallel import batch_sharding
+
+        return jax.device_put(frames, batch_sharding(self._mesh, frames.ndim))
 
     def _step(self, src_hw: tuple, bucket: int, model: Optional[str] = None):
         model = model or self._spec.name
@@ -1302,11 +1546,24 @@ class InferenceEngine:
                     # Dequantize inside the program: XLA fuses int8*scale
                     # into each weight's first consumer, HBM stays int8.
                     return _base(dequantize_tree(qv), *args)
+            # Donate the frames slot (argnum 1) so XLA reuses the input
+            # HBM allocation for outputs instead of allocating a fresh
+            # one per tick — aliasing only, numerics (and the replay
+            # goldens) are untouched. The thumbnail argument is never
+            # donated: its buffer is a gather view of the device-resident
+            # pool. "auto" donates only where the backend implements it
+            # (the CPU test backend would warn per call and copy anyway).
+            donate = ()
+            if self._cfg.donate_frames == "on" or (
+                    self._cfg.donate_frames == "auto"
+                    and jax.default_backend() == "tpu"):
+                donate = (1,)
             # Compile attribution (obs/perf.py): the wrapper AOT-compiles
             # on first call, recording wall time + XLA cost analysis per
             # (model, geometry, bucket) — this is the only cache-miss
             # site, so every compile in the process is accounted.
-            fn = _TimedStep(jax.jit(raw), self.perf, model, src_hw, bucket)
+            fn = _TimedStep(jax.jit(raw, donate_argnums=donate),
+                            self.perf, model, src_hw, bucket)
             self._step_cache[key] = fn
         return fn
 
@@ -1325,10 +1582,20 @@ class InferenceEngine:
                 # Degradation ladder: one observe per tick (queue depth +
                 # last tick's duration vs budget); the rung gates the
                 # stages below. Closed-ladder overhead is one comparison.
+                # Effective backpressure depth for this tick: raw drain
+                # qsize without prefetch; with prefetch, a full queue
+                # counts only when the tick thread actually blocked on
+                # the handoff since the last observation (see
+                # _drain_blocked above).
+                depth = self._drain_q.qsize()
+                if self._xfer is not None and not self._drain_blocked:
+                    depth = min(depth, 1)
+                self._drain_blocked = False
+                self._bp_depth = depth
                 rung = "normal"
                 if self.ladder is not None:
                     rung = self.ladder.observe(
-                        queue_depth=self._drain_q.qsize(),
+                        queue_depth=depth,
                         tick_lag_s=self._last_tick_dur_s,
                         tick_budget_s=tick_s,
                         # SLO-level pressure: a sustained multi-window
@@ -1368,71 +1635,7 @@ class InferenceEngine:
                     # time (shed oldest-first with a staleness bound).
                     groups = self._shed_stale_groups(groups)
                 t_collect = time.time() if self._cfg.stage_trace else 0.0
-                trace_on = tracer.enabled
-                for gi, group in enumerate(groups):
-                    # A dispatch failure aborts the tick; every group not
-                    # yet handed to the drain thread (this one AND the
-                    # ones after it) must return its lease, or a
-                    # persistently failing model leaks one pooled buffer
-                    # per tick until the pool failsafe churns.
-                    try:
-                        step = self._step(
-                            group.src_hw, group.bucket, group.model
-                        )
-                        _, _, variables = self._ensure_model(
-                            group.model or self._spec.name
-                        )
-                        # H2D accounting (ROADMAP item 5 evidence): bytes
-                        # shipped per dispatched batch (padded uint8 frame
-                        # plane) and the wall time of the placement /
-                        # dispatch handoff. On a mesh this times the real
-                        # device_put; single-device it times the numpy
-                        # handoff (the transfer itself hides inside the
-                        # async dispatch) — either way bytes-per-frame is
-                        # exact, which is the number the uint8-shipping
-                        # work gates on.
-                        t_h2d = time.perf_counter()
-                        placed = self._place(group.frames)
-                        h2d_s = time.perf_counter() - t_h2d
-                        self.perf.note_h2d(
-                            group.model or self._spec.name, group.bucket,
-                            group.nbytes, h2d_s,
-                        )
-                        if self._quality_device and group.frames.ndim == 4:
-                            # Quality-carrying step (3-arg): feed last
-                            # tick's per-stream thumbnails, keep this
-                            # tick's on device for the next diff. The
-                            # pop keeps the thumbnails out of _emit's
-                            # D2H fetch — they never cross back to host.
-                            outputs = dict(step(
-                                variables, placed,
-                                self._gather_thumbs(group),
-                            ))
-                            thumbs = outputs.pop("quality_thumbs")
-                            for si, did in enumerate(group.device_ids):
-                                self._thumbs[did] = thumbs[si]
-                        else:
-                            outputs = step(variables, placed)
-                    except Exception:
-                        for g in groups[gi:]:
-                            self._collector.release(g)
-                        raise
-                    self.batches += 1
-                    self._m_batches.inc()
-                    self._m_occupancy.observe(
-                        100.0 * len(group.device_ids) / group.bucket
-                    )
-                    t_submit = time.time()
-                    if trace_on:
-                        for did, meta in zip(group.device_ids, group.metas):
-                            if tracer.sampled(meta.packet):
-                                tracer.record(
-                                    did, "submit", meta.packet,
-                                    ts=t_submit, bucket=group.bucket,
-                                )
-                    self._enqueue_drain(
-                        _Inflight(group, outputs, t_submit, t_collect)
-                    )
+                self._dispatch(groups, t_collect)
                 # Scope per-stream tracker state to streams that still
                 # exist: a long-lived engine with churning device_ids must
                 # not accumulate IoUTracker entries forever. Absence is
@@ -1473,7 +1676,12 @@ class InferenceEngine:
                                     self.quality.forget(d)
                                 del self._tracker_absent[d]
             except Exception:
-                log.exception("engine tick failed; continuing")
+                if self._stop.is_set():
+                    # Shutdown races (e.g. a prefetched placement abandoned
+                    # mid-dispatch) are expected here — not an error.
+                    log.info("engine tick aborted by shutdown")
+                else:
+                    log.exception("engine tick failed; continuing")
             self.ticks += 1
             self._m_ticks.inc()
             self.last_tick_monotonic = time.monotonic()
@@ -1497,6 +1705,121 @@ class InferenceEngine:
                 elapsed = time.monotonic() - t0
                 if elapsed < tick_s:
                     self._stop.wait(tick_s - elapsed)
+
+    def _dispatch(self, groups: List[BatchGroup], t_collect: float) -> None:
+        """Dispatch one tick's collected groups to the device.
+
+        With cfg.prefetch the placement of group g+1 (and g+2) runs on
+        the transfer thread while this thread dispatches group g and the
+        device computes earlier batches — H2D accounting (ROADMAP item 5
+        evidence) then times the REAL async device_put on the transfer
+        thread, and splits off the hidden share: the copy wall time that
+        overlapped in-flight device work, plus whatever share this
+        thread did not have to wait out at the pop. Without prefetch the
+        legacy synchronous path remains (mesh: real device_put; single
+        device: numpy handoff whose transfer hides inside the async
+        dispatch) — either way bytes-per-frame stays exact.
+
+        A dispatch failure aborts the tick; every group not yet handed
+        to the drain thread (this one AND the ones after it, including
+        batches still in flight on the transfer thread) must return its
+        lease, or a persistently failing model leaks one pooled buffer
+        per tick until the pool failsafe churns. Prefetched leases are
+        returned only after their transfer handle resolves — the copy
+        may still be reading the pooled host buffer.
+        """
+        trace_on = tracer.enabled
+        handles: List[Optional[_Prefetched]] = []
+
+        def _top_up(upto: int) -> None:
+            while len(handles) < min(len(groups), upto):
+                handles.append(
+                    self._xfer.submit(groups[len(handles)], self._stop)
+                )
+        if self._xfer is not None and groups:
+            _top_up(_PrefetchStage.DEPTH)
+        for gi, group in enumerate(groups):
+            try:
+                step = self._step(group.src_hw, group.bucket, group.model)
+                _, _, variables = self._ensure_model(
+                    group.model or self._spec.name
+                )
+                if self._xfer is not None:
+                    _top_up(gi + 1 + _PrefetchStage.DEPTH)
+                    pre = handles[gi]
+                    if pre is None:   # shutdown aborted the submission
+                        raise RuntimeError(
+                            "engine stopping; prefetch submission aborted")
+                    t_wait = time.perf_counter()
+                    while not pre.ready.wait(timeout=0.1):
+                        if self._stop.is_set():
+                            raise RuntimeError(
+                                "engine stopping; prefetched placement "
+                                "abandoned")
+                    wait_s = time.perf_counter() - t_wait
+                    if pre.error is not None:
+                        raise pre.error
+                    placed = pre.placed
+                    h2d_s = pre.transfer_s
+                    # Hidden share: fully overlapped when device work was
+                    # in flight during the copy; otherwise the part this
+                    # thread did not spend blocked on the handle (it was
+                    # dispatching earlier groups meanwhile).
+                    hidden_s = max(pre.overlapped_s,
+                                   max(0.0, pre.transfer_s - wait_s))
+                else:
+                    t_h2d = time.perf_counter()
+                    placed = self._place(group.frames)
+                    h2d_s = time.perf_counter() - t_h2d
+                    hidden_s = 0.0
+                idx = None
+                aux_nbytes = 0
+                if self._quality_device and group.frames.ndim == 4:
+                    idx = self._thumbs.gather_indices(
+                        group.device_ids, group.bucket)
+                    aux_nbytes = int(idx.nbytes)
+                self.perf.note_h2d(
+                    group.model or self._spec.name, group.bucket,
+                    group.nbytes + aux_nbytes, h2d_s, hidden_s=hidden_s,
+                )
+                if idx is not None:
+                    # Quality-carrying step (3-arg): previous-tick
+                    # thumbnails arrive as a device-side gather from the
+                    # resident pool (no host rows cross); this tick's
+                    # rows scatter back for the next diff. The pop keeps
+                    # them out of _emit's D2H fetch.
+                    outputs = dict(step(
+                        variables, placed, self._thumbs.gather(idx),
+                    ))
+                    self._thumbs.scatter(
+                        group.device_ids, outputs.pop("quality_thumbs"))
+                else:
+                    outputs = step(variables, placed)
+            except Exception:
+                for gj in range(gi, len(groups)):
+                    if gj < len(handles) and handles[gj] is not None:
+                        # Bounded: block_until_ready in the transfer loop
+                        # keeps this short, and an unresolved handle means
+                        # the copy may still be reading the host buffer.
+                        handles[gj].ready.wait(timeout=5.0)
+                    self._collector.release(groups[gj])
+                raise
+            self.batches += 1
+            self._m_batches.inc()
+            self._m_occupancy.observe(
+                100.0 * len(group.device_ids) / group.bucket
+            )
+            t_submit = time.time()
+            if trace_on:
+                for did, meta in zip(group.device_ids, group.metas):
+                    if tracer.sampled(meta.packet):
+                        tracer.record(
+                            did, "submit", meta.packet,
+                            ts=t_submit, bucket=group.bucket,
+                        )
+            self._enqueue_drain(
+                _Inflight(group, outputs, t_submit, t_collect)
+            )
 
     def _apply_rung_cap(self, rung: str) -> None:
         """Rung 2+ (bucket_downshift): hide the largest batch bucket so
@@ -1531,10 +1854,11 @@ class InferenceEngine:
         episode, so a stalled device or recompile storm surfaces as ONE
         log line, not one per tick. Also feeds the per-tick SLO samples
         (fps, availability) and runs the throttled SLO evaluation."""
-        depth = self._drain_q.qsize()
-        self._m_drain_depth.set(depth)
+        self._m_drain_depth.set(self._drain_q.qsize())   # raw, for dashboards
         self.watchdog.check(
-            "drain_backpressure", depth, above=1,
+            # Effective depth (computed in _run): prefetch keeps the
+            # queue full by design, so only a blocked handoff counts.
+            "drain_backpressure", self._bp_depth, above=1,
             detail="device slower than the tick loop (double buffer full)",
         )
         # Recompile storm: a step-cache miss on N consecutive ticks means
@@ -1606,6 +1930,13 @@ class InferenceEngine:
         interruptible slices) when the pipeline is 2 deep — backpressure,
         not unbounded in-flight growth. On shutdown while full, the
         batch's result is dropped but its buffer lease is returned."""
+        try:
+            self._drain_q.put_nowait(inflight)
+            return
+        except queue.Full:
+            # The ladder/watchdog backpressure signal under prefetch:
+            # the device did NOT absorb the pipeline this tick.
+            self._drain_blocked = True
         while not self._stop.is_set():
             try:
                 self._drain_q.put(inflight, timeout=0.1)
@@ -1622,6 +1953,7 @@ class InferenceEngine:
         while True:
             inflight = self._drain_q.get()
             if inflight is None:
+                self._drain_q.task_done()
                 return
             try:
                 self._emit(inflight)
@@ -1629,6 +1961,9 @@ class InferenceEngine:
                 log.exception("drain failed; continuing")
             finally:
                 self._collector.release(inflight.group)
+                # Closes the in-flight window the prefetch stage's
+                # "busy" signal (hidden-transfer attribution) reads.
+                self._drain_q.task_done()
 
     # -- result emission --
 

@@ -87,47 +87,66 @@ def _strip_model_prefix(state: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     return state
 
 
-def _stem_pad_ok(model_cfg, have: tuple, want: tuple) -> bool:
-    """Is zero-padding a stem conv kernel ``have`` -> ``want`` sound for
-    this model config? True only when the model really runs the
-    channel-padded stem (stem_pad_c, NOT the space-to-depth stem — its
-    extra input planes carry real pixels) and the shapes differ solely by
-    the missing padded input channels."""
-    pad_c = getattr(model_cfg, "stem_pad_c", 0)
+def _stem_pad_ok(model_cfg, have: tuple, want: tuple,
+                 attr: str = "stem_pad_c", axis: int = 2) -> bool:
+    """Is zero-padding a conv kernel ``have`` -> ``want`` along its
+    input-channel ``axis`` sound for this model config? True only when
+    the model really runs the channel-padded stem/patchify (``attr``
+    non-zero, NOT the space-to-depth stem — its extra input planes carry
+    real pixels) and the shapes differ solely by the missing padded
+    input channels."""
+    pad_c = getattr(model_cfg, attr, 0)
     if not pad_c or getattr(model_cfg, "s2d_stem", False):
         return False
     return (
-        len(have) == 4 and len(want) == 4
-        and have[:2] == want[:2] and have[3] == want[3]
-        and have[2] < want[2] == pad_c
+        len(have) == len(want) > axis
+        and have[:axis] == want[:axis]
+        and have[axis + 1:] == want[axis + 1:]
+        and have[axis] < want[axis] == pad_c
     )
 
 
+# Conv kernels the cpad levers grow, per family: (params path, config
+# attr, kernel input-channel axis).
+_PAD_KERNELS = (
+    (("stem", "conv", "kernel"), "stem_pad_c", 2),      # ConvBN stems, HWIO
+    (("patch_embed", "kernel"), "patch_pad_c", 2),      # ViT patchify, HWIO
+    (("tubelet", "proj", "kernel"), "patch_pad_c", 3),  # VideoMAE, THWIO
+)
+
+
 def pad_stem_on_load(raw, template, model) -> dict:
-    """Compat shim for checkpoints saved before ``stem_pad_c`` was
-    adopted: zero-pad the stem conv kernel to the template's shape when
-    (and only when) the model config says the extra input planes are
-    zero-padding. Shared by the engine load path and tools/eval_detector
-    — every ``load_msgpack`` consumer of detector checkpoints."""
+    """Compat shim for checkpoints saved before a cpad lever
+    (``stem_pad_c`` / ``patch_pad_c``) was adopted: zero-pad the
+    stem/patchify conv kernel to the template's shape when (and only
+    when) the model config says the extra input planes are zero-padding.
+    Shared by the engine load path and tools/eval_detector — every
+    ``load_msgpack`` consumer of imported checkpoints."""
     cfg = getattr(model, "cfg", None)
-    try:
-        kern = raw["params"]["stem"]["conv"]["kernel"]
-        want = np.shape(template["params"]["stem"]["conv"]["kernel"])
-    except (KeyError, TypeError):
-        return raw
-    have = np.shape(kern)
-    if have != want and _stem_pad_ok(cfg, have, want):
-        raw["params"]["stem"]["conv"]["kernel"] = np.pad(
-            np.asarray(kern),
-            ((0, 0), (0, 0), (0, want[2] - have[2]), (0, 0)),
-        )
+    for path, attr, axis in _PAD_KERNELS:
+        try:
+            node = raw["params"]
+            tnode = template["params"]
+            for p in path[:-1]:
+                node = node[p]
+                tnode = tnode[p]
+            kern = node[path[-1]]
+            want = np.shape(tnode[path[-1]])
+        except (KeyError, TypeError):
+            continue
+        have = np.shape(kern)
+        if have == want or not _stem_pad_ok(cfg, have, want, attr, axis):
+            continue
+        widths = [(0, 0)] * len(want)
+        widths[axis] = (0, want[axis] - have[axis])
+        node[path[-1]] = np.pad(np.asarray(kern), widths)
         # Loud trace: served weights now differ in shape from the on-disk
         # checkpoint; an operator debugging that must see why.
         from ..utils.logging import get_logger
 
         get_logger("models.import").info(
-            "checkpoint stem kernel zero-padded %s -> %s (stem_pad_c "
-            "compat)", have, want,
+            "checkpoint %s kernel zero-padded %s -> %s (%s compat)",
+            "/".join(path[:-1]), have, want, attr,
         )
     return raw
 

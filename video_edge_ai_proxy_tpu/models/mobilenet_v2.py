@@ -15,6 +15,7 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops.preprocess import pad_channels
 from .common import ConvBN, Dtype, adaptive_avg_pool, make_divisible
 
 # (expansion t, out channels c, repeats n, first stride s)
@@ -36,6 +37,10 @@ class MobileNetV2Config:
     stages: Sequence[tuple] = field(default=_MNV2_STAGES)
     stem_features: int = 32
     head_features: int = 1280
+    # Lane-fill channel padding for the stem conv (ops.preprocess
+    # .pad_channels; cpad lever, LEVERS_r05). Zero input planes keep
+    # outputs identical; import_weights zero-pads checkpoints. 0 = off.
+    stem_pad_c: int = 0
 
 
 def tiny_mobilenet_v2_config(num_classes: int = 10) -> MobileNetV2Config:
@@ -79,6 +84,7 @@ class MobileNetV2(nn.Module):
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         c = self.cfg
         x = x.astype(self.dtype)
+        x = pad_channels(x, c.stem_pad_c)
         x = ConvBN(
             make_divisible(c.stem_features * c.width_mult), stride=2,
             act="relu6", dtype=self.dtype, name="stem",

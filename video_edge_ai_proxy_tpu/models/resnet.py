@@ -15,6 +15,7 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops.preprocess import pad_channels
 from .common import ConvBN, Dtype, adaptive_avg_pool
 
 # torchvision ResNets train with BN eps 1e-5; matching it is required for
@@ -27,6 +28,11 @@ class ResNetConfig:
     num_classes: int = 1000
     stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
     width: int = 64
+    # Lane-fill channel padding for the stem conv (see ops.preprocess
+    # .pad_channels and the yolov8 cpad8 lever, LEVERS_r05): the stem
+    # kernel grows [7,7,3,W]->[7,7,pad,W], extra input planes are zeros,
+    # outputs identical; import_weights zero-pads checkpoints. 0 = off.
+    stem_pad_c: int = 0
 
 
 def tiny_resnet_config(num_classes: int = 10) -> ResNetConfig:
@@ -66,6 +72,7 @@ class ResNet(nn.Module):
     ) -> jnp.ndarray:
         c = self.cfg
         x = x.astype(self.dtype)
+        x = pad_channels(x, c.stem_pad_c)
         x = ConvBN(c.width, kernel=7, stride=2, act="relu", epsilon=_BN_EPS,
                    dtype=self.dtype, name="stem")(x, train)
         # Explicit (1, 1) padding = torch's MaxPool2d(3, 2, padding=1);

@@ -22,6 +22,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..ops.preprocess import pad_channels
 from .common import Dtype
 from .transformer import AttnFn, Encoder, EncoderConfig
 
@@ -33,6 +34,11 @@ class VideoMAEConfig:
     patch_size: int = 16
     num_frames: int = 8
     tubelet_size: int = 2
+    # Lane-fill channel padding for the tubelet conv (ops.preprocess
+    # .pad_channels; cpad lever, LEVERS_r05): proj kernel grows
+    # [ts,p,p,3,D]->[ts,p,p,pad,D], zero input planes keep outputs
+    # identical; import_weights zero-pads checkpoints. 0 = off.
+    patch_pad_c: int = 0
     encoder: EncoderConfig = field(default_factory=EncoderConfig)
     # Light decoder for the MAE pretrain objective (VideoMAE uses a narrow
     # 4-layer decoder; scaled here with the encoder config).
@@ -70,15 +76,17 @@ class TubeletEmbed(nn.Module):
     patch_size: int
     tubelet_size: int
     dtype: Dtype = jnp.bfloat16
+    pad_c: int = 0     # lane-fill channel padding (VideoMAEConfig.patch_pad_c)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         """[B, T, H, W, 3] -> [B, tokens, dim]."""
         p, ts = self.patch_size, self.tubelet_size
+        x = pad_channels(x.astype(self.dtype), self.pad_c)
         x = nn.Conv(
             self.dim, kernel_size=(ts, p, p), strides=(ts, p, p),
             padding="VALID", dtype=self.dtype, name="proj",
-        )(x.astype(self.dtype))
+        )(x)
         b = x.shape[0]
         return x.reshape(b, -1, self.dim)
 
@@ -91,7 +99,8 @@ class VideoMAE(nn.Module):
     def setup(self):
         c = self.cfg
         self.embed = TubeletEmbed(
-            c.encoder.dim, c.patch_size, c.tubelet_size, self.dtype, name="tubelet"
+            c.encoder.dim, c.patch_size, c.tubelet_size, self.dtype,
+            pad_c=c.patch_pad_c, name="tubelet"
         )
         self.pos_embed = self.param(
             "pos_embed", nn.initializers.normal(0.02),

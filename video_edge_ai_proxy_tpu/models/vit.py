@@ -14,6 +14,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops.preprocess import pad_channels
 from .common import Dtype
 from .transformer import AttnFn, Encoder, EncoderConfig
 
@@ -24,6 +25,11 @@ class ViTConfig:
     image_size: int = 224
     patch_size: int = 16
     encoder: EncoderConfig = field(default_factory=EncoderConfig)  # B/16 defaults
+    # Lane-fill channel padding for the patchify conv (ops.preprocess
+    # .pad_channels; cpad lever, LEVERS_r05): kernel grows
+    # [p,p,3,D]->[p,p,pad,D], zero input planes keep outputs identical;
+    # import_weights zero-pads checkpoints. 0 = off.
+    patch_pad_c: int = 0
 
     @property
     def num_patches(self) -> int:
@@ -48,6 +54,7 @@ class ViT(nn.Module):
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         c = self.cfg
         x = x.astype(self.dtype)
+        x = pad_channels(x, c.patch_pad_c)
         p = c.patch_size
         x = nn.Conv(
             c.encoder.dim, kernel_size=(p, p), strides=(p, p),

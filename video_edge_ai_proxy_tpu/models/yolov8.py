@@ -23,6 +23,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..ops.boxes import dist_to_bbox
+from ..ops.preprocess import pad_channels
 from .common import ConvBN, Dtype, make_divisible, round_depth
 
 
@@ -238,13 +239,9 @@ class YOLOv8(nn.Module):
             x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * ci)
             x = ConvBN(ch(64), dtype=self.dtype, name="stem")(x, train)             # P1
         else:
-            if c.stem_pad_c > x.shape[-1]:
-                # Lane-fill: zero input planes cost bandwidth but let XLA
-                # tile the stem conv with full input-channel vectors.
-                x = jnp.pad(
-                    x, ((0, 0), (0, 0), (0, 0),
-                        (0, c.stem_pad_c - x.shape[-1]))
-                )
+            # Lane-fill: zero input planes cost bandwidth but let XLA
+            # tile the stem conv with full input-channel vectors.
+            x = pad_channels(x, c.stem_pad_c)
             x = ConvBN(ch(64), stride=2, dtype=self.dtype, name="stem")(x, train)   # P1
         x = ConvBN(ch(128), stride=2, dtype=self.dtype, name="down2")(x, train)     # P2
         x = C2f(ch(128), d(3), True, self.dtype, name="c2f_2")(x, train)

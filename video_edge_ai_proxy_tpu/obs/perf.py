@@ -128,17 +128,20 @@ class _RateWindow:
 class _H2DCell:
     """Per-(model, bucket) host->device transfer accounting: pre-resolved
     counter children + running totals, same fixed-allocation discipline
-    as :class:`_BatchCell` (``note_h2d`` runs on the engine tick thread,
-    once per dispatched batch)."""
+    as :class:`_BatchCell` (``note_h2d`` runs once per dispatched batch
+    — on the engine tick thread, which with the prefetch stage enabled
+    just relays the numbers the transfer thread measured)."""
 
-    __slots__ = ("bytes_child", "seconds_child", "bytes", "seconds",
-                 "batches", "slots")
+    __slots__ = ("bytes_child", "seconds_child", "hidden_child", "bytes",
+                 "seconds", "hidden_s", "batches", "slots")
 
-    def __init__(self, bytes_child, seconds_child):
+    def __init__(self, bytes_child, seconds_child, hidden_child):
         self.bytes_child = bytes_child
         self.seconds_child = seconds_child
+        self.hidden_child = hidden_child
         self.bytes = 0
         self.seconds = 0.0
+        self.hidden_s = 0.0
         self.batches = 0
         self.slots = 0
 
@@ -236,11 +239,18 @@ class PerfTracker:
         self._m_h2d_bytes = reg.counter(
             "vep_h2d_bytes",
             "Host->device bytes shipped per dispatched batch (uint8 "
-            "frames incl. bucket padding)", ("model", "bucket"))
+            "frames incl. bucket padding, plus aux tensors such as the "
+            "int32 thumbnail slot-index vector)", ("model", "bucket"))
         self._m_h2d_seconds = reg.counter(
             "vep_h2d_seconds",
-            "Wall seconds spent placing batches on device (device_put / "
-            "dispatch handoff)", ("model", "bucket"))
+            "Wall seconds of async device_put transfer per batch, timed "
+            "on the prefetch transfer thread (copy start to "
+            "block_until_ready)", ("model", "bucket"))
+        self._m_h2d_hidden = reg.counter(
+            "vep_h2d_hidden_seconds",
+            "Share of H2D transfer wall seconds that overlapped in-flight "
+            "device compute or dispatch work (prefetch stage)",
+            ("model", "bucket"))
 
     # -- compile-time attribution ----------------------------------------
 
@@ -313,19 +323,28 @@ class PerfTracker:
         self._m_fps.set(self._fps.rate(now))
 
     def note_h2d(self, model: str, bucket: int, nbytes: int,
-                 seconds: float) -> None:
+                 seconds: float, *, hidden_s: float = 0.0) -> None:
         """Record one host->device batch placement: ``nbytes`` on the wire
-        (the full padded uint8 batch) taking ``seconds`` of tick-thread
-        wall time. Runs once per dispatched batch on the tick loop, so it
-        follows the same fixed-allocation cell discipline as
-        ``note_batch`` — the direct measurement behind ROADMAP item 5's
-        bytes-per-frame gate."""
+        (the full padded uint8 batch plus aux tensors such as the int32
+        thumbnail slot-index vector) taking ``seconds`` of transfer wall
+        time. With the prefetch stage enabled this is a real async
+        ``device_put`` timed on the dedicated transfer thread (copy start
+        to ``block_until_ready``); ``hidden_s`` is the portion of that
+        window which overlapped in-flight device compute or dispatch work
+        on the tick thread — the evidence behind ``h2d_hidden_pct``.
+        Without prefetch it degrades to the legacy synchronous placement
+        timing with ``hidden_s`` = 0. Called once per dispatched batch,
+        same fixed-allocation cell discipline as ``note_batch`` — the
+        direct measurement behind ROADMAP item 5's bytes-per-frame gate."""
         key = (model, bucket)
         cell = self._h2d.get(key)
         if cell is None:
             cell = self._make_h2d_cell(key)
         cell.bytes_child.inc(nbytes)
         cell.seconds_child.inc(seconds)
+        if hidden_s > 0.0:
+            cell.hidden_child.inc(hidden_s)
+            cell.hidden_s += float(hidden_s)
         cell.bytes += int(nbytes)
         cell.seconds += float(seconds)
         cell.batches += 1
@@ -337,6 +356,7 @@ class PerfTracker:
         cell = _H2DCell(
             bytes_child=self._m_h2d_bytes.labels(model, b),
             seconds_child=self._m_h2d_seconds.labels(model, b),
+            hidden_child=self._m_h2d_hidden.labels(model, b),
         )
         with self._lock:
             return self._h2d.setdefault(key, cell)
@@ -383,11 +403,19 @@ class PerfTracker:
                     "mfu_pct": round(util, 3) if util is not None else None,
                 })
             h2d = []
+            h2d_seconds = 0.0
+            h2d_hidden = 0.0
             for (model, bucket), cell in sorted(self._h2d.items()):
+                h2d_seconds += cell.seconds
+                h2d_hidden += cell.hidden_s
                 h2d.append({
                     "model": model, "bucket": bucket,
                     "bytes": cell.bytes,
                     "seconds": round(cell.seconds, 6),
+                    "hidden_seconds": round(cell.hidden_s, 6),
+                    "hidden_pct": (round(100.0 * cell.hidden_s
+                                         / cell.seconds, 1)
+                                   if cell.seconds > 0 else None),
                     "batches": cell.batches,
                     "bytes_per_frame": (cell.bytes // cell.slots
                                         if cell.slots else None),
@@ -402,4 +430,6 @@ class PerfTracker:
                                          r["bucket"])),
             "buckets": buckets,
             "h2d": h2d,
+            "h2d_hidden_pct": (round(100.0 * h2d_hidden / h2d_seconds, 1)
+                               if h2d_seconds > 0 else None),
         }

@@ -107,9 +107,24 @@ class EngineConfig:
     # (jax default); "auto" = the server resolves <data_dir>/compile_cache.
     compile_cache_dir: str = ""
     # Geometries to compile at boot instead of on first frame: list of
-    # [height, width, bucket]. Big programs (e.g. ViT at bucket 32) can take
-    # minutes to compile; prewarming moves that cost out of the hot path.
+    # [height, width, bucket] or [height, width, bucket, model] (the
+    # 4-element form prewarms a non-default registry model's program —
+    # multi-family fleets otherwise hit the compile stall mid-soak on the
+    # first frame of each extra model). Big programs (e.g. ViT at bucket
+    # 32) can take minutes to compile; prewarming moves that cost out of
+    # the hot path.
     prewarm: list = field(default_factory=list)
+    # H2D prefetch stage (ROADMAP item 5): batch placement runs as a real
+    # async jax.device_put on a dedicated transfer thread so the copy of
+    # batch t+1 overlaps device compute for batch t (double-buffered: at
+    # most 2 placements outstanding, matching the depth-2 drain
+    # pipeline). False = legacy synchronous placement on the tick thread.
+    prefetch: bool = True
+    # Donate the frames argument to the compiled step (jax donate_argnums)
+    # so XLA reuses the input HBM slot instead of allocating one per tick.
+    # "auto" = donate where the backend implements donation (TPU; the CPU
+    # test backend would warn per call and copy anyway), "on"/"off" force.
+    donate_frames: str = "auto"
     # /healthz flags the engine loop wedged when no tick completed for this
     # long. Must exceed the longest legitimate in-tick XLA compile (first
     # frame of a new geometry compiles inside the tick) or a k8s liveness
