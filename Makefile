@@ -2,7 +2,8 @@
 # whole build; ours adds the native bus lib and test/bench shortcuts).
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
-	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke
+	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
+	roi-smoke
 
 all: proto native
 
@@ -143,6 +144,23 @@ h2d-smoke:
 		assert not d['exposition_problems'], d['exposition_problems']; \
 		print('h2d overlap: %.1f%% of transfer wall hidden (%d batches/geometry)' \
 			% (d['h2d_hidden_pct'], d['batches_per_geometry']))"
+
+# MOSAIC ROI serving smoke: two lockstep serves over a color-keyed
+# synthetic fleet (3 moving + 3 static streams, blob-gauge model),
+# roi=False baseline vs roi=True packed path. Gates (in
+# tools/roi_smoke.py, exit non-zero on breach): mean IoU vs analytic
+# ground truth >= 0.9, ZERO misrouted/unrouted detections, the motion
+# gate engaged (idle+roi stream-ticks, >=1 canvas), and >= 2x
+# full-frame-equivalent throughput per device frame. The committed
+# ROI_r01.json artifact is a pinned run of this tool. ~30 s.
+roi-smoke:
+	python tools/roi_smoke.py | tee /tmp/vep_roi_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_roi_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); \
+		print('roi serving: %.2fx equivalent fps, IoU mean %.4f, %d crops on %d canvases' \
+			% (d['equivalent_fps_gain'], d['roi']['iou_mean'], \
+			   d['roi']['perf_roi']['crops'], d['roi']['perf_roi']['canvases']))"
 
 # Performance regression gate: run the bench, then compare its JSON line
 # against the committed BENCH_r*.json trajectory (tools/bench_gate.py;

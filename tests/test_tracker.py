@@ -85,6 +85,52 @@ class TestIoUTracker:
         assert near == a and far != a
 
 
+class TestTrackerCoasting:
+    """The ROI-serving surface (engine/runner.py MOSAIC gate): tracks()
+    snapshots, stored confidences, and empty-update coasting."""
+
+    def test_tracks_snapshot_is_isolated(self):
+        tr = IoUTracker()
+        (tid,) = tr.update([_box(10, 20)], [4], scores=[0.9])
+        (snap,) = tr.tracks()
+        assert snap["track_id"] == int(tid)
+        assert snap["box"] == (10.0, 20.0, 30.0, 40.0)
+        assert snap["class_id"] == 4
+        assert snap["misses"] == 0
+        assert snap["confidence"] == 0.9
+        # Mutating the snapshot never reaches tracker state.
+        snap["box"] = (0, 0, 0, 0)
+        assert tr.tracks()[0]["box"] == (10.0, 20.0, 30.0, 40.0)
+
+    def test_scores_update_confidence_and_omission_keeps_it(self):
+        tr = IoUTracker()
+        tr.update([_box(10, 10)], [0], scores=[0.8])
+        tr.update([_box(11, 11)], [0], scores=[0.6])
+        assert tr.tracks()[0]["confidence"] == 0.6
+        tr.update([_box(12, 12)], [0])          # scores omitted
+        assert tr.tracks()[0]["confidence"] == 0.6   # last value kept
+        (tid,) = tr.update([_box(200, 200)], [1])    # new track, no score
+        t = next(t for t in tr.tracks() if t["track_id"] == int(tid))
+        assert t["confidence"] == 0.0
+
+    def test_empty_update_coasts_predicted_box_and_counts_misses(self):
+        """The gated-idle emission path: update([], []) advances the
+        velocity prediction and ages misses so stale tracks still expire
+        while a stream is gated."""
+        tr = IoUTracker(max_misses=3)
+        for f in range(3):                       # 4 px/frame rightward
+            tr.update([_box(10 + 4 * f, 10)], [0], scores=[0.9])
+        assert tr.update([], []) == []           # no detections assigned
+        (t,) = tr.tracks()
+        assert t["misses"] == 1
+        # Velocity EMA converges toward 4 px/frame; the coasted box moved
+        # right of the last measured position.
+        assert t["box"][0] > 18.0
+        for _ in range(3):                       # misses 2..4: past cap
+            tr.update([], [])
+        assert tr.live_tracks == 0               # expired while coasting
+
+
 class TestEngineTracking:
     def test_tracker_resets_on_model_switch_and_expires_on_empty(self):
         """Engine-level guarantees: (a) a stream's tracker resets when its

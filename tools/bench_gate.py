@@ -119,6 +119,12 @@ def gate(current: dict, trajectory: list, tolerance: float,
     # metric-matched first-run pass.
     if current.get("h2d_hidden_pct") is not None:
         report["h2d_hidden_pct"] = current["h2d_hidden_pct"]
+    # Same pattern for the round-9 ROI serving evidence: when the bench
+    # line carries MOSAIC numbers (roi_smoke.py fields folded in), they
+    # ride along for the log — informational only, never gated.
+    for key in ("roi_equivalent_fps", "roi_canvas_occupancy_pct"):
+        if current.get(key) is not None:
+            report[key] = current[key]
     if not usable:
         report.update(passed=True, reason="no committed baseline for "
                       f"metric {metric!r} (first run records the bar)")

@@ -1,0 +1,251 @@
+"""ROI serving smoke: prove the MOSAIC packed path returns full-frame
+results at a fraction of the device work.
+
+Two lockstep serves over the SAME deterministic synthetic fleet — half
+the streams idle (static scene), half active (a blob in slow motion) —
+once with cfg.roi=False (classic full frames, the baseline) and once
+with cfg.roi=True (motion-gated crop packing, engine/runner.py
+``_roi_transform``). Scenes are blob-gauge color-keyed (models/blob.py):
+every detection's class id names the stream that owns it, so a
+scatter-back routing bug is directly observable as a misrouted
+detection, and every emitted box is compared against the analytically
+known blob position. Gates, exit non-zero on breach (ISSUE 9
+acceptance):
+
+- detection/ground-truth agreement: mean IoU >= 0.9 on the ROI run
+  (the gauge is detect-exact, so anything below that is a serving bug),
+- ZERO misrouted detections (a result carrying another stream's color
+  key) and zero unrouted canvas detections,
+- the gate actually engaged: idle + roi stream-ticks > 0 and >= 1
+  packed canvas served,
+- full-frame-equivalent throughput: stream results per device frame
+  >= 2x the baseline's (idle coasting + crop packing shrink the device
+  plane; the baseline ratio is ~1 by construction).
+
+Runs in ~30 s on the CPU twin; wired as ``make roi-smoke``. One JSON
+line on stdout; ``--out`` additionally writes the artifact (committed
+as ROI_r01.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _iou(a, b) -> float:
+    ix0, iy0 = max(a[0], b[0]), max(a[1], b[1])
+    ix1, iy1 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(0, ix1 - ix0) * max(0, iy1 - iy0)
+    area_a = (a[2] - a[0]) * (a[3] - a[1])
+    area_b = (b[2] - b[0]) * (b[3] - b[1])
+    return inter / float(area_a + area_b - inter) if inter else 0.0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--native", action="store_true",
+                    help="use the environment's real backend instead of "
+                         "forcing CPU")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="seconds to serve per pass (default 10)")
+    ap.add_argument("--active", type=int, default=3,
+                    help="streams with a moving blob (default 3)")
+    ap.add_argument("--idle", type=int, default=3,
+                    help="streams with a static scene (default 3)")
+    ap.add_argument("--min-iou", type=float, default=0.9)
+    ap.add_argument("--min-gain", type=float, default=2.0,
+                    help="required full-frame-equivalent throughput gain")
+    ap.add_argument("--out", default="",
+                    help="also write the artifact JSON here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if not args.native:
+        jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+
+    import queue as _queue
+
+    import numpy as np
+
+    from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+    from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+    from video_edge_ai_proxy_tpu.engine import InferenceEngine
+    from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.models.blob import blob_color
+    from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+    from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+    model = "blob_gauge" if backend == "tpu" else "tiny_blob_gauge"
+    spec = registry.get(model)
+    side = spec.input_size            # frames == model input: exact boxes
+    n_streams = args.active + args.idle
+    assert n_streams <= 8, "one color key per stream (8 bins)"
+    blob_w, blob_h = max(8, side // 6), max(8, side // 8)
+    span = side - blob_w - 16         # triangle-wave travel for movers
+
+    def scene(stream: int, step: int):
+        """Deterministic frame + ground-truth box for (stream, step)."""
+        frame = np.full((side, side, 3), 114, np.uint8)
+        if stream < args.active:      # mover: 1 px/publish triangle wave
+            phase = step % (2 * span)
+            x0 = 8 + (phase if phase < span else 2 * span - phase)
+        else:                         # static scene
+            x0 = 8 + 5 * stream
+        y0 = 8 + 4 * stream
+        box = (x0, y0, x0 + blob_w, y0 + blob_h)
+        frame[box[1]:box[3], box[0]:box[2]] = blob_color(stream)
+        return frame, box
+
+    def serve(roi: bool) -> dict:
+        bus = MemoryFrameBus()
+        try:
+            eng = InferenceEngine(
+                bus,
+                EngineConfig(
+                    model=model, batch_buckets=(1, 2, 4, 8), tick_ms=10,
+                    prof=False, roi=roi, roi_canvas=side,
+                    roi_min_crop=max(8, side // 8),
+                    roi_full_interval_ms=500,
+                ),
+                annotations=AnnotationQueue(handler=lambda batch: True),
+            )
+            eng.warmup()
+            for s in range(n_streams):
+                bus.create_stream(f"cam{s}", side * side * 3)
+            results_q: _queue.Queue = _queue.Queue()
+            with eng._sub_lock:
+                eng._subscribers.append((results_q, None))
+            truth = {}                 # (device_id, ts) -> (key, box)
+            results = []
+            eng.start()
+            try:
+                deadline = time.monotonic() + args.duration
+                step = 0
+                last_ts = 0
+                while time.monotonic() < deadline:
+                    ts = max(int(time.time() * 1000), last_ts + 1)
+                    last_ts = ts
+                    for s in range(n_streams):
+                        frame, box = scene(s, step)
+                        truth[(f"cam{s}", ts)] = (s, box)
+                        bus.publish(
+                            f"cam{s}", frame,
+                            FrameMeta(width=side, height=side, channels=3,
+                                      timestamp_ms=ts, is_keyframe=True))
+                    step += 1
+                    time.sleep(0.03)
+                    while True:
+                        try:
+                            results.append(results_q.get_nowait())
+                        except _queue.Empty:
+                            break
+            finally:
+                eng.stop()
+            while True:
+                try:
+                    results.append(results_q.get_nowait())
+                except _queue.Empty:
+                    break
+            snap = eng.perf.snapshot()
+        finally:
+            bus.close()
+
+        results = [r for r in results if r is not None]  # stop() sentinel
+        ious, misrouted, matched = [], 0, 0
+        for r in results:
+            key_box = truth.get((r.device_id, r.timestamp))
+            if key_box is None or not r.detections:
+                continue
+            key, box = key_box
+            for d in r.detections:
+                if d.class_id != key:
+                    misrouted += 1
+                    continue
+                matched += 1
+                ious.append(_iou(
+                    (d.box.left, d.box.top, d.box.left + d.box.width,
+                     d.box.top + d.box.height), box))
+        device_frames = sum(b["frames"] for b in snap["buckets"])
+        n_results = len(results)
+        return {
+            "roi": roi,
+            "results": n_results,
+            "device_frames": device_frames,
+            "results_per_device_frame": (
+                round(n_results / device_frames, 3) if device_frames else None),
+            "matched_detections": matched,
+            "misrouted": misrouted,
+            "iou_mean": round(float(np.mean(ious)), 4) if ious else None,
+            "iou_min": round(float(np.min(ious)), 4) if ious else None,
+            "perf_roi": snap.get("roi"),
+        }
+
+    base = serve(roi=False)
+    packed = serve(roi=True)
+
+    gain = None
+    if base["results_per_device_frame"] and packed["results_per_device_frame"]:
+        gain = round(packed["results_per_device_frame"]
+                     / base["results_per_device_frame"], 2)
+    roi_stats = packed["perf_roi"] or {}
+    ticks = roi_stats.get("stream_ticks", {})
+    out = {
+        "tool": "roi_smoke",
+        "backend": backend,
+        "model": model,
+        "duration_s": args.duration,
+        "streams": {"active": args.active, "idle": args.idle},
+        "baseline": base,
+        "roi": packed,
+        "equivalent_fps_gain": gain,
+        "gates": {
+            "min_iou": args.min_iou,
+            "min_gain": args.min_gain,
+        },
+    }
+    print(json.dumps(out), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+
+    if packed["matched_detections"] < 20:
+        raise SystemExit(
+            f"roi_smoke: only {packed['matched_detections']} matched "
+            "detections on the ROI pass — the serve never reached steady "
+            "state")
+    if packed["misrouted"] or base["misrouted"]:
+        raise SystemExit(
+            f"roi_smoke: misrouted detections (roi={packed['misrouted']}, "
+            f"baseline={base['misrouted']}) — scatter-back sent a box to "
+            "the wrong stream")
+    if roi_stats.get("unrouted"):
+        raise SystemExit(
+            f"roi_smoke: {roi_stats['unrouted']} unrouted canvas "
+            "detections (expected 0 with non-overlapping per-stream keys)")
+    if packed["iou_mean"] is None or packed["iou_mean"] < args.min_iou:
+        raise SystemExit(
+            f"roi_smoke: ROI-pass IoU mean {packed['iou_mean']} < "
+            f"{args.min_iou} (baseline mean {base['iou_mean']})")
+    if not (ticks.get("idle", 0) + ticks.get("roi", 0)) \
+            or not roi_stats.get("canvases"):
+        raise SystemExit(
+            f"roi_smoke: motion gate never engaged: {roi_stats}")
+    if gain is None or gain < args.min_gain:
+        raise SystemExit(
+            f"roi_smoke: full-frame-equivalent gain {gain} < "
+            f"{args.min_gain} (device frames: baseline "
+            f"{base['device_frames']}, roi {packed['device_frames']})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

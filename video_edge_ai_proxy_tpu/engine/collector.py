@@ -40,6 +40,16 @@ class BatchGroup:
     lease: Optional[tuple] = None  # (pool shape, buf idx) when the frames
                                    # view a pooled buffer under strict
                                    # leasing (Collector.release returns it)
+    # MOSAIC lineage (cfg.roi, engine/runner.py). ``crops``: this group's
+    # frames are packed shared canvases, one CropPlacement per blitted
+    # crop — the provenance the scatter-back path needs to route canvas
+    # detections to their source streams. ``coast``: no device work at
+    # all; list of (device_id, meta, detections) for gated-idle streams
+    # whose tracker-coasted results ride the drain queue so per-stream
+    # emit ordering is preserved. Both None on the classic full-frame
+    # path — which is exactly what keeps roi=False bit-identical.
+    crops: Optional[list] = None
+    coast: Optional[list] = None
 
     @property
     def padded_slots(self) -> int:
@@ -74,6 +84,147 @@ def pad_to_bucket(group: BatchGroup, buckets: Sequence[int]) -> BatchGroup:
         group.frames = np.concatenate([group.frames, pad], axis=0)
     group.bucket = bucket
     return group
+
+
+@dataclass(frozen=True)
+class CropPlacement:
+    """Provenance for one crop blitted onto a shared canvas (MOSAIC).
+
+    The forward placement is a pure integer affine — source rect ``src``
+    decimated by ``scale`` (source px per canvas px, power of two) and
+    blitted with its top-left corner at ``dst``'s origin — so the
+    scatter-back inverse (ops/boxes.py ``uncrop_boxes``) is exact:
+    ``src_px = (canvas_px - dst_origin) * scale + src_origin``.
+    """
+
+    device_id: str
+    meta: FrameMeta          # the source frame's meta (timestamps, packet)
+    canvas: int              # slot index within the canvas batch
+    src: tuple               # (x0, y0, x1, y1) source-frame px (ints)
+    dst: tuple               # (x0, y0, x1, y1) canvas px (ints)
+    scale: int               # source px per canvas px (>= 1, power of 2)
+
+    def contains(self, x: float, y: float) -> bool:
+        """Does a canvas-coordinate point land in this crop's cell? Used
+        by the scatter-back router: one cell per detection center, cells
+        never overlap (the packer keeps a gap between them)."""
+        return (self.dst[0] <= x < self.dst[2]
+                and self.dst[1] <= y < self.dst[3])
+
+
+class CanvasPacker:
+    """Deterministic shelf packer: many streams' active crops → a small
+    set of static-shape shared canvases (MOSAIC, arxiv 2305.03222).
+
+    Geometry is the bucket: every canvas is ``side``×``side`` uint8, so
+    the packed batch reuses the engine's existing (geometry, bucket) step
+    cache — XLA still sees a small closed shape set, no new programs
+    beyond the one canvas geometry. Packing is deterministic (sort by
+    scaled height/width then stream id, first-fit shelves) so replaying
+    the same crops yields byte-identical canvases — the property the
+    replay-checksum harness leans on.
+
+    Crops larger than a canvas are decimated by the smallest power-of-two
+    stride that fits; power-of-two strided views keep the inverse
+    transform exact (no fractional resampling) and the blit a cheap numpy
+    strided copy. A ``gap`` of background pixels separates cells so a
+    detection can never straddle two streams' crops; background is 114
+    gray, matching ``preprocess_letterbox``'s pad value so cell borders
+    look like letterbox padding to the detector.
+    """
+
+    def __init__(self, side: int = 640, gap: int = 8,
+                 max_canvases: int = 8, min_crop: int = 16):
+        self.side = int(side)
+        self.gap = int(gap)
+        self.max_canvases = int(max_canvases)
+        self.min_crop = int(min_crop)
+
+    def _fit_scale(self, w: int, h: int) -> int:
+        scale = 1
+        while (w + scale - 1) // scale > self.side \
+                or (h + scale - 1) // scale > self.side:
+            scale *= 2
+        return scale
+
+    def pack(self, requests: Sequence[tuple]):
+        """``requests``: (device_id, meta, frame [H,W,3] u8, roi xyxy).
+
+        Returns (canvases [K, side, side, 3] u8, placements, overflow):
+        ``placements`` one CropPlacement per packed crop, ``overflow``
+        the request indices that did not fit within ``max_canvases``
+        (the engine falls those streams back to the full-frame path).
+        """
+        side, gap = self.side, self.gap
+        prepared = []   # (sh, sw, scale, rect, req_index)
+        overflow: List[int] = []
+        for ri, (device_id, _meta, frame, roi) in enumerate(requests):
+            fh, fw = frame.shape[0], frame.shape[1]
+            x0 = max(0, min(int(roi[0]), fw - 1))
+            y0 = max(0, min(int(roi[1]), fh - 1))
+            x1 = max(x0 + 1, min(int(round(roi[2])), fw))
+            y1 = max(y0 + 1, min(int(round(roi[3])), fh))
+            # Tiny ROIs inflate to min_crop: the detector needs context
+            # and the NMS floor behaves badly on few-pixel cells.
+            if x1 - x0 < self.min_crop:
+                x1 = min(fw, x0 + self.min_crop)
+                x0 = max(0, x1 - self.min_crop)
+            if y1 - y0 < self.min_crop:
+                y1 = min(fh, y0 + self.min_crop)
+                y0 = max(0, y1 - self.min_crop)
+            scale = self._fit_scale(x1 - x0, y1 - y0)
+            sw = (x1 - x0 + scale - 1) // scale
+            sh = (y1 - y0 + scale - 1) // scale
+            prepared.append((sh, sw, scale, (x0, y0, x1, y1), ri))
+        # Deterministic shelf order: tallest first, then widest, then
+        # stream id — identical input always packs identically.
+        prepared.sort(key=lambda p: (-p[0], -p[1],
+                                     requests[p[4]][0], p[4]))
+        placements: List[CropPlacement] = []
+        slots = []   # per-canvas shelf cursors: [x, y, shelf_h]
+        blits = []   # (canvas, dst, rect, scale, req_index)
+        for sh, sw, scale, rect, ri in prepared:
+            placed = False
+            for ci, cur in enumerate(slots):
+                x, y, shelf_h = cur
+                if x + sw > side:                     # next shelf
+                    x, y, shelf_h = 0, y + shelf_h + gap, 0
+                if x + sw <= side and y + sh <= side:
+                    blits.append((ci, (x, y, x + sw, y + sh),
+                                  rect, scale, ri))
+                    slots[ci] = [x + sw + gap, y, max(shelf_h, sh)]
+                    placed = True
+                    break
+            if not placed:
+                if len(slots) < self.max_canvases:
+                    ci = len(slots)
+                    slots.append([sw + gap, 0, sh])
+                    blits.append((ci, (0, 0, sw, sh), rect, scale, ri))
+                else:
+                    overflow.append(ri)
+        canvases = np.full((len(slots), side, side, 3), 114, np.uint8)
+        for ci, dst, rect, scale, ri in blits:
+            device_id, meta, frame, _roi = requests[ri]
+            x0, y0, x1, y1 = rect
+            view = frame[y0:y1:scale, x0:x1:scale]
+            canvases[ci, dst[1]:dst[3], dst[0]:dst[2]] = view
+            placements.append(CropPlacement(
+                device_id=device_id, meta=meta, canvas=ci,
+                src=rect, dst=dst, scale=scale,
+            ))
+        return canvases, placements, overflow
+
+    @staticmethod
+    def area_fraction(placements: Sequence[CropPlacement],
+                      n_canvases: int, side: int) -> float:
+        """Crop-pixel share of the canvas batch — the crop-level
+        occupancy obs/perf.py reports for packed batches (a canvas is
+        NOT one fully-occupied slot)."""
+        if not n_canvases:
+            return 0.0
+        used = sum((p.dst[2] - p.dst[0]) * (p.dst[3] - p.dst[1])
+                   for p in placements)
+        return used / float(n_canvases * side * side)
 
 
 class Collector:

@@ -53,7 +53,7 @@ from ..resilience.ladder import RUNGS, DegradationLadder
 from ..utils.config import EngineConfig
 from ..utils.logging import get_logger, reset_log_context, set_log_context
 from .classes import class_name
-from .collector import BatchGroup, Collector
+from .collector import BatchGroup, CanvasPacker, Collector, pad_to_bucket
 
 log = get_logger("engine.runner")
 
@@ -531,6 +531,70 @@ class _PrefetchStage:
             pre.ready.set()
 
 
+class _RoiGate:
+    """Per-stream motion-gate state for MOSAIC ROI serving (cfg.roi).
+
+    Classification inputs are both *feedback* signals: the previous
+    tick's device thumbnail diff energy (ops/preprocess.py
+    frame_quality_stats, observed host-side in ``_emit``) and the
+    stream's IoUTracker state (updated in ``_emit`` from the previous
+    detections). The verdict per detect stream per tick:
+
+    - ``full``  — refresh cadence due, or no gating signal yet, or
+      motion with no tracks to localize it: run the classic full frame
+      (also the only slots that refresh quality stats, so the diff
+      signal can never starve itself).
+    - ``idle``  — diff energy below ``roi_idle_diff``: no device work;
+      the tracker coasts one frame (misses age so stale tracks expire)
+      and its predicted boxes emit with decayed confidence.
+    - ``roi``   — motion with live tracks: crops around the predicted
+      track boxes join the shared canvases.
+
+    Dict-like protocol (``__iter__``/``__len__``/``pop``) so the
+    engine's debounced stream GC treats it exactly like the tracker /
+    thumbnail state maps. All access runs under the engine's
+    ``_state_lock`` (tick-thread classify + GC, drain-thread feedback).
+    """
+
+    def __init__(self, idle_diff: float, full_interval_ms: float):
+        self.idle_diff = float(idle_diff)
+        self.full_interval_s = full_interval_ms / 1000.0
+        self._streams: Dict[str, dict] = {}
+
+    def __bool__(self) -> bool:
+        return bool(self._streams)
+
+    def __iter__(self):
+        return iter(self._streams)
+
+    def __len__(self) -> int:
+        return len(self._streams)
+
+    def pop(self, device_id: str, default=None):
+        return self._streams.pop(device_id, default)
+
+    def state(self, device_id: str) -> dict:
+        return self._streams.setdefault(
+            device_id, {"diff": None, "full_at": 0.0})
+
+    def note_diff(self, device_id: str, diff: float) -> None:
+        self.state(device_id)["diff"] = float(diff)
+
+    def note_full(self, device_id: str, now: float) -> None:
+        self.state(device_id)["full_at"] = now
+
+    def classify(self, device_id: str, tracker, now: float) -> str:
+        st = self.state(device_id)
+        if not st["full_at"] \
+                or now - st["full_at"] >= self.full_interval_s:
+            return "full"
+        if st["diff"] is not None and st["diff"] < self.idle_diff:
+            return "idle"
+        if tracker is not None and tracker.live_tracks:
+            return "roi"
+        return "full"
+
+
 class InferenceEngine:
     """Owns the model, the compiled step cache, and the engine thread."""
 
@@ -751,6 +815,21 @@ class InferenceEngine:
         # Device-resident thumbnail pool (dict-like: stream -> pool row).
         self._thumbs = _ThumbPool(self._cfg.quality_thumb)
         self._quality_device = False
+        # Spatially-multiplexed ROI serving (MOSAIC, ROADMAP item 1):
+        # motion gate state + shelf packer, built at warmup (the packer
+        # needs the effective bucket list). cfg.roi=False leaves both
+        # None — every batch then takes the classic full-frame path
+        # bit-identically (test-pinned kill switch). Mesh serving keeps
+        # full frames too: the canvas scatter-back assumes single-chip
+        # host outputs, same restriction as the thumbnail pool.
+        self._roi: Optional[_RoiGate] = None
+        self._packer: Optional[CanvasPacker] = None
+        if self._cfg.roi and not self._cfg.mesh:
+            self._roi = _RoiGate(
+                self._cfg.roi_idle_diff, self._cfg.roi_full_interval_ms)
+        elif self._cfg.roi:
+            log.info("roi: disabled under mesh serving (canvas "
+                     "scatter-back is single-chip); full frames remain")
         # H2D prefetch stage (cfg.prefetch): placement of collected
         # batches moves off the tick thread onto a dedicated transfer
         # thread, double-buffered at depth 2 to match the drain pipeline.
@@ -913,6 +992,16 @@ class InferenceEngine:
             self._variables = jax.device_put(self._variables)
         self._models[self._spec.name] = (self._spec, self._model, self._variables)
         self._buckets = buckets   # effective (mesh-filtered) buckets
+        if self._roi is not None:
+            # Canvas count per tick can never exceed the largest batch
+            # bucket (the packed group must still pad to a known bucket).
+            self._packer = CanvasPacker(
+                side=self._cfg.roi_canvas,
+                gap=self._cfg.roi_gap,
+                max_canvases=min(self._cfg.roi_max_canvases,
+                                 max(buckets)),
+                min_crop=self._cfg.roi_min_crop,
+            )
         self._collector = Collector(
             self._bus,
             buckets=buckets,
@@ -1634,6 +1723,11 @@ class InferenceEngine:
                     # Rung 1+: stale frames leave before they cost device
                     # time (shed oldest-first with a staleness bound).
                     groups = self._shed_stale_groups(groups)
+                if self._roi is not None and groups:
+                    # MOSAIC: motion-gate detect streams, pack active
+                    # crops onto shared canvases, coast gated-idle
+                    # streams (ROADMAP item 1).
+                    groups = self._roi_transform(groups)
                 t_collect = time.time() if self._cfg.stage_trace else 0.0
                 self._dispatch(groups, t_collect)
                 # Scope per-stream tracker state to streams that still
@@ -1643,7 +1737,8 @@ class InferenceEngine:
                 # re-creates its ring unlink-then-create — one sample in
                 # that window must not reset the stream's track-id
                 # numbering (invariant in _assign_tracks).
-                if self._trackers or self._ann_state or self._thumbs:
+                if self._trackers or self._ann_state or self._thumbs \
+                        or (self._roi is not None and self._roi):
                     now = time.monotonic()
                     # GC keys on bus PRESENCE, not on inference_streams():
                     # a live stream gated >grace (inference_model toggled
@@ -1651,9 +1746,11 @@ class InferenceEngine:
                     # would restart track-id numbering and reuse ids
                     # already uplinked for other objects.
                     present = set(present)
+                    roi_ids = set(self._roi) if self._roi is not None \
+                        else set()
                     with self._state_lock:
                         for d in (set(self._trackers) | set(self._ann_state)
-                                  | set(self._thumbs)):
+                                  | set(self._thumbs) | roi_ids):
                             if d in present:
                                 self._tracker_absent.pop(d, None)
                                 continue
@@ -1672,6 +1769,10 @@ class InferenceEngine:
                                 # (the tracker re-discards its first
                                 # zero-reference diff).
                                 self._thumbs.pop(d, None)
+                                # ROI gate state restarts with the
+                                # stream (first frame re-gates to full).
+                                if self._roi is not None:
+                                    self._roi.pop(d, None)
                                 if self.quality is not None:
                                     self.quality.forget(d)
                                 del self._tracker_absent[d]
@@ -1729,6 +1830,18 @@ class InferenceEngine:
         may still be reading the pooled host buffer.
         """
         trace_on = tracer.enabled
+        if self._roi is not None and groups:
+            # Tracker-coasted groups (gated-idle streams): no device
+            # work, but they ride the drain queue so per-stream emit
+            # ordering against earlier in-flight batches is preserved.
+            rest = []
+            for g in groups:
+                if g.coast is not None:
+                    self._enqueue_drain(
+                        _Inflight(g, None, time.time(), t_collect))
+                else:
+                    rest.append(g)
+            groups = rest
         handles: List[Optional[_Prefetched]] = []
 
         def _top_up(upto: int) -> None:
@@ -1774,7 +1887,12 @@ class InferenceEngine:
                     hidden_s = 0.0
                 idx = None
                 aux_nbytes = 0
-                if self._quality_device and group.frames.ndim == 4:
+                # Canvas groups (group.crops) never carry quality state:
+                # their synthetic _canvas<i> ids must not claim thumbnail
+                # pool rows, and a canvas "frame" has no per-stream diff
+                # meaning anyway (full-frame refreshes keep the signal).
+                if self._quality_device and group.frames.ndim == 4 \
+                        and group.crops is None:
                     idx = self._thumbs.gather_indices(
                         group.device_ids, group.bucket)
                     aux_nbytes = int(idx.nbytes)
@@ -1795,6 +1913,14 @@ class InferenceEngine:
                         group.device_ids, outputs.pop("quality_thumbs"))
                 else:
                     outputs = step(variables, placed)
+                    if group.crops is not None and isinstance(outputs, dict):
+                        # Quality-carrying steps still compute stats for
+                        # the canvas batch (same compiled program); they
+                        # are meaningless per-stream — drop them before
+                        # _emit's D2H fetch.
+                        outputs = dict(outputs)
+                        outputs.pop("quality_stats", None)
+                        outputs.pop("quality_thumbs", None)
             except Exception:
                 for gj in range(gi, len(groups)):
                     if gj < len(handles) and handles[gj] is not None:
@@ -1847,6 +1973,214 @@ class InferenceEngine:
             else:
                 out.append(kept)
         return out
+
+    # -- MOSAIC ROI serving (cfg.roi; ROADMAP item 1) --
+
+    def _roi_transform(self, groups: List[BatchGroup]) -> List[BatchGroup]:
+        """Motion-gate each detect group's rows and rewrite the tick's
+        work: ``full`` rows stay classic full frames (compacted in place,
+        shed_stale discipline — the lease rides with them), ``roi`` rows
+        become crops shelf-packed onto shared canvases (one synthetic
+        canvas group per tick, lease-free copies), ``idle`` rows become a
+        tracker-coasted group with no device work at all.
+
+        Ordering matters twice: crops blit (copy) out of the pooled
+        buffer BEFORE full rows compact (compaction moves rows upward
+        within the same view), and classification runs under
+        ``_state_lock`` because the drain thread feeds the gate (diff
+        energy, full-frame stamps) and trackers concurrently. Groups
+        that are not full-frame detect batches (clip inputs, embed/
+        classify models, already-transformed groups) pass through
+        untouched — with cfg.roi=False this method is never called and
+        the classic path is bit-identical (test-pinned)."""
+        out: List[BatchGroup] = []
+        for group in groups:
+            model = group.model or self._spec.name
+            entry = self._models.get(model)
+            spec = entry[0] if entry is not None else None
+            if (spec is None or spec.kind != "detect"
+                    or group.frames.ndim != 4
+                    or group.crops is not None or group.coast is not None):
+                out.append(group)
+                continue
+            now = time.monotonic()
+            full_rows: List[int] = []
+            coast: List[tuple] = []
+            reqs: List[tuple] = []    # CanvasPacker requests
+            req_row: List[int] = []   # request index -> group row
+            with self._state_lock:
+                for i, device_id in enumerate(group.device_ids):
+                    t_entry = self._trackers.get(device_id)
+                    tracker = (
+                        t_entry[1]
+                        if t_entry is not None and t_entry[0] == spec.name
+                        else None
+                    )
+                    verdict = self._roi.classify(device_id, tracker, now)
+                    if verdict == "idle":
+                        coast.append((
+                            device_id, group.metas[i],
+                            self._coasted_detections(tracker, spec),
+                        ))
+                        continue
+                    rects = (self._track_rois(tracker)
+                             if verdict == "roi" else [])
+                    if rects:
+                        for rect in rects:
+                            reqs.append((device_id, group.metas[i],
+                                         group.frames[i], rect))
+                            req_row.append(i)
+                    else:
+                        full_rows.append(i)
+            if not coast and not reqs:
+                # Everything full: the group passes through untouched.
+                # Still count the verdicts — synchronized refresh ticks
+                # (streams primed together expire together) would
+                # otherwise vanish from gated_stream_pct.
+                self.perf.note_roi_gate(0, 0, len(group.device_ids))
+                out.append(group)
+                continue
+            placements: list = []
+            n_canvases = 0
+            if reqs:
+                canvases, placements, overflow = self._packer.pack(reqs)
+                n_canvases = canvases.shape[0]
+                if overflow:
+                    # Crops that did not fit fall back to the full-frame
+                    # path. ALL of a spilled stream's placements leave
+                    # the routing table too — a stream must never emit
+                    # twice in one tick, so its already-placed crops'
+                    # canvas detections drop as unrouted (rare, counted).
+                    spill = {reqs[ri][0] for ri in overflow}
+                    placements = [p for p in placements
+                                  if p.device_id not in spill]
+                    spill_rows = sorted(
+                        {req_row[ri] for ri in range(len(reqs))
+                         if reqs[ri][0] in spill})
+                    full_rows = sorted(set(full_rows) | set(spill_rows))
+            self.perf.note_roi_gate(
+                len(coast), len({p.device_id for p in placements}),
+                len(full_rows))
+            if placements:
+                side = self._packer.side
+                n_used = 1 + max(p.canvas for p in placements)
+                metas = []
+                for ci in range(n_used):
+                    pts = [p.meta.timestamp_ms or 0
+                           for p in placements if p.canvas == ci]
+                    # Latency accounting for the canvas batch follows its
+                    # oldest member; per-stream latency uses each crop's
+                    # own meta at scatter-back.
+                    metas.append(FrameMeta(
+                        width=side, height=side, channels=3,
+                        timestamp_ms=min(pts) if pts else 0,
+                    ))
+                cgroup = BatchGroup(
+                    src_hw=(side, side),
+                    device_ids=[f"_canvas{ci}" for ci in range(n_used)],
+                    frames=canvases[:n_used],
+                    metas=metas,
+                    model=group.model,
+                    crops=placements,
+                )
+                out.append(pad_to_bucket(cgroup, self._buckets))
+                self.perf.note_roi_pack(
+                    len(placements), n_used,
+                    CanvasPacker.area_fraction(placements, n_used, side))
+            if coast:
+                out.append(BatchGroup(
+                    src_hw=group.src_hw,
+                    device_ids=[c[0] for c in coast],
+                    frames=np.empty((0,) + group.frames.shape[1:],
+                                    group.frames.dtype),
+                    metas=[c[1] for c in coast],
+                    bucket=0,
+                    model=group.model,
+                    coast=coast,
+                ))
+            if full_rows:
+                for new_i, old_i in enumerate(full_rows):
+                    if new_i != old_i:
+                        group.frames[new_i] = group.frames[old_i]
+                group.device_ids = [group.device_ids[i] for i in full_rows]
+                group.metas = [group.metas[i] for i in full_rows]
+                n = len(full_rows)
+                bucket = next(b for b in sorted(self._buckets) if b >= n)
+                view = group.frames[:bucket]
+                if bucket != n:
+                    view[n:] = 0
+                group.frames = view
+                group.bucket = bucket
+                out.append(group)
+            else:
+                # No full rows survive: the pooled buffer goes back now
+                # (canvases and coast groups hold copies, not views).
+                self._collector.release(group)
+        return out
+
+    def _coasted_detections(self, tracker, spec) -> List[pb.Detection]:
+        """Gated-idle emission: advance the stream's tracker one frame
+        with no detections (misses age, so stale tracks still expire
+        while the stream is gated) and render the surviving predicted
+        boxes as detections with geometrically decayed confidence.
+        Caller holds ``_state_lock``."""
+        if tracker is None:
+            return []
+        tracker.update([], [])
+        decay = self._cfg.roi_coast_decay
+        floor = self._cfg.roi_coast_floor
+        n_classes = self._num_classes(spec)
+        out: List[pb.Detection] = []
+        for t in tracker.tracks():
+            conf = t["confidence"] * decay ** max(t["misses"], 1)
+            if conf < floor:
+                continue
+            x1, y1, x2, y2 = (int(round(v)) for v in t["box"])
+            det = pb.Detection(
+                box=pb.BoundingBox(left=x1, top=y1,
+                                   width=x2 - x1, height=y2 - y1),
+                confidence=float(conf),
+                class_id=t["class_id"],
+                class_name=class_name(t["class_id"], n_classes),
+            )
+            det.track_id = str(t["track_id"])
+            out.append(det)
+        return out
+
+    def _track_rois(self, tracker) -> List[tuple]:
+        """Candidate crop rectangles for a tracked stream: predicted
+        track boxes inflated by cfg.roi_margin (context for the detector
+        + slack for motion since the prediction), then overlapping
+        rects merged to a common hull — one object must never appear in
+        two crops of the same stream (double detection after
+        scatter-back). Caller holds ``_state_lock``."""
+        if tracker is None:
+            return []
+        margin = self._cfg.roi_margin
+        rects: List[list] = []
+        for t in tracker.tracks():
+            x1, y1, x2, y2 = t["box"]
+            mw = (x2 - x1) * margin
+            mh = (y2 - y1) * margin
+            rects.append([x1 - mw, y1 - mh, x2 + mw, y2 + mh])
+        merged = True
+        while merged:
+            merged = False
+            folded: List[list] = []
+            for r in rects:
+                for o in folded:
+                    if (r[0] < o[2] and o[0] < r[2]
+                            and r[1] < o[3] and o[1] < r[3]):
+                        o[0] = min(o[0], r[0])
+                        o[1] = min(o[1], r[1])
+                        o[2] = max(o[2], r[2])
+                        o[3] = max(o[3], r[3])
+                        merged = True
+                        break
+                else:
+                    folded.append(list(r))
+            rects = folded
+        return [tuple(r) for r in rects]
 
     def _watch_tick(self, tick_s: float,
                     inferred: Sequence[str] = ()) -> None:
@@ -1970,6 +2304,11 @@ class InferenceEngine:
     def _emit(self, inflight: _Inflight) -> None:
         group = inflight.group
         spec = self._models[group.model or self._spec.name][0]
+        if group.coast is not None:
+            # MOSAIC gated-idle group: no device outputs at all; emit
+            # the tracker-coasted detections computed at gate time.
+            self._emit_coast(inflight, spec)
+            return
         t_drain0 = time.time()
         host = {k: np.asarray(v) for k, v in inflight.outputs.items()}  # D2H
         t_drained = time.time()
@@ -1977,6 +2316,19 @@ class InferenceEngine:
         self._m_device.labels(group.model or self._spec.name).observe(
             device_ms
         )
+        if group.crops is not None:
+            # MOSAIC canvas batch: the fps window counts the STREAMS the
+            # canvases served, and occupancy is the crop-pixel area
+            # share (a canvas is not one fully-occupied batch slot).
+            streams = len({p.device_id for p in group.crops})
+            self.perf.note_batch(
+                group.model or self._spec.name, group.src_hw, group.bucket,
+                device_ms, len(group.device_ids), streams=streams,
+                area_frac=CanvasPacker.area_fraction(
+                    group.crops, len(group.device_ids), group.src_hw[0]),
+            )
+            self._emit_canvas(inflight, host, spec, device_ms, t_drained)
+            return
         # Per-bucket device attribution (obs/perf.py): device-time
         # histogram, padded-slot waste, occupancy, live MFU/fps gauges.
         self.perf.note_batch(
@@ -1988,6 +2340,16 @@ class InferenceEngine:
             if self.slo is not None and spec.kind == "detect" else None
         )
         now_ms = int(t_drained * 1000)
+        if self._roi is not None and spec.kind == "detect" \
+                and group.frames.ndim == 4:
+            # Classic full-frame detect emission while ROI serving is
+            # on: stamp the refresh cadence (gate feedback) and count
+            # the streams toward the equivalent-fps window.
+            now_mono = time.monotonic()
+            with self._state_lock:
+                for device_id in group.device_ids:
+                    self._roi.note_full(device_id, now_mono)
+            self.perf.note_roi_emit(len(group.device_ids))
         for i, device_id in enumerate(group.device_ids):
             meta = group.metas[i]
             # Structured log correlation: every record logged while this
@@ -2061,6 +2423,151 @@ class InferenceEngine:
             )
             tracer.record(device_id, "emit", meta.packet)
 
+    def _emit_coast(self, inflight: _Inflight, spec) -> None:
+        """Emit a gated-idle (MOSAIC ``coast``) group: detections were
+        computed at gate time on the tick thread (tracker coasting); this
+        just fans them out with the same per-stream semantics as
+        ``_emit_slot``. Rides the drain queue so coasted results never
+        overtake an earlier in-flight device batch for the same stream."""
+        group = inflight.group
+        now_ms = int(time.time() * 1000)
+        slo_latency = (
+            self._slo_latency
+            if self.slo is not None and spec.kind == "detect" else None
+        )
+        for device_id, meta, detections in group.coast:
+            ctx = set_log_context(stream=device_id, seq=meta.packet)
+            try:
+                self._emit_stream_result(
+                    inflight, device_id, meta, detections, spec, now_ms,
+                    0.0, slo_latency, coasted=True,
+                )
+            finally:
+                reset_log_context(ctx)
+        self.perf.note_roi_emit(len(group.coast))
+
+    def _emit_canvas(self, inflight: _Inflight, host: dict, spec,
+                     device_ms: float, t_drained: float) -> None:
+        """MOSAIC scatter-back: route each canvas detection to its crop
+        by center point (cells never overlap — the packer keeps a
+        background gap), map it through the exact per-crop inverse
+        affine (ops/boxes.py ``uncrop_boxes``), clip to the crop's
+        source rect, and emit per source stream. A detection whose
+        center lands in no cell (gap/background artifact, or a spilled
+        stream's cell that left the routing table) is counted and
+        dropped — it must never reach the wrong stream."""
+        from ..ops.boxes import uncrop_boxes
+
+        group = inflight.group
+        now_ms = int(t_drained * 1000)
+        slo_latency = (
+            self._slo_latency
+            if self.slo is not None and spec.kind == "detect" else None
+        )
+        by_canvas: Dict[int, list] = {}
+        results: Dict[str, tuple] = {}   # device_id -> (meta, [Detection])
+        for p in group.crops:
+            by_canvas.setdefault(p.canvas, []).append(p)
+            results.setdefault(p.device_id, (p.meta, []))
+        thr = (
+            self._conf_threshold
+            if self._spec is not None and spec.name == self._spec.name
+            else 0.0
+        )
+        n_classes = self._num_classes(spec)
+        for ci in range(len(group.device_ids)):
+            cells = by_canvas.get(ci)
+            if not cells:
+                continue
+            for j in np.nonzero(host["valid"][ci])[0]:
+                score = float(host["scores"][ci, j])
+                if score < thr:
+                    continue
+                bx = [float(v) for v in host["boxes"][ci, j]]
+                cx = (bx[0] + bx[2]) / 2.0
+                cy = (bx[1] + bx[3]) / 2.0
+                cell = next(
+                    (p for p in cells if p.contains(cx, cy)), None)
+                if cell is None:
+                    self.perf.note_roi_unrouted()
+                    continue
+                box = uncrop_boxes(
+                    np.asarray(bx, np.float32), scale=cell.scale,
+                    dst_origin=cell.dst[:2], src_origin=cell.src[:2],
+                )
+                x1 = max(cell.src[0], min(float(box[0]), cell.src[2]))
+                y1 = max(cell.src[1], min(float(box[1]), cell.src[3]))
+                x2 = max(cell.src[0], min(float(box[2]), cell.src[2]))
+                y2 = max(cell.src[1], min(float(box[3]), cell.src[3]))
+                ix1, iy1 = int(round(x1)), int(round(y1))
+                ix2, iy2 = int(round(x2)), int(round(y2))
+                cid = int(host["classes"][ci, j])
+                results[cell.device_id][1].append(pb.Detection(
+                    box=pb.BoundingBox(left=ix1, top=iy1,
+                                       width=ix2 - ix1, height=iy2 - iy1),
+                    confidence=score,
+                    class_id=cid,
+                    class_name=class_name(cid, n_classes),
+                ))
+        for device_id, (meta, detections) in results.items():
+            ctx = set_log_context(stream=device_id, seq=meta.packet)
+            try:
+                self._emit_stream_result(
+                    inflight, device_id, meta, detections, spec, now_ms,
+                    device_ms, slo_latency,
+                )
+            finally:
+                reset_log_context(ctx)
+        self.perf.note_roi_emit(len(results))
+
+    def _emit_stream_result(self, inflight, device_id, meta, detections,
+                            spec, now_ms, device_ms, slo_latency,
+                            coasted: bool = False) -> None:
+        """ROI-path twin of ``_emit_slot``'s tail: tracker association,
+        quality detections-only observation (canvas slots carry no
+        per-stream frame statistics), publish, annotate, stats, SLO.
+        Kept separate so the classic full-frame path stays byte-for-byte
+        untouched with roi off. Coasted results skip tracker association
+        (the gate already advanced the tracker and the detections ARE
+        its tracks) and device-time attribution (no device work ran)."""
+        group = inflight.group
+        if self._cfg.track and spec.kind == "detect" and not coasted:
+            self._assign_tracks(device_id, spec.name, detections)
+        if self.quality is not None:
+            self.quality.observe(
+                device_id,
+                classes=[d.class_id for d in detections],
+                scores=[d.confidence for d in detections],
+            )
+        latency = max(0.0, now_ms - meta.timestamp_ms) if meta.timestamp_ms else 0.0
+        result = pb.InferenceResult(
+            device_id=device_id,
+            timestamp=meta.timestamp_ms,
+            model=spec.name,
+            model_version="0",
+            detections=detections,
+            latency_ms=latency,
+            batch_size=group.bucket,
+            frame_packet=meta.packet,
+        )
+        self._publish(result)
+        self._annotate(device_id, meta, detections, spec)
+        st = self._stats.setdefault(device_id, StreamStats())
+        st.frames += 1
+        st.note_latency(latency)
+        st.last_batch = group.bucket
+        if not coasted:
+            st.note_device(device_ms, group.padded_slots)
+        st.last_emit_mono = time.monotonic()
+        if slo_latency is not None and meta.timestamp_ms:
+            ok = latency <= self._cfg.slo_latency_ms
+            slo_latency.record(good=1.0 if ok else 0.0,
+                               bad=0.0 if ok else 1.0)
+        self._m_frames.labels(device_id).inc()
+        self._m_latency.labels(device_id).observe(latency)
+        if latency > self._cfg.obs_late_ms:
+            self._m_late.labels(device_id).inc()
+
     def _observe_quality(self, host: dict, i: int, device_id: str,
                          meta: FrameMeta, detections) -> None:
         """Fold one emitted slot into the quality plane: the device
@@ -2076,6 +2583,13 @@ class InferenceEngine:
                 "luma_var": float(qs[i, 1]),
                 "diff_energy": float(qs[i, 2]),
             }
+            if self._roi is not None:
+                # MOSAIC gate feedback: the next tick classifies this
+                # stream against the diff energy just fetched (only
+                # full-frame slots carry stats, so the refresh cadence
+                # keeps the signal alive).
+                with self._state_lock:
+                    self._roi.note_diff(device_id, float(qs[i, 2]))
         self.quality.observe(
             device_id,
             classes=[d.class_id for d in detections],
@@ -2112,7 +2626,12 @@ class InferenceEngine:
                  d.box.top + d.box.height)
                 for d in detections
             ]
-            ids = tracker.update(boxes, [d.class_id for d in detections])
+            # Scores ride along so ROI coasting can decay from the last
+            # matched confidence (state-only: emitted bytes unchanged).
+            ids = tracker.update(
+                boxes, [d.class_id for d in detections],
+                scores=[d.confidence for d in detections],
+            )
         for det, tid in zip(detections, ids):
             det.track_id = tid
 

@@ -33,6 +33,8 @@ class _Track:
     velocity: np.ndarray       # d(box)/frame, float32[4]
     class_id: int
     misses: int = 0
+    confidence: float = 0.0    # last matched detection's score (ROI
+                               # coasted emissions decay from this)
 
 
 def _iou_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -72,8 +74,15 @@ class IoUTracker:
         boxes: Sequence[Sequence[float]],
         classes: Sequence[int],
         now: float | None = None,
+        scores: Sequence[float] | None = None,
     ) -> List[str]:
-        """One frame of detections -> one track id per detection, in order."""
+        """One frame of detections -> one track id per detection, in order.
+
+        ``scores`` (optional, parallel to ``boxes``) stores each matched
+        detection's confidence on its track so gated-idle streams can
+        emit tracker-coasted results with a decayed confidence
+        (``tracks()`` below); omitted → confidences keep their last
+        value (new tracks start at 0)."""
         now = time.monotonic() if now is None else now
         if self._last_update and now - self._last_update > self.max_gap_s:
             self._tracks = []
@@ -108,6 +117,8 @@ class IoUTracker:
             t.velocity = t.velocity + 0.5 * (dets[di] - t.box)
             t.box = dets[di].copy()
             t.misses = 0
+            if scores is not None:
+                t.confidence = float(scores[di])
             assigned[di] = t.track_id
             used_tracks.add(ti)
             iou[ti, :] = -1.0
@@ -121,6 +132,8 @@ class IoUTracker:
                     box=dets[di].copy(),
                     velocity=np.zeros(4, np.float32),
                     class_id=int(cls[di]),
+                    confidence=(float(scores[di])
+                                if scores is not None else 0.0),
                 )
                 self.next_id += 1
                 self._tracks.append(t)
@@ -142,3 +155,24 @@ class IoUTracker:
     @property
     def live_tracks(self) -> int:
         return len(self._tracks)
+
+    def tracks(self) -> List[dict]:
+        """Snapshot of live tracks at their current (predicted) boxes.
+
+        The ROI gate (engine/runner.py) reads this for two things:
+        candidate crop rectangles for tracked streams, and
+        tracker-coasted result emission for gated-idle streams — call
+        ``update([], [])`` first to advance predictions and count the
+        miss so stale tracks still expire while a stream is gated.
+        Boxes are plain float tuples (xyxy); mutating the snapshot never
+        touches tracker state."""
+        return [
+            {
+                "track_id": t.track_id,
+                "box": tuple(float(v) for v in t.box),
+                "class_id": t.class_id,
+                "misses": t.misses,
+                "confidence": t.confidence,
+            }
+            for t in self._tracks
+        ]

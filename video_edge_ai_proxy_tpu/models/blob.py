@@ -1,0 +1,101 @@
+"""Detect-identity "blob gauge" model for ROI serving verification.
+
+Not a learned model: a jittable measurement instrument that returns the
+EXACT pixel bounding box of color-keyed blobs, used by the MOSAIC
+round-trip gates (tests/test_roi.py, tools/roi_smoke.py) to prove the
+pack -> detect -> scatter-back path is geometry-preserving without any
+model noise in the loop. A learned detector's boxes wobble a few px per
+crop placement, which would make the replay gate's IoU threshold measure
+the model, not the serving path; this gauge makes a coordinate bug show
+up as an exact mismatch.
+
+Scene contract: synthetic frames are background gray (114, the
+letterbox pad value) with axis-aligned blobs painted in one of
+``BINS`` color keys — BGR ``(64, 255, key*BIN_WIDTH + BIN_WIDTH//2)``.
+Anchor ``k`` of the output detects the bounding box of every pixel
+whose red channel quantizes to bin ``k`` AND whose green channel is
+bright (background/letterbox gray fails the green test, so the gray
+bin can never fire on padding). One color key per stream keeps blobs
+separable when many streams' crops share a canvas. The red-bin centers
+are ``BIN_WIDTH`` apart with a +-12 level acceptance window, wide
+enough that bf16 preprocessing error (<1 level at u8 scale) can never
+flip a bin.
+
+Implements the registry detect contract (models/registry.py,
+engine/runner.py build_serving_step): ``apply(variables, x,
+decode="serving")`` -> (boxes [N, A, 4] xyxy letterbox px, max_logit
+[N, A], cls_ids [N, A]); class id == color bin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# 8 red-channel bins of 32 u8 levels each; bin 3 contains the 114-gray
+# background and is excluded by the green-brightness test, not by index.
+BINS = 8
+BIN_WIDTH = 32
+# Acceptance half-window around each bin center, in u8 levels.
+_BIN_TOL = 12.0
+_LOGIT_HIT = 8.0     # sigmoid(8) ~ 0.99966: far above the NMS floor
+_LOGIT_MISS = -8.0
+
+
+def blob_color(key: int) -> tuple:
+    """BGR fill color for color bin ``key`` (paint synthetic blobs with
+    this; the gauge's anchor ``key`` will report their bbox)."""
+    return (64, 255, key * BIN_WIDTH + BIN_WIDTH // 2)
+
+
+@dataclasses.dataclass(frozen=True)
+class BlobGaugeConfig:
+    num_classes: int = BINS
+
+
+class BlobGauge(nn.Module):
+    """See module docstring. Carries one dummy parameter so the
+    registry's ``init_params`` / checkpoint plumbing work unchanged."""
+
+    cfg: BlobGaugeConfig = BlobGaugeConfig()
+
+    @nn.compact
+    def __call__(self, x, decode=True):
+        bins = self.cfg.num_classes
+        bias = self.param("bias", nn.initializers.zeros, (1,))
+        # f32 throughout: the gauge measures geometry, bf16 buys nothing.
+        x = x.astype(jnp.float32) + bias[0] * 0.0
+        n, h, w, _ = x.shape
+        # preprocess_letterbox flips BGR -> RGB: channel 0 is the red key.
+        red = x[..., 0] * 255.0
+        green = x[..., 1]
+        centers = (jnp.arange(bins, dtype=jnp.float32) * BIN_WIDTH
+                   + BIN_WIDTH / 2.0)
+        mask = (
+            (jnp.abs(red[..., None] - centers) < _BIN_TOL)
+            & (green[..., None] > 0.75)
+        )                                             # [N, H, W, BINS]
+        cols = jnp.arange(w, dtype=jnp.float32)[None, :, None]
+        rows = jnp.arange(h, dtype=jnp.float32)[None, :, None]
+        any_col = mask.any(axis=1)                    # [N, W, BINS]
+        any_row = mask.any(axis=2)                    # [N, H, BINS]
+        big = jnp.float32(1e9)
+        x0 = jnp.min(jnp.where(any_col, cols, big), axis=1)
+        x1 = jnp.max(jnp.where(any_col, cols + 1.0, -big), axis=1)
+        y0 = jnp.min(jnp.where(any_row, rows, big), axis=1)
+        y1 = jnp.max(jnp.where(any_row, rows + 1.0, -big), axis=1)
+        present = any_col.any(axis=1)                 # [N, BINS]
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1)
+        boxes = jnp.where(present[..., None], boxes, 0.0)
+        logits = jnp.where(present, _LOGIT_HIT, _LOGIT_MISS)
+        cls_ids = jnp.broadcast_to(
+            jnp.arange(bins, dtype=jnp.int32)[None, :], (n, bins))
+        if decode == "serving":
+            return boxes, logits, cls_ids
+        # decode=True parity shape (boxes, per-anchor class probs).
+        probs = (jax.nn.sigmoid(logits)[..., None]
+                 * jax.nn.one_hot(cls_ids, bins))
+        return boxes, probs

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from .blob import BlobGauge, BlobGaugeConfig
 from .mobilenet_v2 import MobileNetV2, MobileNetV2Config, tiny_mobilenet_v2_config
 from .resnet import ResNet, ResNetConfig, tiny_resnet_config
 from .videomae import VideoMAE, VideoMAEConfig, tiny_videomae_config
@@ -114,6 +115,22 @@ register(ModelSpec(
     input_size=224, preprocess="clip", kind="video", clip_len=64,
     description="long-context clips: 64 frames -> 6272 tokens, attention "
                 "auto-dispatches to the Pallas flash kernel",
+))
+
+# --- diagnostic gauges ----------------------------------------------------
+
+register(ModelSpec(
+    "blob_gauge", lambda: BlobGauge(BlobGaugeConfig()),
+    input_size=640, preprocess="letterbox", kind="detect",
+    description="detect-identity measurement gauge (models/blob.py): "
+                "exact pixel bboxes of color-keyed synthetic blobs; the "
+                "ROI round-trip gate (tools/roi_smoke.py) serves it to "
+                "prove pack->detect->scatter-back preserves geometry",
+))
+register(ModelSpec(
+    "tiny_blob_gauge", lambda: BlobGauge(BlobGaugeConfig()),
+    input_size=64, preprocess="letterbox", kind="detect",
+    description="CPU/CI twin of blob_gauge (tests/test_roi.py)",
 ))
 
 # --- tiny twins (tests / CI on CPU) --------------------------------------

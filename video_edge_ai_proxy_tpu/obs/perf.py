@@ -251,6 +251,37 @@ class PerfTracker:
             "Share of H2D transfer wall seconds that overlapped in-flight "
             "device compute or dispatch work (prefetch stage)",
             ("model", "bucket"))
+        # ROI serving attribution (MOSAIC, engine/runner.py cfg.roi):
+        # per-tick gate split, packer output, scatter-back routing
+        # failures, and the projected full-frame-equivalent fps — the
+        # rate of per-stream results served through the ROI plane
+        # (coasted + packed + full), i.e. what the fleet would have cost
+        # in full frames.
+        self._m_roi_states = reg.counter(
+            "vep_roi_stream_states_total",
+            "Motion-gate verdicts per detect stream per tick",
+            ("state",))
+        self._m_roi_crops = reg.counter(
+            "vep_roi_crops_total",
+            "Crops packed onto shared canvases").labels()
+        self._m_roi_canvases = reg.counter(
+            "vep_roi_canvases_total",
+            "Shared canvases dispatched").labels()
+        self._m_roi_occupancy = reg.gauge(
+            "vep_roi_canvas_occupancy_pct",
+            "Crop-pixel share of the packed canvas plane, last "
+            "batch").labels()
+        self._m_roi_unrouted = reg.counter(
+            "vep_roi_unrouted_total",
+            "Canvas detections that landed outside every crop cell "
+            "(dropped in scatter-back)").labels()
+        self._m_roi_fps = reg.gauge(
+            "vep_roi_equivalent_fps",
+            "Per-stream results served through the ROI plane per second "
+            "(full-frame-equivalent fps, sliding window)").labels()
+        self._roi_fps = _RateWindow(window_s=fps_window_s)
+        self._roi = {"idle": 0, "roi": 0, "full": 0, "crops": 0,
+                     "canvases": 0, "unrouted": 0, "area_frac": None}
 
     # -- compile-time attribution ----------------------------------------
 
@@ -291,9 +322,19 @@ class PerfTracker:
     # -- tick-time attribution -------------------------------------------
 
     def note_batch(self, model: str, src_hw: Tuple[int, int], bucket: int,
-                   device_ms: float, frames: int) -> None:
+                   device_ms: float, frames: int, *,
+                   streams: Optional[int] = None,
+                   area_frac: Optional[float] = None) -> None:
         """Record one drained device batch: ``frames`` real frames in a
-        ``bucket``-slot program that ran for ``device_ms``."""
+        ``bucket``-slot program that ran for ``device_ms``.
+
+        Canvas-aware accounting (MOSAIC packed batches): ``frames`` is
+        then the canvas count, ``streams`` the number of source streams
+        whose crops rode the batch (feeds the fps window — results
+        emitted, not canvases), and ``area_frac`` the crop-pixel share
+        of the canvas plane. With ``area_frac`` the occupancy gauge
+        reports crop-level occupancy — a half-empty canvas must NOT read
+        as one fully-occupied slot."""
         geometry = self._geometry(src_hw)
         key = (model, geometry, bucket)
         cell = self._cells.get(key)
@@ -304,7 +345,10 @@ class PerfTracker:
         if padded > 0:
             cell.padded.inc(padded)
         cell.slots.inc(bucket)
-        cell.occupancy.set(100.0 * frames / bucket if bucket else 0.0)
+        if area_frac is not None:
+            cell.occupancy.set(100.0 * area_frac)
+        else:
+            cell.occupancy.set(100.0 * frames / bucket if bucket else 0.0)
         if cell.ema_init:
             cell.ema_ms = 0.9 * cell.ema_ms + 0.1 * device_ms
         else:
@@ -319,7 +363,7 @@ class PerfTracker:
             cell.mfu.set(util)
             cell.tflops.set(flops / (cell.ema_ms * 1e-3) / 1e12)
         now = self._clock()
-        self._fps.add(frames, now)
+        self._fps.add(streams if streams is not None else frames, now)
         self._m_fps.set(self._fps.rate(now))
 
     def note_h2d(self, model: str, bucket: int, nbytes: int,
@@ -349,6 +393,48 @@ class PerfTracker:
         cell.seconds += float(seconds)
         cell.batches += 1
         cell.slots += int(bucket)
+
+    # -- ROI serving attribution (cfg.roi, engine/runner.py) --------------
+
+    def note_roi_gate(self, idle: int, roi: int, full: int) -> None:
+        """One tick's motion-gate split over detect streams."""
+        if idle:
+            self._m_roi_states.labels("idle").inc(idle)
+        if roi:
+            self._m_roi_states.labels("roi").inc(roi)
+        if full:
+            self._m_roi_states.labels("full").inc(full)
+        with self._lock:
+            self._roi["idle"] += idle
+            self._roi["roi"] += roi
+            self._roi["full"] += full
+
+    def note_roi_pack(self, crops: int, canvases: int,
+                      area_frac: float) -> None:
+        """One packed canvas batch leaving the packer."""
+        self._m_roi_crops.inc(crops)
+        self._m_roi_canvases.inc(canvases)
+        self._m_roi_occupancy.set(100.0 * area_frac)
+        with self._lock:
+            self._roi["crops"] += crops
+            self._roi["canvases"] += canvases
+            self._roi["area_frac"] = area_frac
+
+    def note_roi_emit(self, streams: int) -> None:
+        """Per-stream results served through the ROI plane (coasted,
+        packed, or full-frame-while-gating) — the full-frame-equivalent
+        fps evidence (ISSUE 9 acceptance)."""
+        now = self._clock()
+        self._roi_fps.add(streams, now)
+        self._m_roi_fps.set(self._roi_fps.rate(now))
+
+    def note_roi_unrouted(self, n: int = 1) -> None:
+        self._m_roi_unrouted.inc(n)
+        with self._lock:
+            self._roi["unrouted"] += n
+
+    def roi_equivalent_fps(self) -> float:
+        return self._roi_fps.rate(self._clock())
 
     def _make_h2d_cell(self, key: Tuple[str, int]) -> _H2DCell:
         model, bucket = key
@@ -422,7 +508,7 @@ class PerfTracker:
                     "mbps": (round(cell.bytes / 1e6 / cell.seconds, 1)
                              if cell.seconds > 0 else None),
                 })
-        return {
+        out = {
             "peak_tflops": self.peak_tflops,
             "fps": round(self.fps(), 1),
             "compiles": sorted(
@@ -433,3 +519,25 @@ class PerfTracker:
             "h2d_hidden_pct": (round(100.0 * h2d_hidden / h2d_seconds, 1)
                                if h2d_seconds > 0 else None),
         }
+        with self._lock:
+            roi = dict(self._roi)
+        gated = roi["idle"] + roi["roi"] + roi["full"]
+        if gated or roi["canvases"]:
+            out["roi"] = {
+                "stream_ticks": {"idle": roi["idle"], "roi": roi["roi"],
+                                 "full": roi["full"]},
+                "gated_stream_pct": round(
+                    100.0 * (roi["idle"] + roi["roi"]) / gated, 1)
+                if gated else 0.0,
+                "crops": roi["crops"],
+                "canvases": roi["canvases"],
+                "crops_per_canvas": round(
+                    roi["crops"] / roi["canvases"], 2)
+                if roi["canvases"] else None,
+                "canvas_occupancy_pct": round(
+                    100.0 * roi["area_frac"], 1)
+                if roi["area_frac"] is not None else None,
+                "unrouted": roi["unrouted"],
+                "equivalent_fps": round(self.roi_equivalent_fps(), 1),
+            }
+        return out

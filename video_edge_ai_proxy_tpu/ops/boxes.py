@@ -49,6 +49,30 @@ def box_iou_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return inter / jnp.maximum(union, 1e-9)
 
 
+def uncrop_boxes(boxes_xyxy, *, scale: float,
+                 dst_origin: tuple, src_origin: tuple):
+    """Map boxes from packed-canvas pixel coords back to source-frame
+    pixel coords — the per-crop inverse of the MOSAIC canvas placement
+    (engine/collector.py ``CanvasPacker``), the same shape of inverse
+    affine ``unletterbox_boxes`` applies for whole-frame letterboxing.
+
+    A crop taken at ``src_origin`` = (x0, y0) in its source frame is
+    decimated by integer ``scale`` (source px per canvas px) and blitted
+    at ``dst_origin`` = (x0, y0) on the canvas, so the inverse is exact:
+
+        src = (canvas - dst_origin) * scale + src_origin
+
+    Pure arithmetic on the input array type: works on ``np`` arrays
+    host-side (the scatter-back path in engine/runner.py, post-NMS) and
+    on ``jnp`` arrays in-graph alike. [..., 4] xyxy in, same shape out.
+    """
+    import numpy as np
+
+    shift = np.asarray([dst_origin[0], dst_origin[1]] * 2, np.float32)
+    offset = np.asarray([src_origin[0], src_origin[1]] * 2, np.float32)
+    return (boxes_xyxy - shift) * float(scale) + offset
+
+
 def dist_to_bbox(distances: jnp.ndarray, anchor_points: jnp.ndarray) -> jnp.ndarray:
     """Anchor-free head decode: per-anchor (l, t, r, b) distances -> xyxy.
 

@@ -232,6 +232,44 @@ class EngineConfig:
     quality_window_s: float = 5.0      # drift scoring window
     quality_drift_threshold: float = 0.35
     quality_ladder: bool = True        # black/frozen streams shed first
+    # Spatially-multiplexed ROI serving (MOSAIC, arxiv 2305.03222;
+    # ROADMAP item 1). Each tick, detect streams are motion-gated from
+    # the previous tick's device thumbnail diff energy (quality plane)
+    # plus IoUTracker state: "idle" streams (no motion, no live tracks
+    # past coasting) skip device work entirely and emit tracker-coasted
+    # results; "tracked" streams contribute crops around their predicted
+    # track boxes, shelf-packed with crops from other streams onto a few
+    # shared side×side canvases (engine/collector.py CanvasPacker) that
+    # run through the SAME (geometry, bucket) step cache; "active"
+    # streams (fresh motion / refresh cadence due / no diff signal yet)
+    # run the classic full frame. Detections scatter back from canvas to
+    # per-stream frame coordinates via exact per-crop inverse affines
+    # (ops/boxes.py uncrop_boxes). roi=False (default) is the kill
+    # switch: every batch takes today's full-frame path bit-identically
+    # (test-pinned).
+    roi: bool = False
+    roi_canvas: int = 640              # shared canvas side (geometry)
+    roi_gap: int = 8                   # background px between packed crops
+    roi_max_canvases: int = 8          # per tick; overflow crops go full
+    roi_margin: float = 0.25           # track-box inflation for crops
+    roi_min_crop: int = 32             # minimum crop side before packing
+    # Streams whose thumbnail diff energy (inter-frame MSE of [0,1] luma)
+    # stays below this are motionless; with no live tracks they gate to
+    # idle, with tracks they serve from crops only. ~50x the freeze
+    # detector's quality_freeze_diff floor: "no scene change worth a
+    # full frame", not "pixel-identical".
+    roi_idle_diff: float = 5e-5
+    # Full-frame refresh cadence per stream: catches objects appearing
+    # outside every tracked ROI and refreshes the diff-energy signal
+    # (quality stats only ride full-frame slots — crops would alias the
+    # thumbnail). Also the bound on how stale a gated stream's scene
+    # model can get.
+    roi_full_interval_ms: int = 1000
+    # Coasted-emission confidence decay per missed frame; a coasted track
+    # below roi_coast_floor stops being emitted (the track itself still
+    # expires via IoUTracker.max_misses).
+    roi_coast_decay: float = 0.9
+    roi_coast_floor: float = 0.1
     # Canary integrity loop: a golden trace (recorder.py) replayed into
     # the live engine at low cadence by an engine-owned publisher; each
     # completed loop's host result checksums fold and compare against the
