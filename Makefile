@@ -3,7 +3,7 @@
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
-	roi-smoke
+	roi-smoke fleet-obs-smoke
 
 all: proto native
 
@@ -153,6 +153,22 @@ h2d-smoke:
 # gate engaged (idle+roi stream-ticks, >=1 canvas), and >= 2x
 # full-frame-equivalent throughput per device frame. The committed
 # ROI_r01.json artifact is a pinned run of this tool. ~30 s.
+# r14 fleet telemetry: 3 member Server subprocesses replaying through
+# real workers/buses/engines, one FleetAggregator scraping them. The
+# tool hard-gates (exit nonzero): merged exposition lint-clean, every
+# member present + fresh, >=1 fully-stitched cross-process trace
+# (worker -> bus -> engine -> client via the on-wire trace_id), and
+# merged counters == sum of per-member scrapes. Commits FLEETOBS_r01.json.
+fleet-obs-smoke:
+	python tools/soak_replay.py --fleet 3 --fleet-out FLEETOBS_r01.json \
+		| tee /tmp/vep_fleet_obs.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_fleet_obs.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); g=d['gates']; \
+		print('fleet obs: %d members, %d stitched traces, lint_clean=%s, conserved=%s' \
+			% (d['members'], g['stitched_traces'], \
+			   g['merged_lint_clean'], g['counters_conserved']))"
+
 roi-smoke:
 	python tools/roi_smoke.py | tee /tmp/vep_roi_smoke.json
 	@python -c "import json; \

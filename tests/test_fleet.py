@@ -1,0 +1,494 @@
+"""r14 fleet telemetry plane: cross-process trace ids, merge rules,
+member health, and the two-process aggregation conformance test.
+
+The conformance test is the first multihost-flavored test that does NOT
+skip on the CPU backend: it boots two REAL serve processes (control
+plane only — no engine, so no backend init) on ephemeral ports, scrapes
+them with a FleetAggregator, and asserts merged counters equal the sum
+of the members plus the staleness flag on a killed member.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+from video_edge_ai_proxy_tpu.obs.fleet import (
+    FleetAggregator,
+    MemberState,
+    parse_exposition,
+    _strip_label,
+    _with_instance,
+)
+from video_edge_ai_proxy_tpu.obs.metrics import Registry, lint_exposition
+from video_edge_ai_proxy_tpu.obs.spans import (
+    SpanRecorder,
+    stage_breakdown,
+    to_chrome_trace,
+    trace_id_for,
+    trace_id_of,
+)
+
+
+# ---------------------------------------------------------------------------
+# Trace-context ids (obs/spans.py)
+
+
+class TestTraceIds:
+    def test_deterministic_and_nonzero(self):
+        a = trace_id_for("cam1", 7)
+        assert a == trace_id_for("cam1", 7)     # content-derived: replay-
+        assert a != trace_id_for("cam1", 8)     # checksum safe by design
+        assert a != trace_id_for("cam2", 7)
+        assert a != 0
+
+    def test_63_bit_range(self):
+        # int64-safe on the wire (proto int64 / ctypes c_int64): never
+        # negative, never zero (0 = unstamped sentinel).
+        for i in range(200):
+            tid = trace_id_for(f"cam{i}", i * 37)
+            assert 0 < tid <= 0x7FFF_FFFF_FFFF_FFFF
+
+    def test_trace_id_of_prefers_wire_value(self):
+        meta = FrameMeta(packet=5, trace_id=12345)
+        assert trace_id_of(meta, "cam1") == 12345
+
+    def test_trace_id_of_falls_back_to_hash(self):
+        meta = FrameMeta(packet=5)          # unstamped (trace_id=0)
+        assert trace_id_of(meta, "cam1") == trace_id_for("cam1", 5)
+
+    def test_meta_defaults_ride_the_bus_struct(self):
+        meta = FrameMeta()
+        assert meta.trace_id == 0 and meta.parent_span == 0
+
+
+# ---------------------------------------------------------------------------
+# Dropped-stage lineage closure (the r14 bugfix: drops used to orphan
+# their spans silently)
+
+
+class TestDroppedSpans:
+    def test_breakdown_accounts_drops_by_reason(self):
+        rec = SpanRecorder(enabled=True, sample_every=1)
+        rec.record("cam1", "collect", 1, ts=1.0)
+        rec.record("cam1", "dropped", 1, ts=1.01, reason="stale_shed")
+        rec.record("cam1", "dropped", 2, ts=1.02, reason="stale_shed")
+        rec.record("cam1", "dropped", 3, ts=1.03, reason="shutdown_drain")
+        br = stage_breakdown(rec.events())
+        assert br["drops"]["count"] == 3
+        assert br["drops"]["by_reason"] == {
+            "shutdown_drain": 1, "stale_shed": 2}
+
+    def test_dropped_events_export_to_chrome_trace(self):
+        rec = SpanRecorder(enabled=True, sample_every=1)
+        rec.record("cam1", "dropped", 1, ts=1.0, reason="stale_shed",
+                   trace_id=trace_id_for("cam1", 1))
+        obj = to_chrome_trace(rec.events())
+        assert any(ev.get("name") == "dropped"
+                   for ev in obj["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# Render-time const labels (obs/metrics.py)
+
+
+class TestConstLabels:
+    def test_instance_label_on_every_sample(self):
+        r = Registry()
+        r.set_const_labels(instance="m7")
+        r.counter("vep_x_total", "x").inc(2)
+        r.gauge("vep_g", "g", ("stream",)).labels("cam1").set(1.5)
+        h = r.histogram("vep_h_ms", "h")
+        h.observe(3.0)
+        text = r.render()
+        assert lint_exposition(text) == []
+        for line in text.splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            assert 'instance="m7"' in line, line
+
+    def test_per_sample_label_wins_on_collision(self):
+        r = Registry()
+        r.set_const_labels(instance="outer")
+        r.counter("vep_c_total", "c", ("instance",)).labels("inner").inc()
+        text = r.render()
+        assert 'instance="inner"' in text
+        assert 'instance="outer"' not in text
+
+    def test_snapshot_stays_const_label_free(self):
+        # The ISSUE pins render-time labeling: the JSON snapshot (and the
+        # hot-path sample maps behind it) must not grow per-sample label
+        # churn.
+        r = Registry()
+        r.set_const_labels(instance="m0")
+        r.counter("vep_c_total", "c").inc()
+        snap = r.snapshot()
+        assert "instance" not in json.dumps(snap["vep_c_total"]["samples"])
+
+
+# ---------------------------------------------------------------------------
+# Exposition parsing + merge rules (obs/fleet.py)
+
+
+def _member_page(instance: str, count: float, rung: float) -> str:
+    r = Registry()
+    r.set_const_labels(instance=instance)
+    r.counter("vep_frames_total", "frames", ("stream",)).labels(
+        "cam1").inc(count)
+    r.gauge("vep_ladder_rung", "rung").set(rung)
+    h = r.histogram("vep_lat_ms", "lat")
+    h.observe(1.0)
+    h.observe(100.0)
+    return r.render()
+
+
+def _seed_member(m: MemberState, page: str, *, streams=0, burning=False):
+    m.families = parse_exposition(page)
+    m.stats = {"engine": {"streams": {f"c{i}": {} for i in range(streams)}}}
+    m.slo = {"burning": burning}
+    m.alive = True
+    m.last_ok = time.monotonic()
+    m.scrapes += 1
+
+
+class TestMergeRules:
+    def _agg(self):
+        agg = FleetAggregator(
+            ["m0=http://127.0.0.1:1", "m1=http://127.0.0.1:1"],
+            scrape_interval_s=0.2)
+        _seed_member(agg._members[0], _member_page("m0", 3, 0), streams=2)
+        _seed_member(agg._members[1], _member_page("m1", 5, 2),
+                     streams=1, burning=True)
+        return agg
+
+    def test_parse_roundtrip_families(self):
+        fams = parse_exposition(_member_page("m0", 3, 0))
+        kinds = {f["name"]: f["kind"] for f in fams}
+        assert kinds["vep_frames_total"] == "counter"
+        assert kinds["vep_ladder_rung"] == "gauge"
+        assert kinds["vep_lat_ms"] == "histogram"
+        hist = next(f for f in fams if f["name"] == "vep_lat_ms")
+        assert any(n.endswith("_bucket") for n, _, _ in hist["samples"])
+
+    def test_counters_sum_across_members(self):
+        fs = self._agg().fleet_stats()
+        row = fs["counters"]["vep_frames_total"]['stream="cam1"']
+        assert row["value"] == 8.0
+        assert row["instances"] == {"m0": 3.0, "m1": 5.0}
+
+    def test_histograms_bucket_merge(self):
+        fs = self._agg().fleet_stats()
+        row = fs["histograms"]["vep_lat_ms"][""]
+        assert row["count"] == 4                   # 2 observations x 2
+        assert row["buckets"]["+Inf"] == 4.0
+        # Cumulative bucket counts stay monotone after the merge.
+        finite = [(float(le), v) for le, v in row["buckets"].items()
+                  if le != "+Inf"]
+        ordered = [v for _, v in sorted(finite)]
+        assert ordered == sorted(ordered)
+
+    def test_gauges_last_write_with_staleness(self):
+        fs = self._agg().fleet_stats()
+        row = fs["gauges"]["vep_ladder_rung"][""]
+        assert row["stale"] is False
+        assert row["instances"]["m0"]["value"] == 0.0
+        assert row["instances"]["m1"]["value"] == 2.0
+
+    def test_health_folds_burn_rung_and_streams(self):
+        health = self._agg().health()
+        assert [h["instance"] for h in health] == ["m0", "m1"]  # ranked
+        m0, m1 = health
+        assert m0["score"] > m1["score"]
+        assert m1["slo_burning"] and m1["ladder_rung"] == 2.0
+        assert m0["streams"] == 2 and m1["streams"] == 1
+
+    def test_merged_exposition_lint_clean_with_instances(self):
+        text = self._agg().merged_exposition()
+        assert lint_exposition(text) == []
+        assert 'vep_frames_total{instance="m0",stream="cam1"} 3' in text
+        assert 'vep_frames_total{instance="m1",stream="cam1"} 5' in text
+        assert "vep_fleet_member_health_score" in text
+        assert "vep_fleet_members 2" in text
+
+    def test_dead_member_scores_zero_and_flags_stale(self):
+        agg = self._agg()
+        m1 = agg._members[1]
+        m1.alive = False
+        m1.last_ok = time.monotonic() - 10 * agg.stale_after_s
+        health = {h["instance"]: h for h in agg.health()}
+        assert health["m1"]["stale"] is True
+        assert health["m1"]["score"] == 0.0
+        assert health["m0"]["stale"] is False
+
+    def test_label_helpers(self):
+        assert _strip_label('a="1",instance="m0",b="2"', "instance") == \
+            'a="1",b="2"'
+        assert _with_instance("", "m0") == 'instance="m0"'
+        assert _with_instance('k="v"', "m0") == 'instance="m0",k="v"'
+        # A member that already self-labels keeps its own identity.
+        assert _with_instance('instance="self",k="v"', "m0") == \
+            'instance="self",k="v"'
+
+
+# ---------------------------------------------------------------------------
+# Feature-disabled notice (satellite 1)
+
+
+class TestFeatureDisabledGauge:
+    def test_gauge_set_and_log_once(self):
+        import logging
+
+        from video_edge_ai_proxy_tpu.engine import runner
+        from video_edge_ai_proxy_tpu.obs import registry as obs_registry
+
+        # The vep_tpu root logger does not propagate (utils/logging.py),
+        # so capture with a handler on the runner's own logger.
+        records: list = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = _Capture()
+        logger = logging.getLogger("vep_tpu.engine.runner")
+        logger.addHandler(handler)
+        try:
+            runner._FEATURES_NOTED.discard(("roi", "test_reason"))
+            runner._note_feature_disabled("roi", "test_reason")
+            runner._note_feature_disabled("roi", "test_reason")
+        finally:
+            logger.removeHandler(handler)
+        notices = [m for m in records if "test_reason" in m]
+        assert len(notices) == 1          # once per process, not per tick
+        text = obs_registry.render()
+        assert ('vep_engine_feature_disabled{feature="roi",'
+                'reason="test_reason"} 1' in text)
+
+
+# ---------------------------------------------------------------------------
+# Multi-engine trace merge (tools/obs_export.py --merge --member)
+
+
+class TestMultiEngineMerge:
+    def _spans_file(self, tmp_path, name, stream):
+        rec = SpanRecorder(enabled=True, sample_every=1)
+        tid = trace_id_for(stream, 1)
+        rec.record(stream, "collect", 1, ts=1.0, trace_id=tid)
+        rec.record(stream, "emit", 1, ts=1.01, trace_id=tid)
+        path = tmp_path / f"{name}.json"
+        path.write_text(json.dumps({"events": rec.events()}))
+        return str(path)
+
+    def test_member_pid_namespaces(self, tmp_path):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+        from tools.obs_export import merge_traces
+
+        members = []
+        for i in range(3):
+            with open(self._spans_file(tmp_path, f"m{i}", f"cam{i}")) as f:
+                members.append((f"m{i}", json.load(f)["events"]))
+        trace = merge_traces(None, None, members=members)
+        pids = {ev["pid"] for ev in trace["traceEvents"]}
+        assert pids == {1, 2, 3}
+        names = {ev["args"]["name"] for ev in trace["traceEvents"]
+                 if ev.get("name") == "process_name"}
+        assert names == {"m0", "m1", "m2"}
+        assert trace["metadata"]["merge"]["members"] == ["m0", "m1", "m2"]
+
+    def test_cli_member_flags(self, tmp_path):
+        out = tmp_path / "fleet_trace.json"
+        env = dict(os.environ)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cmd = [sys.executable, os.path.join(root, "tools", "obs_export.py"),
+               "--merge", "--check", "-o", str(out)]
+        for i in range(2):
+            cmd += ["--member",
+                    f"m{i}={self._spans_file(tmp_path, f'cli{i}', f'cam{i}')}"]
+        res = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                             timeout=60)
+        assert res.returncode == 0, res.stderr
+        assert json.loads(res.stdout)["check"] == "ok"
+        trace = json.loads(out.read_text())
+        assert {ev["pid"] for ev in trace["traceEvents"]} == {1, 2}
+
+
+# ---------------------------------------------------------------------------
+# Two-process aggregation conformance (satellite 3): real serve
+# processes, real HTTP scrapes, CPU backend, no skips.
+
+
+_MEMBER_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {root!r})
+    from video_edge_ai_proxy_tpu.obs import registry
+    from video_edge_ai_proxy_tpu.serve.server import Server
+    from video_edge_ai_proxy_tpu.utils.config import Config
+
+    instance, inc, workdir = sys.argv[1], float(sys.argv[2]), sys.argv[3]
+    registry.counter(
+        "vep_fleettest_total", "fleet conformance counter", ("k",)
+    ).labels("x").inc(inc)
+    cfg = Config()
+    cfg.bus.shm_dir = os.path.join("/dev/shm", f"vep_ft_{{os.getpid()}}")
+    cfg.annotation.endpoint = "http://127.0.0.1:1/annotate"
+    cfg.obs.instance = instance
+    srv = Server(cfg, data_dir=workdir, grpc_port=0, rest_port=0,
+                 enable_engine=False)
+    srv.start()
+    print(json.dumps({{"rest_port": srv._rest.bound_port}}), flush=True)
+    sys.stdin.readline()
+    srv.stop()
+    import shutil
+    shutil.rmtree(cfg.bus.shm_dir, ignore_errors=True)
+""")
+
+
+class TestTwoProcessConformance:
+    def test_merged_counters_and_kill_staleness(self, tmp_path):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = tmp_path / "member.py"
+        script.write_text(_MEMBER_SCRIPT.format(root=root))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"   # control plane never inits jax,
+        # but a preset axon tunnel must not leak into the children anyway
+        procs = []
+        ports = []
+        try:
+            for i, inc in enumerate((3.0, 5.0)):
+                wd = tmp_path / f"m{i}"
+                wd.mkdir()
+                p = subprocess.Popen(
+                    [sys.executable, str(script), f"m{i}", str(inc),
+                     str(wd)],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE, text=True, env=env)
+                procs.append(p)
+            for p in procs:
+                # Server logs share stdout with the ready line — skim
+                # until the JSON message (same protocol run_fleet_obs
+                # speaks with its members).
+                port = None
+                deadline = time.time() + 60
+                while port is None and time.time() < deadline:
+                    line = p.stdout.readline()
+                    assert line, p.stderr.read()
+                    try:
+                        port = json.loads(line)["rest_port"]
+                    except (ValueError, KeyError):
+                        continue
+                assert port is not None
+                ports.append(port)
+
+            agg = FleetAggregator(
+                [f"m{i}=http://127.0.0.1:{port}"
+                 for i, port in enumerate(ports)],
+                scrape_interval_s=0.5)
+            agg.scrape_once()
+
+            # Both members present + fresh.
+            health = {h["instance"]: h for h in agg.health()}
+            assert set(health) == {"m0", "m1"}
+            assert all(h["up"] and not h["stale"]
+                       for h in health.values())
+
+            # Merged counters == sum of members; per-instance parts kept.
+            fs = agg.fleet_stats()
+            row = fs["counters"]["vep_fleettest_total"]['k="x"']
+            assert row["value"] == 8.0
+            assert row["instances"] == {"m0": 3.0, "m1": 5.0}
+
+            # Merged exposition lint-clean with both instances labeled.
+            merged = agg.merged_exposition()
+            assert lint_exposition(merged) == []
+            assert 'vep_fleettest_total{instance="m0",k="x"} 3' in merged
+            assert 'vep_fleettest_total{instance="m1",k="x"} 5' in merged
+
+            # Kill m1 (by PID via the Popen handle); the NEXT scrape
+            # pass must flag it stale — within one scrape interval.
+            procs[1].kill()
+            procs[1].wait(timeout=10)
+            agg.scrape_once()
+            health = {h["instance"]: h for h in agg.health()}
+            assert health["m1"]["stale"] is True
+            assert health["m1"]["up"] is False
+            assert health["m0"]["stale"] is False
+            assert health["m0"]["score"] > health["m1"]["score"]
+            # The survivor's counter still serves from the last scrape.
+            merged = agg.merged_exposition()
+            assert lint_exposition(merged) == []
+            assert 'vep_fleet_member_stale{instance="m1"} 1' in merged
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        p.stdin.write("exit\n")
+                        p.stdin.flush()
+                    except (BrokenPipeError, OSError):
+                        pass
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()   # by PID via the handle, never pkill
+
+
+# ---------------------------------------------------------------------------
+# REST fleet routes (serve/rest_api.py)
+
+
+class TestFleetRoutes:
+    def test_disabled_returns_400(self):
+        # No fleet_members configured -> both routes refuse with the
+        # standard kill-switch message instead of serving empties.
+        from aiohttp.test_utils import TestClient, TestServer
+        import asyncio
+
+        from video_edge_ai_proxy_tpu.serve.rest_api import build_app
+
+        class _PM:
+            def list(self):
+                return []
+
+        async def run():
+            app = build_app(_PM(), settings=None, fleet=None)
+            async with TestClient(TestServer(app)) as client:
+                r1 = await client.get("/api/v1/fleet/stats")
+                r2 = await client.get("/api/v1/fleet/metrics")
+                return r1.status, r2.status
+
+        s1, s2 = asyncio.new_event_loop().run_until_complete(run())
+        assert s1 == 400 and s2 == 400
+
+    def test_enabled_serves_merged_plane(self):
+        from aiohttp.test_utils import TestClient, TestServer
+        import asyncio
+
+        from video_edge_ai_proxy_tpu.serve.rest_api import build_app
+
+        agg = FleetAggregator(["m0=http://127.0.0.1:1"],
+                              scrape_interval_s=0.2)
+        _seed_member(agg._members[0], _member_page("m0", 4, 1))
+
+        class _PM:
+            def list(self):
+                return []
+
+        async def run():
+            app = build_app(_PM(), settings=None, fleet=agg)
+            async with TestClient(TestServer(app)) as client:
+                stats = await (await client.get("/api/v1/fleet/stats")).json()
+                page = await (await client.get(
+                    "/api/v1/fleet/metrics")).text()
+                return stats, page
+
+        stats, page = asyncio.new_event_loop().run_until_complete(run())
+        assert stats["members"] == 1
+        assert stats["counters"]["vep_frames_total"][
+            'stream="cam1"']["value"] == 4.0
+        assert lint_exposition(page) == []
+        assert "vep_fleet_member_health_score" in page

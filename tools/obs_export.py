@@ -24,6 +24,12 @@ Modes::
   python tools/obs_export.py spans.json --merge \
       --device-trace plugins/profile/run/perfetto_trace.json.gz -o m.json
 
+  # r14 fleet lineage: N engine processes on ONE Perfetto timeline, one
+  # pid namespace per member; the on-wire trace_id in each span's args
+  # stitches a frame's cross-process path:
+  python tools/obs_export.py --merge \
+      --member m0=m0_spans.json --member m1=m1_spans.json -o fleet.json
+
 ``--check`` schema-validates the (converted/merged) trace and exits
 nonzero on problems — ``make obs-smoke`` / ``make prof-smoke`` gate on
 it. Pure Python, no jax.
@@ -185,18 +191,34 @@ def load_bundle(bundle_dir: str):
     return span_events, device, manifest
 
 
-def merge_traces(span_events, device_trace, t_start=None) -> dict:
+def merge_traces(span_events, device_trace, t_start=None,
+                 members=None) -> dict:
     """Fuse host lineage spans + a jax.profiler Perfetto/Chrome trace
     into one trace object on the span (wall-clock epoch µs) timeline.
 
-    Host spans keep pid 1 (to_chrome_trace); every device-trace pid is
-    remapped to 1000+ so the process tracks can never collide. Device
-    event timestamps are shifted by the estimated clock offset (module
+    Single-engine: host spans keep pid 1 (to_chrome_trace). Multi-engine
+    (r14 fleet lineage): ``members`` is ``[(name, span_events), ...]``
+    and each member gets its own pid namespace (pid 1..N, process named
+    after the member) — span timestamps are wall-clock epoch on every
+    member, so the fleet shares the clock for free, and the on-wire
+    trace_id (FrameMeta/VideoFrame/InferenceResult) in each span's args
+    is what stitches one frame's worker -> bus -> engine -> client path
+    across the process tracks. Every device-trace pid is remapped to
+    1000+ so the process tracks can never collide. Device event
+    timestamps are shifted by the estimated clock offset (module
     docstring). Device events missing required Chrome-trace fields are
     dropped rather than failing --check: jax owns that file's contents,
     and one exotic event must not sink the merge.
     """
-    host = to_chrome_trace(span_events)["traceEvents"]
+    if members:
+        host = []
+        span_events = []
+        for i, (name, evs) in enumerate(members):
+            host.extend(to_chrome_trace(
+                evs, pid=i + 1, process_name=name)["traceEvents"])
+            span_events.extend(evs)
+    else:
+        host = to_chrome_trace(span_events)["traceEvents"]
     dev_events = (device_trace or {}).get("traceEvents") or []
 
     # Earliest host device-stage span START (µs epoch): the host-side
@@ -239,26 +261,29 @@ def merge_traces(span_events, device_trace, t_start=None) -> dict:
             if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
                 ev["dur"] = 0.0
         merged.append(ev)
+    meta = {
+        "clock_offset_us": round(offset, 3),
+        "anchor": ("device_span" if anchor_us is not None
+                   else "manifest_t_start" if t_start is not None
+                   else "none"),
+        "host_events": len(host),
+        "device_events": len(merged) - len(host),
+        "device_pids": len(pid_map),
+    }
+    if members:
+        meta["members"] = [name for name, _ in members]
     return {
         "traceEvents": merged,
         "displayTimeUnit": "ms",
-        "metadata": {
-            "merge": {
-                "clock_offset_us": round(offset, 3),
-                "anchor": ("device_span" if anchor_us is not None
-                           else "manifest_t_start" if t_start is not None
-                           else "none"),
-                "host_events": len(host),
-                "device_events": len(merged) - len(host),
-                "device_pids": len(pid_map),
-            },
-        },
+        "metadata": {"merge": meta},
     }
 
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
-    ap.add_argument("input", help="input JSON path, or - for stdin")
+    ap.add_argument("input", nargs="?", default="-",
+                    help="input JSON path, or - for stdin (optional when "
+                         "--member is used)")
     ap.add_argument("-o", "--out", default="",
                     help="write Chrome trace JSON here")
     ap.add_argument("--check", action="store_true",
@@ -275,9 +300,34 @@ def main(argv=None) -> None:
                     help="jax perfetto/Chrome trace (.json or .json.gz) "
                          "to merge when the input is a spans file, not a "
                          "bundle dir")
+    ap.add_argument("--member", action="append", default=[],
+                    metavar="NAME=SPANS.json",
+                    help="r14 multi-engine merge: repeatable member spec; "
+                         "each member's spans land in their own pid "
+                         "namespace on one timeline (requires --merge; "
+                         "--device-trace still fuses device tracks)")
     args = ap.parse_args(argv)
 
-    if args.merge:
+    if args.member:
+        if not args.merge:
+            raise SystemExit("--member requires --merge")
+        members = []
+        for spec in args.member:
+            name, sep, path = spec.partition("=")
+            if not sep:
+                name, path = f"m{len(members)}", spec
+            obj = _load_json_maybe_gz(path)
+            evs, _ready = load_events(obj)
+            if evs is None:
+                raise SystemExit(
+                    f"--member {spec}: needs span events, got an "
+                    "already-converted Chrome trace")
+            members.append((name, evs))
+        device = (_load_json_maybe_gz(args.device_trace)
+                  if args.device_trace else None)
+        trace = merge_traces(None, device, members=members)
+        events = [e for _, evs in members for e in evs]
+    elif args.merge:
         if args.input != "-" and os.path.isdir(args.input):
             events, device, manifest = load_bundle(args.input)
             t_start = manifest.get("t_start")

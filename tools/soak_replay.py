@@ -40,6 +40,14 @@ silent loss), a fully-drained uplink at exit (zero deadlocks), and
 subscriber drops bounded by the frame budget. ``make chaos-smoke`` runs
 all three kinds deterministically.
 
+``--fleet N`` replaces the three legs with the r14 fleet-telemetry leg:
+N member Server subprocesses (each a full replay worker -> shm bus ->
+engine -> gRPC/REST pipeline) under one FleetAggregator, hard-gating a
+lint-clean merged exposition, every member present, at least one fully
+cross-process-stitched trace (worker -> bus -> engine -> client via the
+on-wire trace_id) and merged-counter conservation; artifact
+``FLEETOBS_r01.json`` (``make fleet-obs-smoke``).
+
 ``--faults`` also accepts the r10 output-quality kinds (black_frame,
 frozen_frame, score_drift): the soak then arms the quality tracker at
 soak-scale hysteresis plus a live canary loop and HARD-GATES that every
@@ -109,6 +117,17 @@ def main(argv=None) -> None:
                     help="retention-ring directory for --profile-on-burn "
                          "bundles (default: a fresh temp dir; printed in "
                          "the prof leg)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="r14 fleet-telemetry leg INSTEAD of the three "
+                         "default legs: N member Server subprocesses + "
+                         "one FleetAggregator, hard-gating merged-page "
+                         "lint, member presence, cross-process trace "
+                         "stitching and counter conservation "
+                         "(make fleet-obs-smoke)")
+    ap.add_argument("--fleet-out", default="FLEETOBS_r01.json",
+                    help="fleet-telemetry artifact path (--fleet)")
+    ap.add_argument("--fleet-duration", type=float, default=12.0,
+                    help="per-member replay window for --fleet, seconds")
     args = ap.parse_args(argv)
 
     import jax
@@ -128,6 +147,53 @@ def main(argv=None) -> None:
         w, h = (int(v) for v in args.size.lower().split("x"))
     except ValueError:
         ap.error(f"--size must be WxH, got {args.size!r}")
+
+    # -- fleet-telemetry leg (--fleet N): replaces the default legs -------
+    if args.fleet:
+        from video_edge_ai_proxy_tpu.replay.harness import run_fleet_obs
+
+        fleet = run_fleet_obs(
+            n_members=args.fleet, duration_s=args.fleet_duration,
+            width=w, height=h, model=model, native=args.native)
+        fleet["tool"] = "soak_replay"
+        fleet["backend"] = backend
+        with open(args.fleet_out, "w") as f:
+            json.dump(fleet, f, indent=2)
+            f.write("\n")
+        gates = fleet["gates"]
+        print(json.dumps({
+            "leg": "fleet", "artifact": args.fleet_out,
+            "members": fleet["members"], "gates": gates,
+            "client_results": fleet["client_results"],
+            "health": [
+                {k: row[k] for k in ("instance", "score", "up", "stale",
+                                     "ladder_rung", "streams")}
+                for row in fleet["health"]],
+        }), flush=True)
+        failures = []
+        if not gates["merged_lint_clean"]:
+            failures.append(
+                f"merged exposition lint: {fleet['lint_errors']}")
+        if not gates["member_lint_clean"]:
+            failures.append("a member /metrics page failed lint")
+        if not gates["all_members_present"]:
+            failures.append(
+                f"member missing/stale at quiesce: {fleet['health']}")
+        if not gates["stitched_traces"]:
+            failures.append(
+                "no fully-stitched cross-process trace (worker -> bus -> "
+                "engine -> client)")
+        if not gates["counters_conserved"]:
+            failures.append(
+                f"merged counters != member sums: "
+                f"{fleet['counter_mismatches']}")
+        if not gates["fleet_trace_valid"]:
+            failures.append(
+                f"merged fleet timeline invalid: "
+                f"{fleet['trace_problems']}")
+        if failures:
+            raise SystemExit("fleet obs failure: " + "; ".join(failures))
+        return
 
     artifact: dict = {"tool": "soak_replay", "backend": backend}
 

@@ -75,6 +75,14 @@ class FrameMeta:
     is_corrupt: bool = False
     frame_type: str = ""
     time_base: float = 0.0
+    # Cross-process trace context (r14 fleet telemetry): stamped once at
+    # worker publish (obs/spans.py trace_id_for — deterministic, so replay
+    # checksums stay bit-identical) and carried by every bus backend so
+    # worker -> bus -> engine -> client span fragments stitch into ONE
+    # lineage. 0 = unstamped (pre-r14 producer); consumers then derive the
+    # same id from (device_id, packet).
+    trace_id: int = 0
+    parent_span: int = 0
 
 
 @dataclass

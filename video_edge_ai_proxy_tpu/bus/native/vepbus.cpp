@@ -39,7 +39,7 @@ namespace {
 constexpr uint64_t kRingMagic = 0x56455042'52494e47ULL;  // "VEPBRING"
 constexpr uint64_t kKvMagic = 0x56455042'4b560001ULL;
 constexpr uint64_t kDoorbellMagic = 0x56455042'44420001ULL;  // "VEPB" "DB"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;  // v2: FrameMeta grew trace_id/parent_span
 constexpr size_t kKeyCap = 96;
 constexpr size_t kValCap = 1024;
 
@@ -60,6 +60,8 @@ struct FrameMeta {
   int32_t frame_type;    // 0=?, 1=I, 2=P, 3=B
   int32_t dtype;         // 0=uint8
   double time_base;
+  int64_t trace_id;      // cross-process lineage (0 = unstamped)
+  int64_t parent_span;
 };
 
 struct SlotHeader {

@@ -136,6 +136,8 @@ class RedisFrameBus(FrameBus):
             time_base=meta.time_base,
             is_keyframe=meta.is_keyframe,
             is_corrupt=meta.is_corrupt,
+            trace_id=meta.trace_id,
+            parent_span=meta.parent_span,
         )
         for i, dim in enumerate(arr.shape):
             vf.shape.dim.append(pb.ShapeProto.Dim(size=dim, name=str(i)))
@@ -475,5 +477,6 @@ def _unmarshal(payload: bytes) -> dict:
         packet=vf.packet, keyframe_cnt=vf.keyframe,
         is_keyframe=vf.is_keyframe, is_corrupt=vf.is_corrupt,
         frame_type=vf.frame_type, time_base=vf.time_base,
+        trace_id=vf.trace_id, parent_span=vf.parent_span,
     )
     return {"data": data, "meta": meta}

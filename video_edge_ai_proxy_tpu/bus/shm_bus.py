@@ -48,6 +48,8 @@ class _CFrameMeta(ctypes.Structure):
         ("frame_type", ctypes.c_int32),
         ("dtype", ctypes.c_int32),
         ("time_base", ctypes.c_double),
+        ("trace_id", ctypes.c_int64),
+        ("parent_span", ctypes.c_int64),
     ]
 
 
@@ -246,6 +248,8 @@ class ShmFrameBus(FrameBus):
             frame_type=FRAME_TYPE_CODES.get(meta.frame_type, 0),
             dtype=0,
             time_base=meta.time_base,
+            trace_id=meta.trace_id,
+            parent_span=meta.parent_span,
         )
         with self._lock:
             if self._closed:
@@ -358,6 +362,7 @@ class ShmFrameBus(FrameBus):
             is_keyframe=bool(cm.is_keyframe), is_corrupt=bool(cm.is_corrupt),
             frame_type=FRAME_TYPE_NAMES.get(int(cm.frame_type), ""),
             time_base=float(cm.time_base),
+            trace_id=int(cm.trace_id), parent_span=int(cm.parent_span),
         )
         return Frame(seq=int(seq), data=data, meta=meta)
 
@@ -399,6 +404,7 @@ class ShmFrameBus(FrameBus):
             is_corrupt=bool(cm.is_corrupt),
             frame_type=FRAME_TYPE_NAMES.get(int(cm.frame_type), ""),
             time_base=float(cm.time_base),
+            trace_id=int(cm.trace_id), parent_span=int(cm.parent_span),
         )
         return int(seq), meta
 

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..bus.interface import Frame, FrameBus, FrameMeta
 from ..obs import registry as obs_registry, tracer
+from ..obs.spans import trace_id_of
 
 
 @dataclass
@@ -319,7 +320,8 @@ class Collector:
         self._cursors[device_id] = seq
         if meta is not None and tracer.sampled(meta.packet):
             tracer.record(
-                device_id, "collect", meta.packet, pub_ms=meta.timestamp_ms
+                device_id, "collect", meta.packet, pub_ms=meta.timestamp_ms,
+                trace_id=trace_id_of(meta, device_id),
             )
 
     def _stream_model(self, device_id: str):

@@ -39,6 +39,7 @@ import numpy as np
 
 from ..bus.interface import FrameBus, FrameMeta
 from ..obs import registry as obs_registry, tracer
+from ..obs.spans import trace_id_of
 from ..obs.perf import PerfTracker
 from ..obs.prof import Profiler
 from ..obs.slo import SLOEngine, default_slos
@@ -157,6 +158,28 @@ def build_serving_step(model, spec, *, quality_thumb: int = 0):
 
 _RUNG_IDX = {r: i for i, r in enumerate(RUNGS)}
 
+# Once-per-process memo for _note_feature_disabled: engine restarts within
+# one process (tests, soak harnesses) would otherwise re-log every
+# construction, and dashboards only need the gauge, not the log scrape.
+_FEATURES_NOTED: set = set()
+
+
+def _note_feature_disabled(feature: str, reason: str) -> None:
+    """Surface an auto-disabled engine feature as a gauge
+    (``vep_engine_feature_disabled{feature,reason}`` == 1) plus ONE
+    process-lifetime log line — fleet dashboards watch the metric, not
+    per-startup warnings."""
+    obs_registry.gauge(
+        "vep_engine_feature_disabled",
+        "1 when an engine feature auto-disabled itself (see reason label)",
+        ("feature", "reason"),
+    ).labels(feature, reason).set(1.0)
+    key = (feature, reason)
+    if key not in _FEATURES_NOTED:
+        _FEATURES_NOTED.add(key)
+        log.info("%s: disabled (%s); vep_engine_feature_disabled gauge set",
+                 feature, reason)
+
 
 def admitted_streams(
     inferred: Sequence[str], deprioritized: Sequence[str] = (),
@@ -186,7 +209,10 @@ def shed_stale(group: BatchGroup, now_ms: float, max_staleness_ms: float,
     within the pooled buffer view (the lease is untouched) and the view
     re-slices to the smallest covering bucket. Returns ``(group, shed)``;
     group is None when every row was stale (caller releases the lease).
-    Frames without a publish timestamp are treated as fresh."""
+    Frames without a publish timestamp are treated as fresh. Shed rows
+    close their lineage with a terminal ``dropped`` span (r14 bugfix:
+    the per-stream ring used to keep the span open forever, so trace
+    export and stage_breakdown undercounted drops)."""
     keep = [
         i for i, m in enumerate(group.metas)
         if not m.timestamp_ms or now_ms - m.timestamp_ms <= max_staleness_ms
@@ -194,6 +220,14 @@ def shed_stale(group: BatchGroup, now_ms: float, max_staleness_ms: float,
     shed = len(group.metas) - len(keep)
     if shed == 0:
         return group, 0
+    if tracer.enabled:
+        kept = set(keep)
+        for i, m in enumerate(group.metas):
+            if i not in kept and tracer.sampled(m.packet):
+                tracer.record(
+                    group.device_ids[i], "dropped", m.packet,
+                    reason="stale_shed", trace_id=trace_id_of(
+                        m, group.device_ids[i]))
     if not keep:
         return None, shed
     for new_i, old_i in enumerate(keep):
@@ -828,8 +862,8 @@ class InferenceEngine:
             self._roi = _RoiGate(
                 self._cfg.roi_idle_diff, self._cfg.roi_full_interval_ms)
         elif self._cfg.roi:
-            log.info("roi: disabled under mesh serving (canvas "
-                     "scatter-back is single-chip); full frames remain")
+            _note_feature_disabled(
+                "roi", "mesh_serving_single_chip_scatter_back")
         # H2D prefetch stage (cfg.prefetch): placement of collected
         # batches moves off the tick thread onto a dedicated transfer
         # thread, double-buffered at depth 2 to match the drain pipeline.
@@ -860,10 +894,8 @@ class InferenceEngine:
             self._quality_device = (
                 self._cfg.quality_thumb > 0 and not self._cfg.mesh)
             if self._cfg.mesh:
-                log.info(
-                    "quality: device frame stats disabled on mesh "
-                    "serving (thumbnail state is not sharded); "
-                    "detections-only verdicts remain")
+                _note_feature_disabled(
+                    "quality_device_stats", "mesh_thumbnail_not_sharded")
 
     # -- lifecycle --
 
@@ -1942,6 +1974,7 @@ class InferenceEngine:
                         tracer.record(
                             did, "submit", meta.packet,
                             ts=t_submit, bucket=group.bucket,
+                            trace_id=trace_id_of(meta, did),
                         )
             self._enqueue_drain(
                 _Inflight(group, outputs, t_submit, t_collect)
@@ -2277,6 +2310,13 @@ class InferenceEngine:
                 return
             except queue.Full:
                 continue
+        if tracer.enabled:
+            for did, m in zip(inflight.group.device_ids,
+                              inflight.group.metas):
+                if tracer.sampled(m.packet):
+                    tracer.record(did, "dropped", m.packet,
+                                  reason="shutdown_drain",
+                                  trace_id=trace_id_of(m, did))
         self._collector.release(inflight.group)
 
     def _drain_loop(self) -> None:
@@ -2386,6 +2426,10 @@ class InferenceEngine:
             latency_ms=latency,
             batch_size=group.bucket,
             frame_packet=meta.packet,
+            # Trace-context echo: clients join their receive event to the
+            # frame's cross-process lineage on this id (0 = unstamped).
+            trace_id=meta.trace_id,
+            parent_span=meta.parent_span,
         )
         self._publish(result)
         if self._cfg.stage_trace:
@@ -2417,11 +2461,12 @@ class InferenceEngine:
         if latency > self._cfg.obs_late_ms:
             self._m_late.labels(device_id).inc()
         if tracer.sampled(meta.packet):
+            tid = trace_id_of(meta, device_id)
             tracer.record(
                 device_id, "device", meta.packet, ts=t_drained,
-                dur_ms=device_ms, bucket=group.bucket,
+                dur_ms=device_ms, bucket=group.bucket, trace_id=tid,
             )
-            tracer.record(device_id, "emit", meta.packet)
+            tracer.record(device_id, "emit", meta.packet, trace_id=tid)
 
     def _emit_coast(self, inflight: _Inflight, spec) -> None:
         """Emit a gated-idle (MOSAIC ``coast``) group: detections were
@@ -2549,6 +2594,8 @@ class InferenceEngine:
             latency_ms=latency,
             batch_size=group.bucket,
             frame_packet=meta.packet,
+            trace_id=meta.trace_id,
+            parent_span=meta.parent_span,
         )
         self._publish(result)
         self._annotate(device_id, meta, detections, spec)

@@ -40,7 +40,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..bus import FrameBus, FrameMeta, RingSlotTooSmall, open_bus
-from ..obs import registry as obs_registry, tracer
+from ..obs import registry as obs_registry, trace_id_for, tracer
 from ..utils.logging import get_logger, set_log_context
 from .archive import GopSegment, PacketGopSegment, SegmentArchiver
 from .sources import VideoSource, open_source
@@ -495,6 +495,10 @@ class IngestWorker:
                         is_corrupt=pkt.is_corrupt,
                         frame_type=frame_type,
                         time_base=pkt.time_base,
+                        # Cross-process lineage origin: deterministic id
+                        # (replay-stable) stamped once here and carried by
+                        # the bus + echoed in every serve response.
+                        trace_id=trace_id_for(cfg.device_id, pkt.packet),
                     )
                     try:
                         self.bus.publish(cfg.device_id, frame, meta)
@@ -519,7 +523,8 @@ class IngestWorker:
                     if tracer.sampled(meta.packet):
                         # Lineage origin: frame id (the packet number) is
                         # stamped here and flows unchanged to result emit.
-                        tracer.record(cfg.device_id, "publish", meta.packet)
+                        tracer.record(cfg.device_id, "publish", meta.packet,
+                                      trace_id=meta.trace_id)
                     if self._recorder is not None:
                         # Record what was published: synthetic frames are
                         # fully determined by (w, h, n), so the trace keeps

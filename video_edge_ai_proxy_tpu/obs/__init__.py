@@ -3,7 +3,7 @@ stall/watermark detection (ISSUE r7 tentpole), live device-performance
 attribution and SLO burn-rate evaluation (ISSUE r9 tentpole).
 
 Pure-Python, jax-free at import, importable from control-plane and worker
-code alike. Seven modules:
+code alike. Eight modules:
 
 - :mod:`metrics` — process-wide counters/gauges/log2-histograms, rendered
   once by ``/metrics`` (Prometheus 0.0.4) and ``/api/v1/stats`` (JSON).
@@ -28,6 +28,11 @@ code alike. Seven modules:
   canary golden-replay integrity check (``vep_quality_*`` /
   ``/api/v1/quality``), feeding the degradation ladder's first-shed set
   and the ``canary_integrity`` SLO.
+- :mod:`fleet` — the cross-process tier (ISSUE r14 tentpole): scrapes N
+  member engines' ``/metrics`` + ``/api/v1/stats`` + ``/api/v1/slo``,
+  merges counters (sum) / gauges (last-write + staleness flag) /
+  histograms (bucket merge) under an ``instance`` label, and ranks
+  member health (``vep_fleet_*``, ``/api/v1/fleet/stats``).
 """
 
 from .metrics import Registry, registry
@@ -35,7 +40,10 @@ from .perf import PerfTracker, cost_summary, mfu_pct
 from .prof import Profiler
 from .quality import CanaryChecker, QualityTracker
 from .slo import BurnRateSLO, SLOEngine, SLOSpec, default_slos, integrity_slo
-from .spans import SpanRecorder, stage_breakdown, to_chrome_trace, tracer
+from .fleet import FleetAggregator
+from .spans import (
+    SpanRecorder, stage_breakdown, to_chrome_trace, trace_id_for, tracer,
+)
 from .watch import Watchdog
 
 __all__ = [
@@ -52,9 +60,11 @@ __all__ = [
     "SLOSpec",
     "default_slos",
     "integrity_slo",
+    "FleetAggregator",
     "SpanRecorder",
     "stage_breakdown",
     "to_chrome_trace",
+    "trace_id_for",
     "tracer",
     "Watchdog",
 ]

@@ -1,0 +1,520 @@
+"""Fleet telemetry plane: multi-engine aggregation + member health.
+
+ROADMAP item 4's observability substrate (MultiStream, arxiv 2207.06078:
+a many-camera monitor is only operable when per-member telemetry rolls up
+into one pane). Every other obs module is process-local; this one makes N
+engine processes read as one system:
+
+- ``FleetAggregator`` scrapes each member's ``/metrics`` +
+  ``/api/v1/stats`` + ``/api/v1/slo`` over plain HTTP (stdlib urllib —
+  jax-free, dependency-free, importable from control-plane code).
+- **Merge rules** (ISSUE r14): counters are SUMMED across members,
+  log2 histograms are bucket-merged (identical ``le`` grids by
+  construction — metrics.py owns the bounds), gauges are last-write per
+  member with a staleness flag instead of a meaningless cross-member sum.
+- ``merged_exposition()`` renders ONE lint-clean Prometheus text page:
+  every member sample labeled ``instance="<member>"`` (preserved when the
+  member already self-labels via ``Registry.set_const_labels``), plus the
+  ``vep_fleet_*`` health families below.
+- **Member health scoring**: liveness/staleness, SLO burn, degradation
+  ladder rung and admitted-stream count folded into one ranked view —
+  exactly the input the item-4 router will consume for shed/re-place
+  decisions.
+
+Serving: any member exposes ``/api/v1/fleet/stats`` +
+``/api/v1/fleet/metrics`` when ``obs.fleet_members`` is configured
+(serve/rest_api.py), and ``python -m video_edge_ai_proxy_tpu.obs.fleet``
+runs the same aggregator standalone on stdlib http.server.
+
+Fleet metric families (all gauges unless noted):
+
+- ``vep_fleet_members`` — configured member count
+- ``vep_fleet_member_up{instance}`` — 1 after a successful last scrape
+- ``vep_fleet_member_staleness_seconds{instance}`` — age of last good
+  scrape
+- ``vep_fleet_member_stale{instance}`` — staleness flag (dead OR older
+  than the staleness bound)
+- ``vep_fleet_member_health_score{instance}`` — ranked health in [0, 1]
+- ``vep_fleet_member_slo_burning{instance}``
+- ``vep_fleet_member_ladder_rung{instance}``
+- ``vep_fleet_member_streams{instance}``
+- ``vep_fleet_scrapes_total{instance}`` /
+  ``vep_fleet_scrape_failures_total{instance}`` (counters)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_LABEL_TOKEN = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"\s*(?:,|$)')
+
+
+def parse_exposition(text: str) -> List[dict]:
+    """Parse Prometheus text 0.0.4 into ordered families:
+    ``[{name, kind, help, samples: [(sample_name, labels_str, value)]}]``.
+    ``labels_str`` is the raw inside-braces text ("" when unlabeled);
+    values stay floats. Tolerant of unannounced samples (untyped
+    family synthesized) so a foreign member's page still merges."""
+    fams: List[dict] = []
+    by_name: Dict[str, dict] = {}
+
+    def family(name: str) -> dict:
+        fam = by_name.get(name)
+        if fam is None:
+            fam = {"name": name, "kind": "untyped", "help": "",
+                   "samples": []}
+            by_name[name] = fam
+            fams.append(fam)
+        return fam
+
+    def base_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in by_name:
+                return name[: -len(suffix)]
+        return name
+
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) < 3:
+                continue
+            fam = family(parts[2])
+            if line.startswith("# HELP "):
+                fam["help"] = parts[3] if len(parts) > 3 else ""
+            elif len(parts) > 3:
+                fam["kind"] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < 0:
+                continue
+            name = line[:brace]
+            labels = line[brace + 1:close]
+            rest = line[close + 1:].strip()
+        else:
+            name, _, rest = line.partition(" ")
+            labels = ""
+        try:
+            value = float(rest.split()[0])
+        except (ValueError, IndexError):
+            continue
+        family(base_of(name))["samples"].append((name, labels, value))
+    return fams
+
+
+def _labels_dict(labels_str: str) -> Dict[str, str]:
+    return {m.group(1): m.group(2)
+            for m in _LABEL_TOKEN.finditer(labels_str)}
+
+
+def _strip_label(labels_str: str, name: str) -> str:
+    """Remove one ``name="..."`` pair from a raw label string."""
+    pairs = [(m.group(1), m.group(2))
+             for m in _LABEL_TOKEN.finditer(labels_str)]
+    return ",".join(f'{n}="{v}"' for n, v in pairs if n != name)
+
+
+def _with_instance(labels_str: str, instance: str) -> str:
+    """Ensure the sample carries ``instance="..."`` (members that
+    self-label via set_const_labels keep their own value)."""
+    if re.search(r'(^|,)\s*instance="', labels_str):
+        return labels_str
+    pair = f'instance="{instance}"'
+    return f"{pair},{labels_str}" if labels_str else pair
+
+
+class MemberState:
+    """Last-scrape snapshot of one fleet member (mutated only by the
+    aggregator thread; read under the aggregator lock)."""
+
+    def __init__(self, name: str, base_url: str):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.alive = False
+        self.last_ok: Optional[float] = None     # time.monotonic()
+        self.last_err = ""
+        self.scrapes = 0
+        self.failures = 0
+        self.families: List[dict] = []
+        self.stats: dict = {}
+        self.slo: dict = {}
+
+    # -- derived health signals --
+
+    def staleness_s(self, now: float) -> Optional[float]:
+        return None if self.last_ok is None else max(0.0, now - self.last_ok)
+
+    def streams(self) -> int:
+        eng = (self.stats or {}).get("engine") or {}
+        return len(eng.get("streams") or {})
+
+    def burning(self) -> bool:
+        return bool((self.slo or {}).get("burning"))
+
+    def ladder_rung(self) -> float:
+        for fam in self.families:
+            if fam["name"] == "vep_ladder_rung":
+                for _, _, value in fam["samples"]:
+                    return float(value)
+        return 0.0
+
+
+class FleetAggregator:
+    """Scrape-and-merge tier over N member engines.
+
+    ``members``: list of ``"name=http://host:port"`` (or bare URLs, which
+    take ``m<i>`` names). ``scrape_interval_s`` paces the background
+    thread (``start``/``stop``); ``scrape_once`` works without it.
+    ``stale_after_s`` defaults to one scrape interval so a killed member
+    is staleness-flagged by the very next pass (ISSUE acceptance)."""
+
+    def __init__(self, members, *, scrape_interval_s: float = 2.0,
+                 stale_after_s: Optional[float] = None,
+                 timeout_s: float = 2.0):
+        self._members: List[MemberState] = []
+        for i, spec in enumerate(members):
+            name, sep, url = str(spec).partition("=")
+            if not sep:
+                name, url = f"m{i}", str(spec)
+            self._members.append(MemberState(name, url))
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.stale_after_s = (float(stale_after_s) if stale_after_s
+                              else self.scrape_interval_s)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_scrape_wall_ms = 0.0
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-scrape", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s + 1.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.scrape_once()
+            self._stop.wait(self.scrape_interval_s)
+
+    # -- scraping --
+
+    def _fetch(self, url: str) -> bytes:
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+            return r.read()
+
+    def scrape_once(self) -> dict:
+        """One pass over every member; returns the health view. Errors
+        mark the member down (failures counted) — never raise."""
+        t0 = time.monotonic()
+        for m in self._members:
+            try:
+                text = self._fetch(m.base_url + "/metrics").decode()
+                stats = json.loads(self._fetch(m.base_url + "/api/v1/stats"))
+                try:
+                    slo = json.loads(self._fetch(m.base_url + "/api/v1/slo"))
+                except Exception:
+                    slo = {}   # SLO plane disabled on the member (400)
+                with self._lock:
+                    m.families = parse_exposition(text)
+                    m.stats = stats
+                    m.slo = slo
+                    m.alive = True
+                    m.last_ok = time.monotonic()
+                    m.last_err = ""
+                    m.scrapes += 1
+            except Exception as e:  # noqa: BLE001 — any member fault
+                with self._lock:
+                    m.alive = False
+                    m.last_err = f"{type(e).__name__}: {e}"
+                    m.failures += 1
+        self._last_scrape_wall_ms = (time.monotonic() - t0) * 1000.0
+        return self.health()
+
+    # -- health --
+
+    def _member_health(self, m: MemberState, now: float) -> dict:
+        staleness = m.staleness_s(now)
+        stale = (not m.alive) or staleness is None \
+            or staleness > self.stale_after_s
+        rung = m.ladder_rung()
+        streams = m.streams()
+        burning = m.burning()
+        if not m.alive and m.last_ok is None:
+            score = 0.0
+        else:
+            score = 0.0 if stale else max(0.0, min(1.0, (
+                1.0 - (0.5 if burning else 0.0)
+                - 0.15 * rung - 0.02 * streams)))
+        return {
+            "instance": m.name,
+            "url": m.base_url,
+            "up": m.alive,
+            "stale": stale,
+            "staleness_s": round(staleness, 3)
+            if staleness is not None else None,
+            "slo_burning": burning,
+            "ladder_rung": rung,
+            "streams": streams,
+            "score": round(score, 4),
+            "scrapes": m.scrapes,
+            "failures": m.failures,
+            "last_err": m.last_err,
+        }
+
+    def health(self) -> List[dict]:
+        """Per-member health, ranked best-first (the router's shed /
+        re-place input: shed FROM the tail, place ONTO the head)."""
+        now = time.monotonic()
+        with self._lock:
+            rows = [self._member_health(m, now) for m in self._members]
+        rows.sort(key=lambda r: (-r["score"], r["instance"]))
+        return rows
+
+    # -- merging --
+
+    def _merge(self) -> Tuple[dict, dict, dict]:
+        """(counters, gauges, histograms) merged across live members.
+
+        counters:   {family: {labels: {"value": sum,
+                     "instances": {name: v}}}}   — sum semantics
+        gauges:     {family: {labels: {"value": last-write,
+                     "instance": name, "stale": bool,
+                     "instances": {name: {"value": v, "stale": bool}}}}}
+        histograms: {family: {labels: {"buckets": {le: cum}, "sum": s,
+                     "count": n}}}               — bucket-wise sum
+        ``labels`` excludes instance/le. Last-write for a gauge = the
+        most recently scraped member carrying it (scrape order breaks
+        ties); its staleness rides along as the flag."""
+        counters: dict = {}
+        gauges: dict = {}
+        hists: dict = {}
+        now = time.monotonic()
+        with self._lock:
+            members = [(m, m.staleness_s(now), m.families)
+                       for m in self._members]
+            stale_bound = self.stale_after_s
+        order = sorted(
+            (m for m in members if m[1] is not None),
+            key=lambda t: t[1], reverse=True)  # stalest first, freshest last
+        for m, staleness, fams in order:
+            stale = (not m.alive) or staleness > stale_bound
+            for fam in fams:
+                kind = fam["kind"]
+                if kind == "counter":
+                    slot = counters.setdefault(fam["name"], {})
+                    for _, labels, value in fam["samples"]:
+                        key = _strip_label(labels, "instance")
+                        row = slot.setdefault(
+                            key, {"value": 0.0, "instances": {}})
+                        row["value"] += value
+                        row["instances"][m.name] = value
+                elif kind == "gauge":
+                    slot = gauges.setdefault(fam["name"], {})
+                    for _, labels, value in fam["samples"]:
+                        key = _strip_label(labels, "instance")
+                        row = slot.setdefault(key, {"instances": {}})
+                        row["instances"][m.name] = {
+                            "value": value, "stale": stale}
+                        row["value"] = value        # last write wins
+                        row["instance"] = m.name
+                        row["stale"] = stale
+                elif kind == "histogram":
+                    slot = hists.setdefault(fam["name"], {})
+                    for name, labels, value in fam["samples"]:
+                        key = _strip_label(
+                            _strip_label(labels, "instance"), "le")
+                        row = slot.setdefault(
+                            key, {"buckets": {}, "sum": 0.0, "count": 0})
+                        if name.endswith("_bucket"):
+                            le = _labels_dict(labels).get("le", "+Inf")
+                            row["buckets"][le] = \
+                                row["buckets"].get(le, 0.0) + value
+                        elif name.endswith("_sum"):
+                            row["sum"] += value
+                        elif name.endswith("_count"):
+                            row["count"] += int(value)
+        return counters, gauges, hists
+
+    def fleet_stats(self) -> dict:
+        """The ``/api/v1/fleet/stats`` body: ranked health + merged
+        counters/gauges/histograms + scrape-plane accounting."""
+        counters, gauges, hists = self._merge()
+        return {
+            "members": len(self._members),
+            "scrape_interval_s": self.scrape_interval_s,
+            "stale_after_s": self.stale_after_s,
+            "last_scrape_wall_ms": round(self._last_scrape_wall_ms, 3),
+            "health": self.health(),
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+        }
+
+    def _fleet_families(self) -> List[str]:
+        health = self.health()
+        lines = [
+            "# HELP vep_fleet_members Configured fleet member count",
+            "# TYPE vep_fleet_members gauge",
+            f"vep_fleet_members {len(self._members)}",
+        ]
+
+        def fam(name, kind, help_text, key, cast=lambda v: f"{v:g}"):
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for row in health:
+                val = key(row)
+                lines.append(
+                    f'{name}{{instance="{row["instance"]}"}} {cast(val)}')
+
+        fam("vep_fleet_member_up", "gauge",
+            "1 when the member's last scrape succeeded",
+            lambda r: 1.0 if r["up"] else 0.0)
+        fam("vep_fleet_member_stale", "gauge",
+            "1 when the member is dead or past the staleness bound",
+            lambda r: 1.0 if r["stale"] else 0.0)
+        fam("vep_fleet_member_staleness_seconds", "gauge",
+            "Age of the member's last successful scrape",
+            lambda r: r["staleness_s"]
+            if r["staleness_s"] is not None else -1.0)
+        fam("vep_fleet_member_health_score", "gauge",
+            "Ranked member health in [0,1] (router placement input)",
+            lambda r: r["score"])
+        fam("vep_fleet_member_slo_burning", "gauge",
+            "1 when the member's SLO engine reports burning",
+            lambda r: 1.0 if r["slo_burning"] else 0.0)
+        fam("vep_fleet_member_ladder_rung", "gauge",
+            "Member degradation-ladder rung index",
+            lambda r: r["ladder_rung"])
+        fam("vep_fleet_member_streams", "gauge",
+            "Member admitted-stream count",
+            lambda r: r["streams"])
+        fam("vep_fleet_scrapes_total", "counter",
+            "Successful member scrapes", lambda r: r["scrapes"])
+        fam("vep_fleet_scrape_failures_total", "counter",
+            "Failed member scrapes", lambda r: r["failures"])
+        return lines
+
+    def merged_exposition(self) -> str:
+        """One Prometheus text page for the whole fleet: every member
+        sample with an ``instance`` label (contiguous per family — the
+        member pages are re-grouped, not concatenated) plus the
+        ``vep_fleet_*`` families. Lint-clean under
+        ``metrics.lint_exposition`` (tested on member AND merged
+        output)."""
+        with self._lock:
+            per_member = [(m.name, m.families) for m in self._members
+                          if m.families]
+        merged: Dict[str, dict] = {}
+        order: List[str] = []
+        for name, fams in per_member:
+            for fam in fams:
+                slot = merged.get(fam["name"])
+                if slot is None:
+                    slot = {"kind": fam["kind"], "help": fam["help"],
+                            "samples": []}
+                    merged[fam["name"]] = slot
+                    order.append(fam["name"])
+                for sample_name, labels, value in fam["samples"]:
+                    slot["samples"].append(
+                        (sample_name, _with_instance(labels, name), value))
+        lines: List[str] = []
+        seen: set = set()
+        for fname in order:
+            fam = merged[fname]
+            if fam["help"]:
+                lines.append(f"# HELP {fname} {fam['help']}")
+            lines.append(f"# TYPE {fname} {fam['kind']}")
+            for sample_name, labels, value in fam["samples"]:
+                key = (sample_name, labels)
+                if key in seen:   # two members claiming one identity
+                    continue
+                seen.add(key)
+                ls = "{" + labels + "}" if labels else ""
+                lines.append(f"{sample_name}{ls} {value:g}")
+        lines.extend(self._fleet_families())
+        return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> None:
+    """Standalone aggregator: scrape members, serve the merged plane on
+    stdlib http.server (no aiohttp/jax — deployable next to any member).
+
+    Usage::
+
+      python -m video_edge_ai_proxy_tpu.obs.fleet \\
+          --members m0=http://h0:8080 m1=http://h1:8080 --port 9090
+    """
+    import argparse
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--members", nargs="+", required=True,
+                    help="member specs: name=http://host:port (or bare "
+                         "URLs, auto-named m0..mN)")
+    ap.add_argument("--port", type=int, default=9090)
+    ap.add_argument("--scrape-interval", type=float, default=2.0)
+    ap.add_argument("--stale-after", type=float, default=0.0,
+                    help="staleness bound seconds (0 = one scrape "
+                         "interval)")
+    args = ap.parse_args(argv)
+
+    agg = FleetAggregator(
+        args.members, scrape_interval_s=args.scrape_interval,
+        stale_after_s=args.stale_after or None)
+    agg.start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            if self.path.split("?")[0] in ("/metrics",
+                                           "/api/v1/fleet/metrics"):
+                body = agg.merged_exposition().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path.split("?")[0] == "/api/v1/fleet/stats":
+                body = json.dumps(agg.fleet_stats()).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
+    print(json.dumps({"fleet_aggregator": True, "port": srv.server_port,
+                      "members": len(agg._members)}), flush=True)
+    try:
+        srv.serve_forever()
+    finally:
+        agg.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -236,6 +236,12 @@ class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._families: Dict[str, Family] = {}
+        # Constant labels (e.g. instance="engine-0") applied to every
+        # sample at RENDER time — not stored per-child, so hot-path
+        # observation cost is unchanged and the label set can be
+        # (re)configured after families exist. Fleet aggregation keys
+        # member identity on these.
+        self._const: Tuple[Tuple[str, str], ...] = ()
 
     def _family(self, name: str, kind: str, help_text: str,
                 labelnames: Iterable[str]) -> Family:
@@ -270,15 +276,28 @@ class Registry:
 
     # -- rendering --
 
+    def set_const_labels(self, **labels: str) -> None:
+        """Set the render-time constant label set (replacing any previous
+        one). ``instance`` is the conventional member-identity key; a
+        family that already carries one of these names keeps its own
+        (the per-sample label wins, the const one is skipped)."""
+        self._const = tuple(sorted(
+            (str(k), str(v)) for k, v in labels.items()))
+
+    @property
+    def const_labels(self) -> Dict[str, str]:
+        return dict(self._const)
+
     @staticmethod
     def _esc(v: str) -> str:
         return (str(v).replace("\\", "\\\\").replace('"', '\\"')
                 .replace("\n", "\\n"))
 
-    @classmethod
-    def _labelstr(cls, names: Tuple[str, ...], values: Tuple[str, ...],
+    def _labelstr(self, names: Tuple[str, ...], values: Tuple[str, ...],
                   extra: str = "") -> str:
-        pairs = [f'{n}="{cls._esc(v)}"' for n, v in zip(names, values)]
+        pairs = [f'{n}="{self._esc(v)}"' for n, v in self._const
+                 if n not in names]
+        pairs += [f'{n}="{self._esc(v)}"' for n, v in zip(names, values)]
         if extra:
             pairs.append(extra)
         return "{" + ",".join(pairs) + "}" if pairs else ""

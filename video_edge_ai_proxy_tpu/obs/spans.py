@@ -19,6 +19,16 @@ Stage vocabulary (the segments a soak report breaks latency into):
 - ``submit``  — frame's batch was handed to the device drain thread.
 - ``device``  — jitted step drained; ``dur_ms`` = device wall time.
 - ``emit``    — postprocessed result published to the result plane.
+- ``dropped`` — terminal: the frame left the pipeline without a result
+  (staleness shed, shutdown drain, unrouted ROI crop). Closing the
+  lineage here keeps trace export and ``stage_breakdown`` honest about
+  drops instead of leaving the span open forever.
+
+Cross-process stitching (r14): the worker stamps ``FrameMeta.trace_id``
+(``trace_id_for`` — deterministic, content-derived) at publish; every
+span a stage records carries ``trace_id=`` in its extras and the id is
+echoed in gRPC/REST responses, so fragments from N processes join into
+one trace in the fleet merge (tools/obs_export.py).
 
 Events export as Chrome trace-event JSON (``to_chrome_trace``, loadable
 in chrome://tracing / Perfetto) via ``tools/obs_export.py`` and are
@@ -36,10 +46,38 @@ import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional
 
-STAGES = ("publish", "collect", "submit", "device", "emit")
+STAGES = ("publish", "collect", "submit", "device", "emit", "dropped")
 
 # Latency legs derivable from a complete lineage, in pipeline order.
 LEGS = ("ingest_bus", "batch", "device", "emit", "total")
+
+# FNV-1a 64-bit, masked to 63 bits so the id fits every carrier on the
+# wire (C int64 in the shm FrameMeta, protobuf int64, JSON) without sign
+# surprises.
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_TRACE_MASK = 0x7FFF_FFFF_FFFF_FFFF
+
+
+def trace_id_for(stream: str, frame_id: int) -> int:
+    """Deterministic per-frame trace id: FNV-1a over ``stream:frame``.
+
+    Content-derived (not random) so a replayed trace produces the SAME
+    ids run-over-run — replay checksums stay bit-identical with fleet
+    telemetry enabled — while ids from different streams/processes land
+    in disjoint ranges with high probability. Never returns 0 (0 on the
+    wire means "unstamped", and consumers re-derive)."""
+    h = _FNV_OFFSET
+    for b in f"{stream}:{int(frame_id)}".encode():
+        h = ((h ^ b) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return (h & _TRACE_MASK) or 1
+
+
+def trace_id_of(meta, stream: str) -> int:
+    """The frame's wire trace id, deriving it for unstamped (pre-r14 or
+    non-worker) producers so every consumer agrees on the same id."""
+    tid = int(getattr(meta, "trace_id", 0) or 0)
+    return tid if tid else trace_id_for(stream, getattr(meta, "packet", 0))
 
 
 class SpanRecorder:
@@ -170,10 +208,20 @@ def stage_breakdown(events: Iterable[dict]) -> dict:
         total       publish stamp -> result emitted
 
     Partial lineages contribute whichever legs they can; a frame sampled
-    mid-flight (ring rolled over) just has fewer legs.
+    mid-flight (ring rolled over) just has fewer legs. Lineages closed by
+    a terminal ``dropped`` span (shed, shutdown, unrouted — the r14 fix
+    for drop-orphaned spans) are counted under ``drops`` by reason
+    instead of silently reading as still-in-flight.
     """
     legs: Dict[str, List[float]] = {leg: [] for leg in LEGS}
+    drops: Dict[str, int] = {}
+    dropped_total = 0
     for (_, _), stages in _lineages(events).items():
+        dropped = stages.get("dropped")
+        if dropped is not None:
+            dropped_total += 1
+            reason = str(dropped.get("reason", "unknown"))
+            drops[reason] = drops.get(reason, 0) + 1
         collect = stages.get("collect")
         submit = stages.get("submit")
         device = stages.get("device")
@@ -194,14 +242,22 @@ def stage_breakdown(events: Iterable[dict]) -> dict:
             legs["emit"].append((emit["ts"] - device["ts"]) * 1000.0)
         if pub_ms is not None and emit is not None:
             legs["total"].append(emit["ts"] * 1000.0 - pub_ms)
-    return {leg: _leg_stats(vals) for leg, vals in legs.items()}
+    out = {leg: _leg_stats(vals) for leg, vals in legs.items()}
+    out["drops"] = {"count": dropped_total,
+                    "by_reason": dict(sorted(drops.items()))}
+    return out
 
 
-def to_chrome_trace(events: Iterable[dict]) -> dict:
+def to_chrome_trace(events: Iterable[dict], pid: int = 1,
+                    process_name: str = "video-edge-ai-proxy-tpu") -> dict:
     """Convert span events to Chrome trace-event JSON (the object; dump
     with ``json.dump``). One trace thread per stream; spans with dur_ms
     become complete events (ph "X", ts = span start), the rest instants
     (ph "i"). Loadable in chrome://tracing and Perfetto.
+
+    ``pid``/``process_name`` namespace the host track — the multi-engine
+    fleet merge (tools/obs_export.py) gives each member its own pid so N
+    engines share one timeline without track collisions.
     """
     events = list(events)
     tids: Dict[str, int] = {}
@@ -211,12 +267,12 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
         if stream not in tids:
             tids[stream] = len(tids) + 1
             trace.append({
-                "ph": "M", "name": "thread_name", "pid": 1,
+                "ph": "M", "name": "thread_name", "pid": pid,
                 "tid": tids[stream], "args": {"name": f"stream {stream}"},
             })
     trace.insert(0, {
-        "ph": "M", "name": "process_name", "pid": 1,
-        "args": {"name": "video-edge-ai-proxy-tpu"},
+        "ph": "M", "name": "process_name", "pid": pid,
+        "args": {"name": process_name},
     })
     for ev in events:
         stream = str(ev.get("stream", "?"))
@@ -227,7 +283,7 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
         base = {
             "name": ev.get("stage", "?"),
             "cat": "frame",
-            "pid": 1,
+            "pid": pid,
             "tid": tids[stream],
             "args": args,
         }

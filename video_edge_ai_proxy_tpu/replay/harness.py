@@ -1,6 +1,6 @@
 """Replay-driven soak + determinism harness (ISSUE r6 tentpole part 3).
 
-Three entry points, all consumed by ``tools/soak_replay.py``:
+Four entry points, all consumed by ``tools/soak_replay.py``:
 
 - :func:`lockstep_checksum` — deterministic replay of a trace through the
   real pipeline stages (bus -> collector -> serving step), folding the
@@ -21,6 +21,11 @@ Three entry points, all consumed by ``tools/soak_replay.py``:
   (subprocess ingest worker reading ``replay://``, bus, collector,
   engine, gRPC serve) with a client measuring publish->receive latency —
   the first true single-path e2e percentile artifact (``E2E_r06.json``).
+- :func:`run_fleet_obs` — r14 fleet telemetry soak: N member Server
+  SUBPROCESSES (``--fleet N``), a FleetAggregator scraping them, gRPC
+  clients recording the trace_id echo, and hard gates on merged-page
+  lint, member presence, cross-process trace stitching and counter
+  conservation (``FLEETOBS_r01.json``).
 
 jax/server imports live inside functions: this module is imported by the
 tools layer before the backend is chosen.
@@ -984,3 +989,344 @@ def run_e2e(
         "unit": "ms publish->client-receive",
         "obs": obs_section,
     }
+
+def _fleet_member_main(argv=None) -> None:
+    """Entry for ONE fleet-soak member subprocess (``python -m
+    video_edge_ai_proxy_tpu.replay.harness --instance m0 ...``), spawned
+    by :func:`run_fleet_obs`. Protocol over stdout (JSON lines; server
+    logs go to stderr): ``{"ready": ..., "rest_port", "grpc_port"}``
+    after boot, ``{"quiesced": ...}`` after the replay stream stopped and
+    drained (counters static — the parent's conservation-scrape window),
+    then the member blocks on stdin until the parent releases it, dumps
+    its span rings to ``--spans-out`` and exits."""
+    import argparse
+    import json
+    import shutil
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--instance", required=True)
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--trace", required=True)
+    ap.add_argument("--device", required=True)
+    ap.add_argument("--model", default="tiny_yolov8")
+    ap.add_argument("--duration", type=float, default=12.0)
+    ap.add_argument("--warmup", type=float, default=8.0,
+                    help="extra replay seconds before the measured window "
+                         "(covers worker boot + first-geometry compile)")
+    ap.add_argument("--spans-out", required=True)
+    ap.add_argument("--native", action="store_true")
+    args = ap.parse_args(argv)
+    if not args.native:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from ..obs import tracer
+    from ..serve.models import StreamProcess
+    from ..serve.server import Server
+    from ..utils.config import Config
+
+    cfg = Config()
+    cfg.bus.shm_dir = os.path.join("/dev/shm", f"vep_fleet_{os.getpid()}")
+    cfg.annotation.endpoint = "http://127.0.0.1:1/annotate"   # no egress
+    cfg.engine.model = args.model
+    cfg.engine.track = False
+    cfg.obs.trace = True
+    cfg.obs.sample_every = 4
+    cfg.obs.instance = args.instance   # const instance label on /metrics
+    srv = Server(cfg, data_dir=args.workdir, grpc_port=0, rest_port=0,
+                 enable_engine=True)
+    srv.start()
+    print(json.dumps({
+        "ready": True, "instance": args.instance,
+        "rest_port": srv._rest.bound_port,
+        "grpc_port": srv.bound_grpc_port,
+    }), flush=True)
+    try:
+        srv.process_manager.start(StreamProcess(
+            name=args.device,
+            rtsp_endpoint=(
+                f"replay://{args.trace}?device={args.device}&pace=1&loop=1"
+            ),
+        ))
+        time.sleep(args.warmup + args.duration)
+        srv.process_manager.stop(args.device)
+        time.sleep(1.0)   # engine drain: counters static after this
+        print(json.dumps({"quiesced": True, "instance": args.instance}),
+              flush=True)
+        sys.stdin.readline()   # parent finished its conservation scrapes
+    finally:
+        events = tracer.events()
+        with open(args.spans_out, "w") as f:
+            json.dump({"events": events}, f)
+        tracer.configure(enabled=False)
+        srv.stop()
+        shutil.rmtree(cfg.bus.shm_dir, ignore_errors=True)
+
+
+def run_fleet_obs(
+    *, n_members: int = 3, duration_s: float = 12.0, warmup_s: float = 8.0,
+    width: int = 128, height: int = 96, fps: float = 30.0,
+    model: str = "tiny_yolov8", native: bool = False,
+    workdir: Optional[str] = None,
+) -> dict:
+    """r14 fleet telemetry soak: N REAL server processes (each with its
+    own subprocess ingest worker, shm bus, engine, gRPC + REST), one
+    FleetAggregator scraping them, and one gRPC client per member
+    recording the ``InferenceResult.trace_id`` echo. Produces the
+    ``FLEETOBS_r01.json`` payload with the four hard gates:
+
+    - ``merged_lint_clean`` — the aggregator's single Prometheus page
+      passes ``metrics.lint_exposition``;
+    - ``all_members_present`` — every member alive + fresh in the ranked
+      health view at quiesce;
+    - ``stitched_traces`` >= 1 — at least one trace_id stamped in a
+      member's WORKER process (nonzero on the wire) observed through the
+      engine's collect/device/emit spans AND received by the client —
+      the full worker -> bus -> engine -> client lineage;
+    - ``counters_conserved`` — after quiesce, every merged counter
+      equals the sum of the members' individually-scraped values.
+    """
+    import json as _json
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    import grpc
+
+    from ..obs.fleet import FleetAggregator, _strip_label, parse_exposition
+    from ..obs.metrics import lint_exposition
+    from ..obs.spans import to_chrome_trace, validate_chrome_trace
+    from ..proto import pb, pb_grpc
+
+    tmp = workdir or tempfile.mkdtemp(prefix="vep_fleetobs_")
+    procs: list = []
+    spans_paths: list = []
+    try:
+        for i in range(n_members):
+            device = f"fleet{i}"
+            trace_path = os.path.join(tmp, f"{device}.vtrace")
+            record_synthetic_trace(
+                trace_path, [device], width=width, height=height, fps=fps,
+                gop=30, frames=max(90, int(fps * 10)))
+            spans_out = os.path.join(tmp, f"m{i}_spans.json")
+            spans_paths.append(spans_out)
+            member_dir = os.path.join(tmp, f"m{i}")
+            os.makedirs(member_dir, exist_ok=True)
+            cmd = [
+                sys.executable, "-m",
+                "video_edge_ai_proxy_tpu.replay.harness",
+                "--instance", f"m{i}", "--workdir", member_dir,
+                "--trace", trace_path, "--device", device,
+                "--model", model, "--duration", str(duration_s),
+                "--warmup", str(warmup_s), "--spans-out", spans_out,
+            ]
+            if native:
+                cmd.append("--native")
+            env = dict(os.environ)
+            if not native:
+                env["JAX_PLATFORMS"] = "cpu"
+            procs.append(subprocess.Popen(
+                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=open(os.path.join(tmp, f"m{i}.stderr"), "w"),
+                env=env, text=True))
+
+        def read_msg(proc, key, timeout_s=120.0):
+            """Next stdout JSON line carrying ``key`` (skips log noise);
+            SystemExit with the member's stderr tail on death/timeout."""
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    raise SystemExit(
+                        f"fleet member died (rc={proc.poll()}); see "
+                        f"{tmp}/m*.stderr")
+                try:
+                    msg = _json.loads(line)
+                except ValueError:
+                    continue
+                if key in msg:
+                    return msg
+            raise SystemExit(f"fleet member: no {key!r} within {timeout_s}s")
+
+        boots = [read_msg(p, "ready") for p in procs]
+        rest_ports = [b["rest_port"] for b in boots]
+        grpc_ports = [b["grpc_port"] for b in boots]
+
+        agg = FleetAggregator(
+            [f"m{i}=http://127.0.0.1:{rest_ports[i]}"
+             for i in range(n_members)],
+            scrape_interval_s=1.0)
+        agg.start()
+
+        client_tids: list = [set() for _ in range(n_members)]
+        results_count = [0] * n_members
+        stop = threading.Event()
+
+        def client(i: int) -> None:
+            channel = grpc.insecure_channel(f"127.0.0.1:{grpc_ports[i]}")
+            stub = pb_grpc.ImageStub(channel)
+            while not stop.is_set():
+                try:
+                    for res in stub.Inference(
+                            pb.InferenceRequest(), timeout=5):
+                        if stop.is_set():
+                            break
+                        results_count[i] += 1
+                        if res.trace_id:
+                            client_tids[i].add(res.trace_id)
+                except grpc.RpcError:
+                    if not stop.is_set():
+                        time.sleep(0.5)
+            channel.close()
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_members)]
+        for t in threads:
+            t.start()
+
+        for p in procs:
+            read_msg(p, "quiesced", timeout_s=warmup_s + duration_s + 120.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        # Conservation window: streams are stopped and drained, but a
+        # few heartbeat counters (engine tick loop) keep moving. Bracket
+        # the aggregator's scrape with two direct member scrapes and
+        # gate ONLY the families that were provably static across the
+        # whole window (frame/result counters are; tick counters
+        # self-exclude) — merged value must equal the member-wise sum.
+        def scrape_pages():
+            pages = []
+            for port in rest_ports:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                    pages.append(r.read().decode())
+            return pages
+
+        def counter_sums(pages):
+            out: dict = {}
+            for page in pages:
+                for fam in parse_exposition(page):
+                    if fam["kind"] != "counter":
+                        continue
+                    for _name, labels, value in fam["samples"]:
+                        key = (fam["name"],
+                               _strip_label(labels, "instance"))
+                        out[key] = out.get(key, 0.0) + value
+            return out
+
+        pages_before = scrape_pages()
+        agg.scrape_once()
+        pages_after = scrape_pages()
+        member_lint = [lint_exposition(p) for p in pages_after]
+        before = counter_sums(pages_before)
+        after = counter_sums(pages_after)
+        static_keys = sorted(
+            k for k, v in before.items() if after.get(k) == v)
+        merged_counters = agg.fleet_stats()["counters"]
+        mismatches = []
+        for fam_name, labels in static_keys:
+            want = before[(fam_name, labels)]
+            got = merged_counters.get(fam_name, {}).get(
+                labels, {}).get("value")
+            if got is None or abs(got - want) > 1e-6:
+                mismatches.append({
+                    "family": fam_name, "labels": labels,
+                    "member_sum": want, "merged": got})
+
+        merged_text = agg.merged_exposition()
+        lint_errors = lint_exposition(merged_text)
+        health = agg.health()
+        all_present = (
+            len(health) == n_members
+            and all(h["up"] and not h["stale"] for h in health))
+
+        # Release members -> they dump spans and exit.
+        for p in procs:
+            try:
+                p.stdin.write("exit\n")
+                p.stdin.flush()
+                p.stdin.close()
+            except (BrokenPipeError, OSError):
+                pass
+        for p in procs:
+            p.wait(timeout=60)
+        agg.stop()
+
+        member_spans = []
+        for path in spans_paths:
+            with open(path) as f:
+                member_spans.append(_json.load(f).get("events", []))
+
+        # One fleet timeline: per-member pid namespaces (the same merge
+        # tools/obs_export.py --merge --member performs).
+        merged_events: list = []
+        for i, evs in enumerate(member_spans):
+            merged_events.extend(to_chrome_trace(
+                evs, pid=i + 1, process_name=f"m{i}")["traceEvents"])
+        fleet_trace = {"traceEvents": merged_events,
+                       "displayTimeUnit": "ms"}
+        trace_problems = validate_chrome_trace(fleet_trace)
+
+        # Cross-process stitching: the trace_id was minted in the ingest
+        # WORKER process (FrameMeta on the shm bus), observed by the
+        # engine's spans, and echoed to the gRPC client.
+        stitched = []
+        for i, evs in enumerate(member_spans):
+            stages_by_tid: dict = {}
+            for ev in evs:
+                tid = ev.get("trace_id")
+                if tid:
+                    stages_by_tid.setdefault(tid, set()).add(ev["stage"])
+            for tid, stages in sorted(stages_by_tid.items()):
+                if ({"collect", "device", "emit"} <= stages
+                        and tid in client_tids[i]):
+                    stitched.append({
+                        "member": f"m{i}", "trace_id": tid,
+                        "stages": sorted(stages)})
+
+        return {
+            "metric": f"fleet_obs_{n_members}x_{model}",
+            "pipeline": (
+                f"{n_members}x [replay worker -> shm bus -> engine -> "
+                "gRPC/REST] -> FleetAggregator + per-member clients"),
+            "members": n_members,
+            "duration_s": duration_s,
+            "model": model,
+            "fps": fps,
+            "gates": {
+                "merged_lint_clean": not lint_errors,
+                "member_lint_clean": all(not e for e in member_lint),
+                "all_members_present": all_present,
+                "stitched_traces": len(stitched),
+                "counters_conserved": bool(static_keys) and not mismatches,
+                "fleet_trace_valid": not trace_problems,
+            },
+            "lint_errors": lint_errors[:10],
+            "counters_gated": len(static_keys),
+            "counter_mismatches": mismatches[:10],
+            "trace_problems": trace_problems[:10],
+            "health": health,
+            "stitched_example": stitched[0] if stitched else None,
+            "client_results": results_count,
+            "client_trace_ids": [len(s) for s in client_tids],
+            "merged_exposition_lines": len(merged_text.splitlines()),
+            "merged_counter_families": len(merged_counters),
+            "fleet_trace_events": len(merged_events),
+            "span_events_per_member": [len(s) for s in member_spans],
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()   # by PID via Popen handle — never pkill
+        if workdir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    _fleet_member_main()

@@ -267,4 +267,7 @@ def _frame_to_proto(device_id: str, frame) -> pb.VideoFrame:
         device_id=device_id,
         packet=meta.packet,
         keyframe=meta.keyframe_cnt,
+        # Trace-context echo (r14 fleet lineage): clients join on this id.
+        trace_id=meta.trace_id,
+        parent_span=meta.parent_span,
     )

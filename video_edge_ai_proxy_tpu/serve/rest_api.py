@@ -74,6 +74,7 @@ def build_app(
     engine=None,                      # Optional[InferenceEngine]
     annotations=None,                 # Optional[AnnotationQueue]
     portal_dir: Optional[str] = None,
+    fleet=None,                       # Optional[obs.FleetAggregator]
 ) -> web.Application:
     app = web.Application(middlewares=[_cors], client_max_size=8 << 20)
 
@@ -461,6 +462,32 @@ def build_app(
     app.router.add_post("/api/v1/profile/start", profile_start)
     app.router.add_post("/api/v1/profile/stop", profile_stop)
 
+    async def fleet_stats(_request: web.Request) -> web.Response:
+        """Fleet plane (r14 tentpole, obs/fleet.py): ranked member health
+        + merged counters/gauges/histograms across every configured
+        member. 400 when this process is not the aggregation tier
+        (obs.fleet_members config, same kill-switch convention as
+        /api/v1/slo)."""
+        if fleet is None:
+            return _error(
+                400, "fleet aggregation disabled (obs.fleet_members config)")
+        return web.json_response(await asyncio.to_thread(fleet.fleet_stats))
+
+    async def fleet_metrics(_request: web.Request) -> web.Response:
+        """One lint-clean Prometheus page for the whole fleet: member
+        samples re-grouped per family under ``instance`` labels, plus
+        the ``vep_fleet_*`` health families."""
+        if fleet is None:
+            return _error(
+                400, "fleet aggregation disabled (obs.fleet_members config)")
+        text = await asyncio.to_thread(fleet.merged_exposition)
+        return web.Response(
+            text=text, content_type="text/plain",
+            charset="utf-8", headers={"X-Prometheus-Version": "0.0.4"})
+
+    app.router.add_get("/api/v1/fleet/stats", fleet_stats)
+    app.router.add_get("/api/v1/fleet/metrics", fleet_metrics)
+
     async def options(_request: web.Request) -> web.Response:
         return web.Response(status=204)
 
@@ -484,8 +511,9 @@ class RestServer:
 
     def __init__(self, pm: ProcessManager, settings: SettingsManager,
                  host: str = "0.0.0.0", port: int = 8080,
-                 engine=None, annotations=None):
-        self._app = build_app(pm, settings, engine=engine, annotations=annotations)
+                 engine=None, annotations=None, fleet=None):
+        self._app = build_app(pm, settings, engine=engine,
+                              annotations=annotations, fleet=fleet)
         self.engine = engine
         self.pm = pm
         self._host = host

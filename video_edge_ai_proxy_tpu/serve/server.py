@@ -125,6 +125,22 @@ class Server:
             sample_every=self.cfg.obs.sample_every,
             ring=self.cfg.obs.trace_ring,
         )
+        # Fleet identity + aggregation tier (r14, obs/fleet.py). The
+        # instance label is applied at render time only — snapshot() and
+        # the hot-path sample maps stay label-free.
+        if self.cfg.obs.instance:
+            from ..obs import registry as obs_registry
+
+            obs_registry.set_const_labels(instance=self.cfg.obs.instance)
+        self.fleet = None
+        if self.cfg.obs.fleet_members:
+            from ..obs import FleetAggregator
+
+            self.fleet = FleetAggregator(
+                self.cfg.obs.fleet_members,
+                scrape_interval_s=self.cfg.obs.fleet_scrape_s,
+                stale_after_s=self.cfg.obs.fleet_stale_s or None,
+            )
         self.storage = Storage(os.path.join(data_dir, "registry.db"))
         self.bus = open_bus(
             bus_backend or self.cfg.bus.backend, self.cfg.bus.shm_dir,
@@ -281,8 +297,16 @@ class Server:
         self._rest = RestServer(
             self.process_manager, self.settings, port=self._rest_port,
             engine=self.engine, annotations=self.annotations,
+            fleet=self.fleet,
         )
         self._rest.start()
+        if self.fleet is not None:
+            self.fleet.start()
+            log.info(
+                "fleet aggregator scraping %d members every %gs "
+                "(/api/v1/fleet/stats, /api/v1/fleet/metrics)",
+                len(self.cfg.obs.fleet_members), self.fleet.scrape_interval_s,
+            )
 
         servicer = ImageServicer(
             self.bus,
@@ -326,6 +350,8 @@ class Server:
 
     def stop(self) -> None:
         log.info("shutting down")
+        if self.fleet is not None:
+            self.fleet.stop()
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=2).wait()
         if self._rest is not None:

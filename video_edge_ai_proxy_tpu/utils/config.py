@@ -291,6 +291,16 @@ class ObsConfig:
     sample_every: int = 16    # trace 1-in-N frames (deterministic, by
                               # packet id, so spans join into lineages)
     trace_ring: int = 1024    # span events buffered per stream
+    # Fleet telemetry plane (r14). instance: this member's identity; when
+    # nonempty it is rendered as a constant label on every /metrics
+    # sample (Registry.set_const_labels) so merged expositions stay
+    # attributable. fleet_members: "name=http://host:port" specs; when
+    # nonempty this process also runs a FleetAggregator and serves
+    # /api/v1/fleet/stats + /api/v1/fleet/metrics.
+    instance: str = ""
+    fleet_members: tuple = ()
+    fleet_scrape_s: float = 2.0
+    fleet_stale_s: float = 0.0   # 0 -> one scrape interval
 
 
 @dataclass
