@@ -3,7 +3,7 @@
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
-	roi-smoke fleet-obs-smoke
+	roi-smoke fleet-obs-smoke stem-smoke
 
 all: proto native
 
@@ -168,6 +168,23 @@ fleet-obs-smoke:
 		print('fleet obs: %d members, %d stitched traces, lint_clean=%s, conserved=%s' \
 			% (d['members'], g['stitched_traces'], \
 			   g['merged_lint_clean'], g['counters_conserved']))"
+
+# Detect-stem smoke (round 12): CPU tiny twin of the s2d/int8 detect
+# path. Gates (in tools/stem_smoke.py, exit non-zero on breach): fused
+# letterbox+s2d preprocess matches the two-pass reference to bf16
+# rounding, the classic->s2d stem kernel fold is lossless at the model
+# level (1e-3 px), the calibrated int8 activation path stays within its
+# committed mAP50 self-consistency tolerance, and an engine configured
+# stem="s2d" + quantize="int8_act" warms up and serves through a real
+# bus. ~30 s.
+stem-smoke:
+	python tools/stem_smoke.py | tee /tmp/vep_stem_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_stem_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); \
+		print('stem: fold maxdiff %.2g px, fused maxdiff %.2g, int8 mAP50 %.3f, %d engine frames' \
+			% (d['fold_box_maxdiff_px'], d['fused_vs_two_pass_maxdiff'], \
+			   d['int8_act_map50_vs_fp'], d['engine_frames_served']))"
 
 roi-smoke:
 	python tools/roi_smoke.py | tee /tmp/vep_roi_smoke.json

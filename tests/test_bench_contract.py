@@ -8,6 +8,7 @@ import time
 from contextlib import redirect_stdout
 
 import numpy as np
+import pytest
 
 import bench
 
@@ -115,6 +116,7 @@ class TestIntegrity:
             bench.main()
 
 
+@pytest.mark.slow
 class TestProfileMfu:
     def test_tiny_config_decomposes(self):
         """profile_mfu's prefix-timing machinery (capture_intermediates +

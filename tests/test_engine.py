@@ -963,8 +963,10 @@ class TestEngine:
         eng = InferenceEngine(bus, cfg)
         eng.start()
         try:
-            assert ("tiny_mobilenet_v2", (32, 32), 2) in eng._step_cache
-            assert ("tiny_mobilenet_v2", (64, 64), 1) in eng._step_cache
+            assert ("tiny_mobilenet_v2", "classic", (32, 32), 2) \
+                in eng._step_cache
+            assert ("tiny_mobilenet_v2", "classic", (64, 64), 1) \
+                in eng._step_cache
         finally:
             eng.stop()
 
@@ -976,8 +978,9 @@ class TestEngine:
         eng = InferenceEngine(bus, cfg)
         eng.start()   # must not raise
         try:
-            assert ("tiny_mobilenet_v2", (32, 32), 1) in eng._step_cache
-            assert not any(k[2] == 7 for k in eng._step_cache)
+            assert ("tiny_mobilenet_v2", "classic", (32, 32), 1) \
+                in eng._step_cache
+            assert not any(k[3] == 7 for k in eng._step_cache)
         finally:
             eng.stop()
 
@@ -1116,8 +1119,10 @@ class TestPrefetch:
         eng = InferenceEngine(bus, cfg)
         eng.start()
         try:
-            assert ("tiny_mobilenet_v2", (32, 32), 1) in eng._step_cache
-            assert ("tiny_yolov8", (64, 64), 1) in eng._step_cache
+            assert ("tiny_mobilenet_v2", "classic", (32, 32), 1) \
+                in eng._step_cache
+            assert ("tiny_yolov8", "classic", (64, 64), 1) \
+                in eng._step_cache
         finally:
             eng.stop()
 

@@ -528,7 +528,7 @@ def test_stem_pad_is_config_gated_not_shape_inferred():
 
     cpad = YOLOv8(yolov8n_config()).cfg                     # stem_pad_c=8
     s2d = YOLOv8(dataclasses.replace(
-        yolov8n_config(), s2d_stem=True, stem_pad_c=0)).cfg
+        yolov8n_config(), stem="s2d", stem_pad_c=0)).cfg
     assert _stem_pad_ok(cpad, (3, 3, 3, 16), (3, 3, 8, 16))
     assert not _stem_pad_ok(s2d, (3, 3, 3, 16), (3, 3, 12, 16))
     assert not _stem_pad_ok(cpad, (3, 3, 3, 16), (3, 3, 12, 16))

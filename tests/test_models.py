@@ -72,10 +72,10 @@ def test_yolo_raw_levels():
 
 
 def test_yolo_s2d_stem_same_output_contract():
-    """s2d_stem (lane-fill experiment, BASELINE.md perf levers) must keep
-    the exact output geometry of the stride-2 stem — only the stem's
-    parameterization differs."""
-    cfg = dataclasses.replace(tiny_yolov8_config(), s2d_stem=True)
+    """stem="s2d" (round-15 lane-fill lever) must keep the exact output
+    geometry of the stride-2 stem — only the stem's parameterization
+    differs (2x2 stride-1 on the folded 12-channel plane)."""
+    cfg = dataclasses.replace(tiny_yolov8_config(), stem="s2d")
     model = YOLOv8(cfg)
     x = jnp.ones((2, 64, 64, 3), jnp.bfloat16)
     params = jax.jit(model.init)(jax.random.PRNGKey(0), x)
@@ -83,9 +83,10 @@ def test_yolo_s2d_stem_same_output_contract():
     anchors = sum((64 // st) ** 2 for st in cfg.strides)
     assert boxes.shape == (2, anchors, 4)
     assert scores.shape == (2, anchors, cfg.num_classes)
-    # The stem consumes 4x the input channels (2x2 block fold).
+    # The stem consumes 4x the input channels (2x2 block fold) through a
+    # 2x2 kernel — the lossless fold layout of the classic 3x3 stem.
     stem_kernel = params["params"]["stem"]["conv"]["kernel"]
-    assert stem_kernel.shape[2] == 12
+    assert stem_kernel.shape == (2, 2, 12, stem_kernel.shape[3])
 
 
 def test_anchor_points_centers():

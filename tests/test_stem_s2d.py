@@ -1,0 +1,203 @@
+"""Round-12 detect-stem tests (CPU backend, tiny twins).
+
+The s2d stem + int8 activation work is only adoptable because of three
+claims, each pinned here: (1) the classic->s2d stem kernel fold is a
+LOSSLESS reshuffle (same detections from the same weights), (2) the
+fused letterbox+s2d preprocess matches the two-pass reference to bf16
+rounding, and (3) the default serving config (stem="classic", fp
+weights) is untouched — its replay checksum stays bit-identical to the
+committed golden.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.models import registry
+from video_edge_ai_proxy_tpu.models.import_weights import s2d_fold_kernel
+from video_edge_ai_proxy_tpu.models.quantize import calibrate_serving
+from video_edge_ai_proxy_tpu.models.yolov8 import YOLOv8
+from video_edge_ai_proxy_tpu.ops.preprocess import (
+    preprocess_letterbox, preprocess_letterbox_fused, space_to_depth,
+)
+from video_edge_ai_proxy_tpu.replay.checksum import zero_class_prior
+
+
+def _classic_and_folded():
+    """One set of weights, two models: classic tiny stem and the s2d twin
+    with the stem kernel folded (the import-path transform)."""
+    spec = registry.get("tiny_yolov8")
+    classic, variables = spec.init_params(jax.random.PRNGKey(0))
+    variables = jax.device_get(zero_class_prior(variables))
+    s2d = YOLOv8(dataclasses.replace(classic.cfg, stem="s2d"))
+    s2d_vars = jax.tree.map(lambda x: x, variables)
+    s2d_vars["params"]["stem"]["conv"]["kernel"] = s2d_fold_kernel(
+        np.asarray(variables["params"]["stem"]["conv"]["kernel"])
+        [:, :, :3, :])
+    return spec, classic, variables, s2d, s2d_vars
+
+
+class TestS2dFold:
+    def test_checkpoint_fold_is_lossless(self):
+        """Same letterboxed plane into both models (the s2d one through
+        the exact integer space_to_depth reshuffle): decoded boxes,
+        scores and argmax classes must MATCH — the fold is algebra on
+        the conv, not an approximation."""
+        spec, classic, variables, s2d, s2d_vars = _classic_and_folded()
+        rng = np.random.default_rng(5)
+        frames = rng.integers(0, 256, (2, 96, 128, 3), dtype=np.uint8)
+        plane = preprocess_letterbox(frames, spec.input_size)[0]
+        cb, cs, cc = jax.device_get(jax.jit(
+            lambda v, x: classic.apply(v, x, decode="serving"))(
+                variables, plane))
+        sb, ss, sc = jax.device_get(jax.jit(
+            lambda v, x: s2d.apply(v, x, decode="serving"))(
+                s2d_vars, space_to_depth(plane)))
+        np.testing.assert_allclose(np.asarray(cb, np.float32),
+                                   np.asarray(sb, np.float32), atol=1e-3)
+        np.testing.assert_allclose(np.asarray(cs, np.float32),
+                                   np.asarray(ss, np.float32), atol=1e-3)
+        assert (np.asarray(cc) == np.asarray(sc)).all()
+
+    def test_fold_kernel_layout(self):
+        """The fold's channel layout IS the space_to_depth layout: folded
+        conv on s2d(x) == classic conv on x, proven directly on the two
+        lax convs the models build (stride-2 3x3 explicit-pad vs
+        stride-1 2x2 asymmetric-pad)."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((1, 8, 8, 3)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((3, 3, 3, 5)), jnp.float32)
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, k.shape, ("NHWC", "HWIO", "NHWC"))
+        ref = jax.lax.conv_general_dilated(
+            x, k, (2, 2), ((1, 1), (1, 1)), dimension_numbers=dn)
+        xf = space_to_depth(x)
+        kf = jnp.asarray(s2d_fold_kernel(np.asarray(k)))
+        dnf = jax.lax.conv_dimension_numbers(
+            xf.shape, kf.shape, ("NHWC", "HWIO", "NHWC"))
+        got = jax.lax.conv_general_dilated(
+            xf, kf, (1, 1), ((1, 0), (1, 0)), dimension_numbers=dnf)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   atol=1e-5)
+
+
+class TestFusedPreprocess:
+    def test_fused_matches_two_pass(self):
+        """Single-program letterbox+normalize+s2d vs the composition of
+        the classic letterbox and the reshuffle, within bf16 rounding of
+        the folded uint8 scale."""
+        rng = np.random.default_rng(3)
+        frames = rng.integers(0, 256, (2, 270, 480, 3), dtype=np.uint8)
+        fused, p_fused = preprocess_letterbox_fused(frames, dst=64)
+        ref, p_ref = preprocess_letterbox(frames, 64)
+        two_pass = space_to_depth(ref)
+        assert fused.shape == (2, 32, 32, 12)
+        diff = np.abs(np.asarray(fused, np.float32)
+                      - np.asarray(two_pass, np.float32)).max()
+        assert diff <= 2.0 / 255.0, f"fused != two-pass: maxdiff {diff}"
+        # Same letterbox geometry record — unletterbox must keep mapping
+        # boxes back to source pixels identically.
+        np.testing.assert_allclose(np.asarray(p_fused.scale),
+                                   np.asarray(p_ref.scale))
+        np.testing.assert_allclose(np.asarray(p_fused.pad_x),
+                                   np.asarray(p_ref.pad_x))
+        np.testing.assert_allclose(np.asarray(p_fused.pad_y),
+                                   np.asarray(p_ref.pad_y))
+
+
+class TestInt8Activations:
+    @pytest.fixture(scope="class")
+    def int8_model(self):
+        spec = registry.get("tiny_yolov8")
+        classic, variables = spec.init_params(jax.random.PRNGKey(0))
+        variables = jax.device_get(zero_class_prior(variables))
+        model = YOLOv8(dataclasses.replace(classic.cfg, act_int8=True))
+        rng = np.random.default_rng(0)
+        cal = [rng.integers(0, 256, (2, 64, 64, 3), dtype=np.uint8)
+               for _ in range(2)]
+        return model, calibrate_serving(model, spec, variables, cal)
+
+    @pytest.mark.parametrize("bucket", [1, 2, 4, 8])
+    def test_shapes_and_dtypes_across_buckets(self, int8_model, bucket):
+        """The int8 path must stay static-shape clean across the engine's
+        bucket ladder: per-bucket outputs keep the fp contract (f32
+        boxes/scores, i32 classes) — quantization is internal."""
+        model, variables = int8_model
+        x = jnp.ones((bucket, 64, 64, 3), jnp.bfloat16)
+        b, s, c = jax.jit(
+            lambda v, x: model.apply(v, x, decode="serving"))(variables, x)
+        n_anchors = 84                      # 64² input -> 8²+4²+2² anchors
+        assert b.shape == (bucket, n_anchors, 4)
+        assert s.shape == (bucket, n_anchors)
+        assert c.shape == (bucket, n_anchors)
+        assert b.dtype == jnp.float32 and s.dtype == jnp.float32
+        assert c.dtype == jnp.int32
+        assert np.isfinite(np.asarray(b)).all()
+
+    def test_program_actually_computes_in_int8(self, int8_model):
+        """Guard against the path silently degrading to fp: the lowered
+        serving program must contain int8 operands (the quantized convs),
+        and the quant collection must be per-conv scalars."""
+        model, variables = int8_model
+        jaxpr = str(jax.make_jaxpr(
+            lambda v, x: model.apply(v, x, decode="serving"))(
+                variables, jnp.ones((1, 64, 64, 3), jnp.bfloat16)))
+        assert "i8[" in jaxpr, "no int8 operands in the serving program"
+        leaves = jax.tree.leaves(variables["quant"])
+        assert leaves, "calibration created no quant state"
+        assert all(np.ndim(l) == 0 for l in leaves)
+        assert all(float(l) > 0 for l in leaves), \
+            "an absmax stayed 0 — a conv never saw calibration data"
+
+    def test_calibration_is_identity_on_outputs(self, int8_model):
+        """During calibration (mutable quant collection) the model must
+        compute in fp — absmax observation cannot perturb the numbers
+        the fp model would produce."""
+        model, variables = int8_model
+        spec = registry.get("tiny_yolov8")
+        classic, fp_vars = spec.init_params(jax.random.PRNGKey(0))
+        fp_vars = jax.device_get(zero_class_prior(fp_vars))
+        x = preprocess_letterbox(
+            np.full((1, 64, 64, 3), 128, np.uint8), 64)[0]
+        ref, _, _ = classic.apply(fp_vars, x, decode="serving")
+        base = {k: v for k, v in variables.items() if k != "quant"}
+        got, _ = model.apply(base, x, decode="serving", mutable=["quant"])
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got[0]))
+
+
+class TestClassicReplayUnchanged:
+    def test_default_serving_checksum_bit_identical(self):
+        """The committed golden pins the CLASSIC program (bench.py's
+        metric, engine default stem="classic" + fp weights): rebuild that
+        exact megastep here and require the bit-identical checksum — the
+        round-12 stem work must not move the default path by one ulp."""
+        from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
+        from video_edge_ai_proxy_tpu.replay.checksum import (
+            fold_checksum, golden_lookup,
+        )
+
+        golden = golden_lookup("bench:tiny_yolov8:cpu:2x2")
+        assert golden is not None, \
+            "committed golden for the classic tiny bench program missing"
+        spec = registry.get("tiny_yolov8")
+        model, variables = spec.init_params(jax.random.PRNGKey(0))
+        assert model.cfg.stem == "classic" and not model.cfg.act_int8
+        variables = zero_class_prior(variables)
+        step = build_serving_step(model, spec)
+
+        @jax.jit
+        def megastep(base_u8):
+            def body(carry, i):
+                frames = base_u8 + i.astype(jnp.uint8)
+                return fold_checksum(carry, step(variables, frames)), None
+
+            total, _ = jax.lax.scan(
+                body, jnp.zeros((), jnp.int32), jnp.arange(2))
+            return total
+
+        rng = np.random.default_rng(0)
+        base = rng.integers(0, 256, (2, 270, 480, 3), dtype=np.uint8)
+        assert int(np.asarray(megastep(jax.device_put(base)))) == golden

@@ -148,6 +148,34 @@ def gate(current: dict, trajectory: list, tolerance: float,
     return report
 
 
+def stem_stage_info(baseline_dir: str):
+    """Newest committed MFU_yolo_*.json's stem-stage row, or None.
+
+    Round 12 informational carry-through: perf-gate logs show where the
+    detect stem stands (the 1%-MFU stage the s2d work targets) next to
+    the fps verdict, labeled with the artifact it came from. NEVER gated
+    — MFU artifacts are chip-run evidence with their own stability gate
+    (tools/profile_mfu.py --require-stable), not a CI bar.
+    """
+    paths = sorted(glob.glob(os.path.join(baseline_dir, "MFU_yolo_*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for row in art.get("stages", []) if isinstance(art, dict) else []:
+            if str(row.get("stage", "")).startswith("stem"):
+                return {
+                    "artifact": os.path.basename(path),
+                    "config": art.get("config"),
+                    "stage": row.get("stage"),
+                    "stem_ms": row.get("stage_ms"),
+                    "stage_mfu_pct": row.get("stage_mfu_pct"),
+                }
+    return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("input", nargs="?", default="-",
@@ -171,6 +199,9 @@ def main(argv=None) -> int:
     trajectory = load_trajectory(args.baseline_dir)
     report = gate(current, trajectory, args.tolerance,
                   strict_contended=args.strict_contended)
+    stem = stem_stage_info(args.baseline_dir)
+    if stem is not None:
+        report["stem_stage"] = stem          # informational, never gated
     print(json.dumps(report, indent=2))
     return 0 if report["passed"] else 1
 

@@ -79,6 +79,21 @@ CONFIGS = {
         ("neck+heads+DFL decode", "__model__"),
         ("NMS + unletterbox", "__full__"),
     ]),
+    # Round 15: the s2d-stem variant of the north star — same milestones,
+    # but the preprocess endpoint is the FUSED letterbox+normalize+s2d
+    # megakernel (one read of the 1080p plane) and the stem runs 2x2
+    # stride-1 on the 320²x12 folded plane. MFU_yolo_r05 charged 2.7 ms
+    # to preprocess (21.6%) and 7.6 ms to stem+P2 (0.9%); this config
+    # measures whether the fold recovers them.
+    "yolov8n_s2d_x16": ("yolov8n_s2d", 16, [
+        ("preprocess(fused letterbox+s2d 1080p->320^2x12)", "__preprocess__"),
+        ("stem+P2 (C12->C32, 320^2)", "c2f_2"),
+        ("P3 (C64, 80^2)", "c2f_3"),
+        ("P4 (C128, 40^2)", "c2f_4"),
+        ("P5+SPPF (C256, 20^2)", "sppf"),
+        ("neck+heads+DFL decode", "__model__"),
+        ("NMS + unletterbox", "__full__"),
+    ]),
     # CPU-backend smoke twins (tests): tiny models, the same machinery.
     "tiny_resnet_x2": ("tiny_resnet", 2, [
         ("preprocess", "__preprocess__"),
@@ -87,6 +102,12 @@ CONFIGS = {
         ("head", "__full__"),
     ]),
     "tiny_yolo_x2": ("tiny_yolov8", 2, [
+        ("preprocess", "__preprocess__"),
+        ("P3", "c2f_3"),
+        ("decode", "__model__"),
+        ("nms", "__full__"),
+    ]),
+    "tiny_yolo_s2d_x2": ("tiny_yolov8_s2d", 2, [
         ("preprocess", "__preprocess__"),
         ("P3", "c2f_3"),
         ("decode", "__model__"),
@@ -124,12 +145,18 @@ def build_prefix(spec, model, variables, milestone, batch, clip_len):
     from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
     from video_edge_ai_proxy_tpu.ops.preprocess import (
         preprocess_classify, preprocess_clip, preprocess_letterbox,
+        preprocess_letterbox_fused,
     )
 
     size = spec.input_size
     detect = spec.kind == "detect"
     serving = build_serving_step(model, spec) if detect else None
     pre = preprocess_clip if clip_len else preprocess_classify
+    # s2d-stem models serve through the fused letterbox+s2d megakernel
+    # (engine/runner.py build_serving_step makes the same dispatch) — the
+    # prefix programs must measure the program that actually serves.
+    fused = detect and getattr(
+        getattr(model, "cfg", None), "stem", "classic") == "s2d"
 
     def prefix_once(v, frames_u8):
         if detect:
@@ -142,7 +169,10 @@ def build_prefix(spec, model, variables, milestone, batch, clip_len):
                         + jnp.sum(out["scores"].astype(jnp.float32))
                         + jnp.sum(out["classes"].astype(jnp.float32))
                         + jnp.sum(out["valid"].astype(jnp.float32)))
-            x, _lb = preprocess_letterbox(frames_u8, size)
+            if fused:
+                x, _lb = preprocess_letterbox_fused(frames_u8, size)
+            else:
+                x, _lb = preprocess_letterbox(frames_u8, size)
             if milestone == "__preprocess__":
                 return jnp.sum(x.astype(jnp.float32))
             if milestone == "__model__":
@@ -199,7 +229,22 @@ def build_prefix(spec, model, variables, milestone, batch, clip_len):
     return megastep, (v_dev, base), flops, iters
 
 
-def run_config(config: str, rounds: int = 4) -> dict:
+SPREAD_STABLE = 1.3     # worst median/min across rounds below this = clean
+
+
+def _window_spread(round_ms) -> float:
+    """Honest stability signal (there is no absolute contention gate for
+    arbitrary prefixes): how far the per-round minima spread. A clean set
+    of windows keeps every prefix's median within ~20% of its min;
+    co-tenant windows show 1.5-3x."""
+    vals = [
+        float(np.median(r)) / min(r) for r in round_ms if min(r) > 0.05
+    ]
+    return max(vals) if vals else 1.0
+
+
+def run_config(config: str, rounds: int = 4,
+               max_rounds: int | None = None) -> dict:
     from video_edge_ai_proxy_tpu.models import registry
 
     model_name, batch, milestones = CONFIGS[config]
@@ -220,8 +265,9 @@ def run_config(config: str, rounds: int = 4) -> dict:
         np.asarray(fn(*args))          # compile + warm
         built.append((label, fn, args, flops, iters))
     round_ms = [[] for _ in built]
-    for r in range(rounds):
-        print(f"  measuring (round {r + 1}/{rounds}) ...", flush=True)
+
+    def one_round(idx: int, total: int) -> None:
+        print(f"  measuring (round {idx + 1}/{total}) ...", flush=True)
         for bi, (label, fn, args, flops, iters) in enumerate(built):
             # Best-of-3 inside timed_best; no absolute good_ms gate is
             # possible here (prefix costs span 100x), so window stability
@@ -230,16 +276,27 @@ def run_config(config: str, rounds: int = 4) -> dict:
                 lambda fn=fn, args=args: fn(*args), iters, backend, 1e9,
                 time.monotonic() + 60.0)
             round_ms[bi].append(elapsed / iters * 1e3)
+
+    for r in range(rounds):
+        one_round(r, rounds)
+    # Contention/stability gate (round 15): MFU_yolo_r05 shipped with
+    # windows_stable=false / spread 1.504, making its re-measured stage
+    # deltas untrustworthy. Instead of recording a bad artifact, keep
+    # adding round-robin rounds (each round gives every prefix another
+    # chance at a clean window, tightening median/min) until the spread
+    # settles or the round budget runs out; --require-stable turns a
+    # still-unstable result into a nonzero exit.
+    max_rounds = max_rounds if max_rounds is not None else rounds * 3
+    spread = _window_spread(round_ms)
+    done = rounds
+    while spread >= SPREAD_STABLE and done < max_rounds:
+        print(f"  window spread {spread:.3f} >= {SPREAD_STABLE}; "
+              "adding a round ...", flush=True)
+        one_round(done, max_rounds)
+        done += 1
+        spread = _window_spread(round_ms)
     best_ms = [min(r) for r in round_ms]
-    # Honest stability signal (there is no absolute contention gate for
-    # arbitrary prefixes): how far the per-round minima spread. A clean
-    # set of windows keeps every prefix's median within ~20% of its min;
-    # co-tenant windows show 1.5-3x.
-    spread = max(
-        (float(np.median(r)) / m) for r, m in zip(round_ms, best_ms)
-        if m > 0.05
-    )
-    windows_stable = spread < 1.3
+    windows_stable = spread < SPREAD_STABLE
     # A prefix is a superset of every earlier one, so its true time is
     # monotone non-decreasing; enforce that (cumulative max) so residual
     # window noise cannot produce negative stage costs.
@@ -275,15 +332,23 @@ def run_config(config: str, rounds: int = 4) -> dict:
         "total_ms": round(total_ms, 3),
         "total_gflop": round(total_gf, 2),
         "total_mfu_pct": round(100 * total_gf / total_ms / PEAK_TFLOPS, 1),
-        "rounds": rounds,
+        "rounds": done,
         "window_spread": round(float(spread), 3),
         "windows_stable": bool(windows_stable),
+        "stability_gate": {
+            "threshold": SPREAD_STABLE,
+            "base_rounds": rounds,
+            "rounds_run": done,
+            "max_rounds": max_rounds,
+            "extra_rounds": done - rounds,
+        },
         "note": "prefix timing via capture_intermediates + XLA DCE; "
                 "stage = difference of adjacent prefixes; FLOPs from each "
                 "compiled prefix's cost analysis (internally consistent); "
                 "window_spread = worst median/min across measurement "
                 "rounds (no absolute contention gate exists for "
-                "arbitrary prefixes)",
+                "arbitrary prefixes); unstable windows retry with extra "
+                "round-robin rounds up to max_rounds before recording",
     }
 
 
@@ -295,13 +360,27 @@ def main(argv=None) -> int:
                     help="measurement rounds per prefix (more rounds let "
                          "the per-prefix minimum converge through choppy "
                          "co-tenant windows)")
+    ap.add_argument("--max-rounds", type=int, default=None,
+                    help="stability-gate round budget (default rounds*3): "
+                         "rounds keep adding while window_spread >= "
+                         f"{SPREAD_STABLE}")
+    ap.add_argument("--require-stable", action="store_true",
+                    help="exit nonzero when windows are still unstable "
+                         "after max-rounds (the artifact is written "
+                         "either way, stamped windows_stable=false)")
     args = ap.parse_args(argv)
-    out = run_config(args.config, rounds=args.rounds)
+    out = run_config(args.config, rounds=args.rounds,
+                     max_rounds=args.max_rounds)
     print(json.dumps(out))
     if args.record:
         with open(args.record, "w") as f:
             json.dump(out, f, indent=2)
             f.write("\n")
+    if args.require_stable and not out["windows_stable"]:
+        print(f"window spread {out['window_spread']} >= {SPREAD_STABLE} "
+              f"after {out['rounds']} rounds: stage deltas untrustworthy",
+              file=sys.stderr)
+        return 2
     return 0
 
 

@@ -1,0 +1,221 @@
+"""Detect-stem smoke: s2d fold parity + fused preprocess + int8 path.
+
+CPU-backend twin (tiny_yolov8 at 64 px) of the round-12 detect-stem
+work, runnable on any host in ~30 s; wired as ``make stem-smoke``. Four
+legs, each a hard gate (exit non-zero on breach):
+
+1. **fused preprocess parity** — ``preprocess_letterbox_fused`` (single
+   XLA program: resize + pad + normalize + space-to-depth) must match the
+   two-pass reference (``preprocess_letterbox`` then ``space_to_depth``)
+   to bf16 rounding on deterministic 1080p-shaped uint8 frames.
+2. **lossless fold parity** — a classic stride-2 3x3 stem model and the
+   same weights with the stem kernel reshuffled by
+   ``import_weights.s2d_fold_kernel`` onto the s2d plane must produce the
+   SAME detections (boxes/scores/classes/valid) through the exact
+   serving program. This is the claim that makes ``stem="s2d"``
+   adoptable without retraining.
+3. **int8 activation proximity** — the calibrated ``act_int8`` serving
+   path (absmax calibration -> int8 x int8 convs in-graph) must stay
+   within a committed mAP50 self-consistency tolerance of the fp model.
+4. **engine plumbing** — an ``InferenceEngine`` configured with
+   ``stem="s2d", quantize="int8_act"`` must warm up (variant clone +
+   calibration at warmup), compile the fused-preprocess bucket, and
+   serve frames end to end through a real MemoryFrameBus.
+
+One JSON line on stdout (the gate values land in /tmp via the Makefile
+``tee``, same shape as h2d_smoke/roi_smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Committed tolerances (also stamped into the JSON line): fold parity is
+# exact algebra — gate at float-accumulation slack, not "close enough";
+# the fused preprocess differs from two-pass only by bf16 rounding of
+# the folded scale; int8 rounds activations+weights so it gates loosest.
+FOLD_BOX_TOL_PX = 1e-3
+FUSED_TOL = 2.0 / 255.0
+INT8_MAP50_TOL = 0.90
+
+
+def _detections(step, variables, frames):
+    import jax
+    import numpy as np
+
+    out = jax.device_get(jax.jit(step)(variables, frames))
+    per_image = []
+    for i in range(frames.shape[0]):
+        v = out["valid"][i].astype(bool)
+        per_image.append((np.asarray(out["boxes"][i][v]),
+                          np.asarray(out["scores"][i][v]),
+                          np.asarray(out["classes"][i][v])))
+    return per_image
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--native", action="store_true",
+                    help="use the environment's real backend instead of "
+                         "forcing CPU")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if not args.native:
+        jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+
+    import numpy as np
+
+    from video_edge_ai_proxy_tpu.engine.runner import build_serving_step
+    from video_edge_ai_proxy_tpu.models import registry
+    from video_edge_ai_proxy_tpu.models.import_weights import s2d_fold_kernel
+    from video_edge_ai_proxy_tpu.models.metrics import DetectionEvaluator
+    from video_edge_ai_proxy_tpu.models.quantize import calibrate_serving
+    from video_edge_ai_proxy_tpu.models.yolov8 import YOLOv8
+    from video_edge_ai_proxy_tpu.ops.preprocess import (
+        preprocess_letterbox, preprocess_letterbox_fused, space_to_depth,
+    )
+    from video_edge_ai_proxy_tpu.replay.checksum import zero_class_prior
+
+    rng = np.random.default_rng(5)
+    out = {"tool": "stem_smoke", "backend": backend, "model": "tiny_yolov8"}
+    failures = []
+
+    # Leg 1: fused letterbox+s2d vs the two-pass reference, 1080p-aspect
+    # source so the letterbox geometry (scale + vertical pad) is real.
+    frames_hd = rng.integers(0, 256, (2, 270, 480, 3), dtype=np.uint8)
+    fused, _ = preprocess_letterbox_fused(frames_hd, dst=64)
+    two_pass = space_to_depth(preprocess_letterbox(frames_hd, 64)[0])
+    fused_diff = float(jax.device_get(
+        abs(fused.astype("float32") - two_pass.astype("float32")).max()))
+    out["fused_vs_two_pass_maxdiff"] = fused_diff
+    out["fused_tol"] = FUSED_TOL
+    if fused_diff > FUSED_TOL:
+        failures.append(
+            f"fused preprocess diverges from two-pass: maxdiff "
+            f"{fused_diff:.6f} > {FUSED_TOL:.6f}")
+
+    # Leg 2: lossless fold, isolated at the MODEL level: both models get
+    # the identical letterboxed plane (classic preprocess; the s2d model
+    # consumes its space_to_depth — exact integer reshuffle), so any
+    # difference is the fold itself, not fused-preprocess rounding (that
+    # rounding is leg 1's, and bench_levers' looser, gate).
+    spec = registry.get("tiny_yolov8")
+    classic, variables = spec.init_params(jax.random.PRNGKey(0))
+    variables = jax.device_get(zero_class_prior(variables))
+    s2d_model = YOLOv8(dataclasses.replace(classic.cfg, stem="s2d"))
+    # tree.map rebuilds every container, so mutating the copy's nested
+    # dicts can't touch the classic tree (leaves stay shared).
+    s2d_vars = jax.tree.map(lambda x: x, variables)
+    s2d_vars["params"]["stem"]["conv"]["kernel"] = s2d_fold_kernel(
+        np.asarray(variables["params"]["stem"]["conv"]["kernel"])
+        [:, :, :3, :])
+    frames = rng.integers(0, 256, (2, 96, 128, 3), dtype=np.uint8)
+    plane = preprocess_letterbox(frames, 64)[0]
+    cb, cs, cc = jax.device_get(jax.jit(
+        lambda v, x: classic.apply(v, x, decode="serving"))(
+            variables, plane))
+    sb, ss, sc = jax.device_get(jax.jit(
+        lambda v, x: s2d_model.apply(v, x, decode="serving"))(
+            s2d_vars, space_to_depth(plane)))
+    fold_box_diff = max(float(abs(cb.astype(np.float32)
+                                  - sb.astype(np.float32)).max()),
+                        float(abs(cs.astype(np.float32)
+                                  - ss.astype(np.float32)).max()))
+    out["fold_anchors"] = int(cb.shape[1])
+    out["fold_box_maxdiff_px"] = fold_box_diff
+    out["fold_tol_px"] = FOLD_BOX_TOL_PX
+    if fold_box_diff > FOLD_BOX_TOL_PX or not (cc == sc).all():
+        failures.append(
+            f"s2d fold is NOT lossless: box/score maxdiff "
+            f"{fold_box_diff:.6f} > {FOLD_BOX_TOL_PX}, classes match="
+            f"{bool((cc == sc).all())}")
+    det_classic = _detections(build_serving_step(classic, spec),
+                              variables, frames)
+
+    # Leg 3: int8 activation path vs fp, scored as self-consistency mAP50
+    # (fp detections as ground truth) — same metric/tolerance style as
+    # tools/bench_levers.py's hard gate.
+    int8_model = YOLOv8(dataclasses.replace(classic.cfg, act_int8=True))
+    cal_rng = np.random.default_rng(0)
+    int8_vars = calibrate_serving(
+        int8_model, spec, variables,
+        [cal_rng.integers(0, 256, (2, 64, 64, 3), dtype=np.uint8)
+         for _ in range(2)])
+    det_int8 = _detections(build_serving_step(int8_model, spec),
+                           int8_vars, frames)
+    ev = DetectionEvaluator()
+    for (gb, _, gc), (pb, ps, pc) in zip(det_classic, det_int8):
+        ev.add_image(pb, ps, pc, gb, gc)
+    int8_map50 = ev.summarize()["mAP50"]
+    out["int8_act_map50_vs_fp"] = round(int8_map50, 4)
+    out["int8_act_tol"] = INT8_MAP50_TOL
+    if int8_map50 < INT8_MAP50_TOL:
+        failures.append(
+            f"int8_act drifted: mAP50 {int8_map50:.4f} < {INT8_MAP50_TOL}")
+
+    # Leg 4: engine plumbing — warmup clones the variant (stem=s2d),
+    # calibrates at warmup (quantize=int8_act), prewarms the fused bucket,
+    # serves through a real bus.
+    from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+    from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+    from video_edge_ai_proxy_tpu.engine import InferenceEngine
+    from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+    from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+    h, w = 96, 128
+    bus = MemoryFrameBus()
+    try:
+        eng = InferenceEngine(
+            bus,
+            EngineConfig(model="tiny_yolov8", stem="s2d",
+                         quantize="int8_act", batch_buckets=(1, 2),
+                         tick_ms=5, prof=False),
+            annotations=AnnotationQueue(handler=lambda batch: True),
+        )
+        eng.warmup()
+        eng.compile_for((h, w), 1)
+        bus.create_stream("cam0", h * w * 3)
+        frame = np.ascontiguousarray(frames[0])
+        eng.start()
+        try:
+            deadline = time.monotonic() + 20.0
+            served = 0
+            while time.monotonic() < deadline:
+                meta = FrameMeta(width=w, height=h, channels=3,
+                                 timestamp_ms=int(time.time() * 1000),
+                                 is_keyframe=True)
+                bus.publish("cam0", frame, meta)
+                snap = eng.perf.snapshot()
+                served = sum(b["frames"] for b in snap["buckets"])
+                if served >= 3:
+                    break
+                time.sleep(0.02)
+        finally:
+            eng.stop()
+    finally:
+        bus.close()
+    out["engine_frames_served"] = int(served)
+    if served < 3:
+        failures.append(
+            f"engine s2d+int8_act leg served only {served} frames "
+            "(need >= 3)")
+
+    out["failures"] = failures
+    print(json.dumps(out), flush=True)
+    if failures:
+        raise SystemExit("stem_smoke FAILED: " + "; ".join(failures))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -47,7 +47,7 @@ from ..obs.watch import Watchdog
 from ..ops.nms import batched_nms
 from ..ops.preprocess import (
     frame_quality_stats, preprocess_classify, preprocess_clip,
-    preprocess_letterbox, unletterbox_boxes,
+    preprocess_letterbox, preprocess_letterbox_fused, unletterbox_boxes,
 )
 from ..proto import pb
 from ..resilience.ladder import RUNGS, DegradationLadder
@@ -98,8 +98,18 @@ def build_serving_step(model, spec, *, quality_thumb: int = 0):
     size = spec.input_size
 
     if spec.kind == "detect":
+        # Stem-variant dispatch (round 15): an s2d-stem model gets the
+        # fused letterbox+normalize+s2d megakernel — the 1080p uint8
+        # plane is read exactly once and the stem consumes the folded
+        # 320²x12 plane directly. The classic path below stays
+        # byte-identical (replay checksums pin it bit-for-bit).
+        fused = getattr(getattr(model, "cfg", None), "stem", "classic") == "s2d"
+
         def raw(variables, frames_u8):
-            x, lb = preprocess_letterbox(frames_u8, size)
+            if fused:
+                x, lb = preprocess_letterbox_fused(frames_u8, size)
+            else:
+                x, lb = preprocess_letterbox(frames_u8, size)
             # decode="serving" (models/yolov8.py): class reduction happens
             # in logit space inside the model; sigmoid is monotone, so
             # applying it to the per-anchor winners here gives the same
@@ -937,6 +947,10 @@ class InferenceEngine:
                 )
         if self._spec is None:
             self._spec = registry.get(self._cfg.model)
+        # Detect-family variant axes (round 15): cfg.stem / int8_act
+        # rewrite the spec's build BEFORE init so the whole lifecycle
+        # (checkpoint templates, prewarm, serving steps) sees one model.
+        self._spec = self._variant_spec(self._spec)
         self._model, self._variables = self._spec.init_params(
             jax.random.PRNGKey(0)
         )
@@ -981,6 +995,9 @@ class InferenceEngine:
                     )
             else:
                 log.warning("checkpoint %s missing; using random init", ckpt)
+        self._variables = self._maybe_calibrate(
+            self._spec, self._model, self._variables
+        )
         self._variables = self._maybe_quantize(self._variables)
         buckets = tuple(self._cfg.batch_buckets)
         if self._cfg.mesh:
@@ -1053,23 +1070,102 @@ class InferenceEngine:
             jax.default_backend(),
         )
 
+    def _variant_spec(self, spec):
+        """Apply the engine's detect-family variant axes — ``cfg.stem``
+        ("s2d": space-to-depth stem + fused preprocess) and
+        ``cfg.quantize="int8_act"`` (int8 activation convs) — by rewriting
+        the spec's build to clone the model with the overridden config.
+        Classic/fp configs pass through untouched (the spec object is the
+        SAME one, so replay checksums and step-cache identity are
+        unchanged). Models whose config lacks the fields (e.g. the
+        BlobGauge diagnostic) serve unmodified with a warning."""
+        if spec.kind != "detect":
+            return spec
+        import dataclasses
+
+        stem = getattr(self._cfg, "stem", "classic") or "classic"
+        if stem not in ("classic", "s2d"):
+            raise ValueError(
+                f"engine.stem={stem!r} unsupported ('classic' or 's2d')"
+            )
+        overrides = {}
+        if stem != "classic":
+            overrides["stem"] = stem
+        if self._cfg.quantize == "int8_act":
+            overrides["act_int8"] = True
+        if not overrides:
+            return spec
+        cfg = getattr(spec.build(), "cfg", None)
+        try:
+            fields = {f.name for f in dataclasses.fields(cfg)}
+        except TypeError:
+            fields = set()
+        missing = sorted(set(overrides) - fields)
+        if missing:
+            log.warning(
+                "model '%s' config has no %s field(s); serving the stock "
+                "variant", spec.name, "/".join(missing),
+            )
+            return spec
+
+        def build(_base=spec.build, _ov=dict(overrides)):
+            m = _base()
+            return m.clone(cfg=dataclasses.replace(m.cfg, **_ov))
+
+        return dataclasses.replace(spec, build=build)
+
+    def _maybe_calibrate(self, spec, model, variables):
+        """cfg.quantize="int8_act": one-shot activation-range calibration
+        (models/quantize.py calibrate_serving) over deterministic synthetic
+        frames at engine boot. The pass runs the FP forward — it only
+        observes per-conv max-abs input ranges into the "quant" collection
+        the int8 serving graph then consumes. Deployments wanting
+        data-matched ranges re-calibrate offline (tools/bench_levers.py
+        calibrates on its own frame set and accuracy-gates the result)."""
+        if self._cfg.quantize != "int8_act":
+            return variables
+        if spec.kind != "detect" or not getattr(
+            getattr(model, "cfg", None), "act_int8", False
+        ):
+            return variables
+        from ..models.quantize import calibrate_serving
+
+        rng = np.random.default_rng(0)
+        s = spec.input_size
+        batches = [
+            rng.integers(0, 256, (2, s, s, 3), np.uint8) for _ in range(2)
+        ]
+        variables = calibrate_serving(model, spec, dict(variables), batches)
+        log.info(
+            "engine activations calibrated for int8 serving "
+            "(%d synthetic batches at %d²)", len(batches), s,
+        )
+        return variables
+
     def _maybe_quantize(self, variables):
         """cfg.quantize="int8": weight-only PTQ (models/quantize.py) — int8
         device/checkpoint residency, dequantize fused into the jitted step.
-        No calibration data needed, so it is safe at engine boot."""
+        No calibration data needed, so it is safe at engine boot.
+        cfg.quantize="int8_act" keeps the same int8 weight residency and
+        additionally runs calibrated int8 activation convs (the model was
+        built with act_int8=True by _variant_spec; calibration happened in
+        _maybe_calibrate)."""
         if not self._cfg.quantize:
             return variables
-        if self._cfg.quantize != "int8":
+        if self._cfg.quantize not in ("int8", "int8_act"):
             raise ValueError(
                 f"engine.quantize={self._cfg.quantize!r} unsupported "
-                "(only 'int8' weight-only quantization exists)"
+                "(only 'int8' weight-only and 'int8_act' calibrated "
+                "activation quantization exist)"
             )
         from ..models.quantize import quantize_tree, quantized_nbytes, tree_nbytes
 
         before = tree_nbytes(variables)
         qt = quantize_tree(variables)
         log.info(
-            "engine params quantized int8 (weight-only): %.1f MB -> %.1f MB",
+            "engine params quantized int8 (%s): %.1f MB -> %.1f MB",
+            "weight-only" if self._cfg.quantize == "int8" else
+            "weights + calibrated activations",
             before / 1e6, quantized_nbytes(qt) / 1e6,
         )
         return qt
@@ -1128,8 +1224,9 @@ class InferenceEngine:
 
             from ..models import registry
 
-            spec = registry.get(name)
+            spec = self._variant_spec(registry.get(name))
             model, variables = spec.init_params(jax.random.PRNGKey(0))
+            variables = self._maybe_calibrate(spec, model, variables)
             variables = self._maybe_quantize(variables)
             if self._mesh is not None:
                 variables = self._place_variables(variables)
@@ -1265,11 +1362,17 @@ class InferenceEngine:
             # prewarm entry must not abort server boot, and buckets must be
             # ones the collector can actually dispatch (post mesh filter).
             try:
-                # [h, w, bucket] or [h, w, bucket, model]: the optional
-                # 4th element prewarms a non-default model's program.
+                # [h, w, bucket], [h, w, bucket, model] or
+                # [h, w, bucket, model, stem]: the optional 4th element
+                # prewarms a non-default model's program; the optional 5th
+                # pins the stem variant the entry was written for (config
+                # files survive engine.stem flips — a mismatched entry is
+                # skipped below instead of compiling a program the engine
+                # can never serve, its params being the other variant's).
                 model = None
-                if len(geom) == 4:
+                if len(geom) >= 4:
                     model = str(geom[3])
+                stem = str(geom[4]) if len(geom) >= 5 else None
                 h, w, bucket = (int(v) for v in geom[:3])
                 if bucket not in self._buckets:
                     log.warning(
@@ -1279,7 +1382,7 @@ class InferenceEngine:
                     continue
                 log.info("prewarming program for %dx%d bucket=%d model=%s",
                          h, w, bucket, model or self._spec.name)
-                self.compile_for((h, w), bucket, model)
+                self.compile_for((h, w), bucket, model, stem=stem)
             except Exception:
                 log.exception("prewarm entry %r failed; continuing", geom)
         if self._xfer is not None:
@@ -1599,13 +1702,28 @@ class InferenceEngine:
     # -- compiled step construction --
 
     def compile_for(self, src_hw: tuple, bucket: int,
-                    model: Optional[str] = None) -> None:
+                    model: Optional[str] = None, *,
+                    stem: Optional[str] = None) -> None:
         """Prewarm the program for one (source geometry, bucket) — of
         the default model, or of any registry model a stream resolves to
         (``model``; 4-element cfg.prewarm entries). Multi-family fleets
         otherwise pay each extra model's compile stall on its first
         mid-soak frame (the stall r11's harness worked around by
-        prewarming downshift buckets for the default model only)."""
+        prewarming downshift buckets for the default model only).
+
+        ``stem`` pins the stem variant a prewarm entry expects
+        (5-element cfg.prewarm entries): the engine's stem is a warmup
+        decision — params are folded/initialized for exactly one
+        variant — so an entry written for the OTHER variant is skipped
+        with a warning rather than compiled into an unservable program."""
+        effective = getattr(self._cfg, "stem", "classic") or "classic"
+        if stem is not None and stem != effective:
+            log.warning(
+                "prewarm entry pinned stem=%r but engine serves stem=%r; "
+                "skipping %sx%s bucket=%d",
+                stem, effective, src_hw[0], src_hw[1], bucket,
+            )
+            return
         spec, _, variables = self._ensure_model(model or self._spec.name)
         shape = (bucket,) + (
             (spec.clip_len,) if spec.clip_len else ()
@@ -1644,7 +1762,12 @@ class InferenceEngine:
 
     def _step(self, src_hw: tuple, bucket: int, model: Optional[str] = None):
         model = model or self._spec.name
-        key = (model, src_hw, bucket)
+        # The key carries the stem-variant axis (round 15): cfg.stem picks
+        # a different compiled program (fused vs classic preprocess, 2x2
+        # vs 3x3 stem) for the SAME model name — recording it keys every
+        # cached program by what it actually computes, so introspection
+        # and any future runtime stem flip can never alias the variants.
+        key = (model, getattr(self._cfg, "stem", "classic"), src_hw, bucket)
         fn = self._step_cache.get(key)
         if fn is not None:
             self._m_cache_hit.inc()

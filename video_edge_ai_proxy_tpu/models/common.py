@@ -32,12 +32,89 @@ ACT: dict[str, Callable] = {
 }
 
 
+class _Int8Conv(nn.Module):
+    """int8 x int8 conv with per-output-channel weight scales (round 15).
+
+    Drop-in replacement for the ``nn.Conv(name="conv")`` inside ConvBN:
+    declares the SAME ``kernel`` param (same shape, same f32 param dtype,
+    same init), so checkpoint trees move between the fp and int8-act
+    variants untouched. Two extra pieces of state/behavior:
+
+    - ``quant/in_absmax`` — a scalar f32 running max-abs of the input
+      activation, written only while the "quant" collection is mutable
+      (the calibration pass, models/quantize.py calibrate_serving). The
+      calibration pass itself computes in the fp dtype, so its outputs
+      match the fp model exactly.
+    - serving (quant frozen): the input quantizes against the calibrated
+      static per-tensor scale, the kernel quantizes in-graph against its
+      per-output-channel max-abs (both absmax/127, the symmetric PTQ rule
+      models/quantize.py already uses for residency), and the conv runs
+      int8 x int8 with ``preferred_element_type=int32`` — the MXU's
+      native int8 systolic mode, 2x the bf16 MAC rate on v5e. Dequantize
+      is one fused multiply by ``s_in * s_w[oc]`` feeding the f32 BN.
+    """
+
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    pad: Any = None
+    groups: int = 1
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from jax import lax
+
+        k = self.kernel
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (k, k, x.shape[-1] // self.groups, self.features),
+            jnp.float32,
+        )
+        absmax = self.variable(
+            "quant", "in_absmax", lambda: jnp.zeros((), jnp.float32)
+        )
+        dn = ("NHWC", "HWIO", "NHWC")
+        strides = (self.stride, self.stride)
+        if self.is_mutable_collection("quant"):
+            # Calibration (and init): observe the input range, run fp.
+            absmax.value = jnp.maximum(
+                absmax.value, jnp.max(jnp.abs(x.astype(jnp.float32)))
+            )
+            return lax.conv_general_dilated(
+                x.astype(self.dtype), kernel.astype(self.dtype), strides,
+                self.pad, dimension_numbers=dn,
+                feature_group_count=self.groups,
+            )
+        s_in = jnp.maximum(absmax.value, 1e-8) * (1.0 / 127.0)
+        xq = jnp.clip(
+            jnp.round(x.astype(jnp.float32) / s_in), -127, 127
+        ).astype(jnp.int8)
+        s_w = jnp.maximum(
+            jnp.max(jnp.abs(kernel), axis=(0, 1, 2)), 1e-12
+        ) * (1.0 / 127.0)
+        wq = jnp.clip(jnp.round(kernel / s_w), -127, 127).astype(jnp.int8)
+        y = lax.conv_general_dilated(
+            xq, wq, strides, self.pad, dimension_numbers=dn,
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.int32,
+        )
+        return (y.astype(jnp.float32) * (s_in * s_w)).astype(self.dtype)
+
+
 class ConvBN(nn.Module):
     """Conv → BatchNorm → activation, the convnet workhorse.
 
     BatchNorm keeps fp32 statistics regardless of compute dtype; `train`
     toggles running-average use so the same module serves the inference
     plane (frozen stats) and fine-tuning (mutable `batch_stats`).
+
+    ``padding`` overrides the symmetric k//2 default (the s2d stem needs
+    asymmetric ((1,0),(1,0))); ``act_int8`` swaps the conv for the int8
+    activation path above (serving-only — the param tree is identical, so
+    fp checkpoints serve either way; fine-tuning through the int8 conv is
+    unsupported).
     """
 
     features: int
@@ -51,25 +128,41 @@ class ConvBN(nn.Module):
     # variance is small, so imported weights would drift layer by layer.
     epsilon: float = 1e-3
     dtype: Dtype = jnp.bfloat16
+    padding: Any = None
+    act_int8: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         k = self.kernel
-        x = nn.Conv(
-            self.features,
-            kernel_size=(k, k),
-            strides=(self.stride, self.stride),
-            # Explicit symmetric k//2 padding, NOT "SAME": identical for
-            # stride 1, but at stride 2 on even inputs XLA's SAME pads
-            # (0, 1) while every torch-trained checkpoint saw (1, 1) —
-            # same output shape, different pixels sampled, so imported
-            # weights would see shifted borders at all 5 down-samplings.
-            padding=((k // 2, k // 2), (k // 2, k // 2)),
-            feature_group_count=self.groups,
-            use_bias=False,
-            dtype=self.dtype,
-            name="conv",
-        )(x)
+        # Explicit symmetric k//2 padding, NOT "SAME": identical for
+        # stride 1, but at stride 2 on even inputs XLA's SAME pads
+        # (0, 1) while every torch-trained checkpoint saw (1, 1) —
+        # same output shape, different pixels sampled, so imported
+        # weights would see shifted borders at all 5 down-samplings.
+        pad = self.padding
+        if pad is None:
+            pad = ((k // 2, k // 2), (k // 2, k // 2))
+        if self.act_int8:
+            if train:
+                raise NotImplementedError(
+                    "act_int8 is a serving-path quantization; fine-tune "
+                    "the fp variant and re-calibrate"
+                )
+            x = _Int8Conv(
+                self.features, kernel=k, stride=self.stride, pad=pad,
+                groups=self.groups, dtype=self.dtype, name="conv",
+            )(x)
+        else:
+            x = nn.Conv(
+                self.features,
+                kernel_size=(k, k),
+                strides=(self.stride, self.stride),
+                padding=pad,
+                feature_group_count=self.groups,
+                use_bias=False,
+                dtype=self.dtype,
+                name="conv",
+            )(x)
         x = nn.BatchNorm(
             use_running_average=not train,
             momentum=0.97,

@@ -37,7 +37,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["convert", "load_state_dict", "SUPPORTED"]
+__all__ = ["convert", "load_state_dict", "s2d_fold_kernel", "SUPPORTED"]
 
 # source-key suffix -> (our leaf name, collection)
 _BN_LEAF = {
@@ -96,13 +96,60 @@ def _stem_pad_ok(model_cfg, have: tuple, want: tuple,
     real pixels) and the shapes differ solely by the missing padded
     input channels."""
     pad_c = getattr(model_cfg, attr, 0)
-    if not pad_c or getattr(model_cfg, "s2d_stem", False):
+    if not pad_c or getattr(model_cfg, "stem", "classic") != "classic":
         return False
     return (
         len(have) == len(want) > axis
         and have[:axis] == want[:axis]
         and have[axis + 1:] == want[axis + 1:]
         and have[axis] < want[axis] == pad_c
+    )
+
+
+def s2d_fold_kernel(k: np.ndarray) -> np.ndarray:
+    """Losslessly re-express a stride-2 3x3 stem kernel ``[3, 3, ci, co]``
+    as the stride-1 2x2 kernel ``[2, 2, 4*ci, co]`` computing the SAME
+    function on the space-to-depth plane (round 15 detect-stem lever).
+
+    Derivation: classic output pixel p reads input rows ``2p-1+di`` for
+    tap ``di in {0,1,2}`` (explicit (1,1) top padding). The s2d plane
+    stores input row ``2r+a`` at s2d row r, block-offset a; so row
+    ``2p-1+di`` lives at ``(r, a) = (p-1, 1)`` for di=0, ``(p, di-1)``
+    otherwise. A 2x2 stride-1 conv with ((1,0),(1,0)) padding reads s2d
+    rows ``p-1+u``, hence tap di lands at ``(u, a) = (0, 1)`` if di==0
+    else ``(1, di-1)`` — same for columns. Channel slot ``(2a+b)*ci + c``
+    matches ops/preprocess.space_to_depth's block flattening. The 2x2x4ci
+    kernel has 16ci/9ci taps; the (u=0, a=0) and (v=0, b=0) slots are
+    never read by the classic function and stay zero. Exact up to float
+    summation order (same products, regrouped) — bf16-tolerance parity,
+    verified by tests/test_stem_s2d.py."""
+    kh, kw, ci, co = np.shape(k)
+    if (kh, kw) != (3, 3):
+        raise ValueError(f"s2d fold expects a 3x3 kernel, got {np.shape(k)}")
+    k = np.asarray(k)
+    out = np.zeros((2, 2, 4 * ci, co), k.dtype)
+    for di in range(3):
+        u, a = (0, 1) if di == 0 else (1, di - 1)
+        for dj in range(3):
+            v, b = (0, 1) if dj == 0 else (1, dj - 1)
+            s = (2 * a + b) * ci
+            out[u, v, s:s + ci] = k[di, dj]
+    return out
+
+
+def _s2d_fold_ok(model_cfg, have: tuple, want: tuple) -> bool:
+    """Does ``have`` (a classic 3x3 stem kernel, possibly cpad-grown) fold
+    into ``want`` (the target s2d 2x2 stem kernel) for this config? The
+    target input depth is 4x the true channel count; a cpad-padded source
+    (zero-input planes beyond channel want[2]//4) slices down losslessly
+    first."""
+    if getattr(model_cfg, "stem", "classic") != "s2d":
+        return False
+    return (
+        len(have) == len(want) == 4
+        and have[:2] == (3, 3) and want[:2] == (2, 2)
+        and have[3] == want[3]
+        and want[2] % 4 == 0 and have[2] >= want[2] // 4
     )
 
 
@@ -135,7 +182,21 @@ def pad_stem_on_load(raw, template, model) -> dict:
         except (KeyError, TypeError):
             continue
         have = np.shape(kern)
-        if have == want or not _stem_pad_ok(cfg, have, want, attr, axis):
+        if have == want:
+            continue
+        if path[0] == "stem" and _s2d_fold_ok(cfg, have, want):
+            # Classic checkpoint serving the s2d stem: slice off any cpad
+            # zero-input planes, then fold 3x3/stride-2 -> 2x2/stride-1.
+            node[path[-1]] = s2d_fold_kernel(
+                np.asarray(kern)[:, :, : want[2] // 4, :]
+            )
+            from ..utils.logging import get_logger
+
+            get_logger("models.import").info(
+                "checkpoint stem kernel s2d-folded %s -> %s", have, want,
+            )
+            continue
+        if not _stem_pad_ok(cfg, have, want, attr, axis):
             continue
         widths = [(0, 0)] * len(want)
         widths[axis] = (0, want[axis] - have[axis])
@@ -295,6 +356,7 @@ def _ln(leaf: str) -> str:
 
 _FAMILIES: Dict[str, Callable] = {
     "yolov8n": _yolo_key, "yolov8s": _yolo_key, "tiny_yolov8": _yolo_key,
+    "yolov8n_s2d": _yolo_key, "tiny_yolov8_s2d": _yolo_key,
     "resnet50": _resnet_key, "tiny_resnet": _resnet_key,
     "vit_b16": _vit_key, "tiny_vit": _vit_key,
 }
@@ -351,6 +413,13 @@ def convert(model_name: str, state: Dict[str, np.ndarray]):
             val = transform(val)
         tgt = np.shape(target)
         if (full_path[-3:] == ("stem", "conv", "kernel")
+                and _s2d_fold_ok(model_cfg, np.shape(val), tgt)):
+            # s2d stem target: the stock 3x3 stride-2 stem kernel folds
+            # losslessly into the 2x2 stride-1 layout (see
+            # s2d_fold_kernel) — detection outputs stay numerically
+            # equivalent, no retraining.
+            val = s2d_fold_kernel(np.asarray(val)[:, :, : tgt[2] // 4, :])
+        elif (full_path[-3:] == ("stem", "conv", "kernel")
                 and _stem_pad_ok(model_cfg, np.shape(val), tgt)):
             # Channel-padded stem (YOLOv8Config.stem_pad_c): the model
             # zero-pads its INPUT planes beyond the source's 3 channels,

@@ -12,10 +12,17 @@ weight-only, symmetric int8:
 - at serving time the weights are dequantized *inside* the jitted program
   (`int8 * scale -> bf16`), so HBM holds int8 (4x smaller than f32
   checkpoints, 2x smaller than bf16 residency) and XLA fuses the
-  dequantize into each consumer. Compute stays bf16 on the MXU —
-  activation quantization (int8 matmuls) is deliberately out of scope:
-  weight-only is accuracy-safe without calibration data, which an edge
-  deployment rarely has.
+  dequantize into each consumer. Compute stays bf16 on the MXU.
+
+Round 15 adds an OPT-IN activation path for the detect family
+(``engine.quantize: int8_act``): the model's convs run int8 x int8 on
+the MXU's native int8 systolic mode (models/common.py ``_Int8Conv``),
+which needs per-tensor input scales observed by a calibration pass —
+:func:`calibrate_serving` below runs representative frames through the
+model with the "quant" collection mutable and freezes the observed
+max-abs ranges. Weight-only ``int8`` stays the calibration-free default
+recommendation; ``int8_act`` is gated by the accuracy tolerance committed
+in ``tools/bench_levers.py``.
 
 `engine/runner.py` enables this via ``engine.quantize: int8`` in the
 config. On-disk checkpoints deliberately stay full precision — the
@@ -103,3 +110,51 @@ def quantized_nbytes(qt: QuantizedTree) -> int:
 
 def tree_nbytes(tree: Any) -> int:
     return sum(np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(tree))
+
+
+def calibrate_serving(model, spec, variables: Any, frame_batches) -> Any:
+    """Calibrate the int8 activation path: observe per-conv input ranges.
+
+    Runs each uint8 frame batch (``[B, H, W, 3]``, raw camera geometry)
+    through the model's own serving preprocess + forward with the "quant"
+    collection mutable, so every ``_Int8Conv`` (models/common.py) records
+    the running max-abs of its input. The calibration forward computes in
+    the fp dtype — outputs are the fp model's exactly — only the observed
+    ranges are new. Returns ``variables`` with the frozen "quant"
+    collection merged in, ready for the int8 serving graph.
+
+    Detect-family only: the calibrated model must have been built with
+    ``act_int8=True`` (otherwise there is nothing to observe and the
+    returned tree simply gains an empty collection).
+    """
+    from ..ops.preprocess import preprocess_letterbox
+
+    if spec.kind != "detect":
+        raise ValueError(
+            f"int8 activation calibration is detect-family only; "
+            f"{spec.name!r} is kind={spec.kind!r}"
+        )
+    base = {k: v for k, v in variables.items() if k != "quant"}
+
+    @jax.jit
+    def _create(frames):
+        x, _ = preprocess_letterbox(frames, spec.input_size)
+        _, muts = model.apply(base, x, decode="serving", mutable=["quant"])
+        return muts["quant"]
+
+    @jax.jit
+    def _observe(quant, frames):
+        x, _ = preprocess_letterbox(frames, spec.input_size)
+        _, muts = model.apply(
+            {**base, "quant": quant}, x, decode="serving", mutable=["quant"]
+        )
+        return muts["quant"]
+
+    it = iter(frame_batches)
+    try:
+        quant = _create(next(it))
+    except StopIteration:
+        raise ValueError("calibration needs at least one frame batch")
+    for frames in it:
+        quant = _observe(quant, frames)
+    return {**base, "quant": quant}

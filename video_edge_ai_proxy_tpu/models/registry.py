@@ -83,12 +83,14 @@ register(ModelSpec(
 ))
 register(ModelSpec(
     "yolov8n_s2d", lambda: YOLOv8(
-        dataclasses.replace(yolov8n_config(), s2d_stem=True)
+        dataclasses.replace(yolov8n_config(), stem="s2d")
     ),
     input_size=640, preprocess="letterbox", kind="detect",
-    description="north-star variant: space-to-depth stem (lane-fill "
-                "experiment, BASELINE.md perf notes; checkpoints do not "
-                "transfer from yolov8n)",
+    description="north-star variant: space-to-depth stem (round 15) — "
+                "2x2 stride-1 stem on the folded 320²x12 plane; stock "
+                "yolov8n checkpoints transfer via the lossless kernel "
+                "fold (models/import_weights.py s2d_fold_kernel), "
+                "detections numerically equivalent",
 ))
 register(ModelSpec(
     "yolov8s", lambda: YOLOv8(yolov8s_config()),
@@ -142,6 +144,14 @@ register(ModelSpec(
 register(ModelSpec(
     "tiny_yolov8", lambda: YOLOv8(tiny_yolov8_config()),
     input_size=64, preprocess="letterbox", kind="detect",
+))
+register(ModelSpec(
+    "tiny_yolov8_s2d", lambda: YOLOv8(
+        dataclasses.replace(tiny_yolov8_config(), stem="s2d")
+    ),
+    input_size=64, preprocess="letterbox", kind="detect",
+    description="CPU/CI twin of yolov8n_s2d (tests/test_stem_s2d.py, "
+                "tools/stem_smoke.py)",
 ))
 register(ModelSpec(
     "tiny_resnet", lambda: ResNet(tiny_resnet_config()),

@@ -35,12 +35,24 @@ class YOLOv8Config:
     max_channels: int = 1024
     reg_max: int = 16             # DFL bins
     strides: Sequence[int] = (8, 16, 32)
-    # Space-to-depth stem (BASELINE.md perf notes): fold 2x2 spatial blocks
-    # into channels (3 -> 12) before a stride-1 conv, so the P1 stage feeds
-    # the VPU/MXU 12 input lanes instead of 3 (the stock stem underfills
-    # the 128-lane registers at 3 channels). Same output geometry as the
-    # stride-2 stem; DIFFERENT architecture — checkpoints do not transfer.
-    s2d_stem: bool = False
+    # Stem variant. "classic": stride-2 3x3 conv on [B,S,S,3] (the stock
+    # architecture, the checkpoint contract). "s2d": space-to-depth stem
+    # (round 15) — fold 2x2 spatial blocks into channels (3 -> 12), then a
+    # stride-1 2x2 conv with asymmetric ((1,0),(1,0)) padding on the 320²
+    # plane. Same output geometry, 4x the input lanes for the MXU, and —
+    # unlike the rejected round-5 s2d experiment (a fresh 3x3 stem that
+    # broke checkpoints and lost 0.85x) — EXACTLY the same function: every
+    # classic stem kernel folds losslessly into the 2x2 layout
+    # (models/import_weights.py s2d_fold_kernel), so stock checkpoints
+    # transfer and detections stay numerically equivalent.
+    stem: str = "classic"
+    # int8 activation path (round 15): every ConvBN except the stem runs
+    # int8 x int8 against calibrated per-tensor input scales and in-graph
+    # per-output-channel weight scales (models/common.py _Int8Conv). The
+    # param tree is identical to fp, so checkpoints serve either way after
+    # a calibration pass (models/quantize.py calibrate_serving). Serving
+    # only; head 1x1 out-convs and DFL/NMS decode stay fp32.
+    act_int8: bool = False
     # Channel-padded stem (the one lane-fill lever that DOES transfer
     # checkpoints): zero-pad the input from 3 to this many channels before
     # the stem conv, whose kernel grows [3,3,3,C]->[3,3,pad,C]. The extra
@@ -60,8 +72,11 @@ def yolov8n_config(num_classes: int = 80) -> YOLOv8Config:
     # stem_pad_c=8: measured +3.2% end-to-end at the north-star shape
     # (two uncontended runs, 12.35/12.36 vs 12.74 ms — BASELINE.md levers
     # table), reproducible, and checkpoint-transferable (the importer
-    # zero-pads the stem kernel, unlike s2d which lost 0.85x AND broke
-    # checkpoints).
+    # zero-pads the stem kernel). The round-5 s2d experiment lost 0.85x
+    # AND broke checkpoints; the round-15 stem="s2d" is a different,
+    # lossless fold — see YOLOv8Config.stem. pad_channels no-ops when the
+    # input already has >= pad channels, so stem_pad_c=8 is inert under
+    # the 12-channel s2d plane.
     return YOLOv8Config(num_classes=num_classes, stem_pad_c=8)
 
 
@@ -79,11 +94,15 @@ class Bottleneck(nn.Module):
     features: int
     shortcut: bool = True
     dtype: Dtype = jnp.bfloat16
+    act_int8: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
-        h = ConvBN(self.features, kernel=3, dtype=self.dtype, name="cv1")(x, train)
-        h = ConvBN(self.features, kernel=3, dtype=self.dtype, name="cv2")(h, train)
+        q = self.act_int8
+        h = ConvBN(self.features, kernel=3, dtype=self.dtype, act_int8=q,
+                   name="cv1")(x, train)
+        h = ConvBN(self.features, kernel=3, dtype=self.dtype, act_int8=q,
+                   name="cv2")(h, train)
         if self.shortcut and x.shape[-1] == self.features:
             h = h + x
         return h
@@ -96,21 +115,23 @@ class C2f(nn.Module):
     n: int = 1
     shortcut: bool = True
     dtype: Dtype = jnp.bfloat16
+    act_int8: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         hidden = self.features // 2
-        h = ConvBN(2 * hidden, kernel=1, dtype=self.dtype, name="cv1")(x, train)
+        q = self.act_int8
+        h = ConvBN(2 * hidden, kernel=1, dtype=self.dtype, act_int8=q,
+                   name="cv1")(x, train)
         parts = [h[..., :hidden], h[..., hidden:]]
         for i in range(self.n):
             parts.append(
-                Bottleneck(hidden, self.shortcut, self.dtype, name=f"m{i}")(
+                Bottleneck(hidden, self.shortcut, self.dtype, q, name=f"m{i}")(
                     parts[-1], train
                 )
             )
-        return ConvBN(self.features, kernel=1, dtype=self.dtype, name="cv2")(
-            jnp.concatenate(parts, axis=-1), train
-        )
+        return ConvBN(self.features, kernel=1, dtype=self.dtype, act_int8=q,
+                      name="cv2")(jnp.concatenate(parts, axis=-1), train)
 
 
 class SPPF(nn.Module):
@@ -118,15 +139,18 @@ class SPPF(nn.Module):
 
     features: int
     dtype: Dtype = jnp.bfloat16
+    act_int8: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         hidden = self.features // 2
-        h = ConvBN(hidden, kernel=1, dtype=self.dtype, name="cv1")(x, train)
+        h = ConvBN(hidden, kernel=1, dtype=self.dtype, act_int8=self.act_int8,
+                   name="cv1")(x, train)
         pools = [h]
         for _ in range(3):
             pools.append(nn.max_pool(pools[-1], (5, 5), strides=(1, 1), padding="SAME"))
-        return ConvBN(self.features, kernel=1, dtype=self.dtype, name="cv2")(
+        return ConvBN(self.features, kernel=1, dtype=self.dtype,
+                      act_int8=self.act_int8, name="cv2")(
             jnp.concatenate(pools, axis=-1), train
         )
 
@@ -145,6 +169,7 @@ class DetectHead(nn.Module):
     cfg: YOLOv8Config
     level_ch: Sequence[int]
     dtype: Dtype = jnp.bfloat16
+    act_int8: bool = False
 
     @nn.compact
     def __call__(self, feats, train: bool = False):
@@ -153,10 +178,13 @@ class DetectHead(nn.Module):
         c = self.cfg
         c_box = max(16, self.level_ch[0] // 4, c.reg_max * 4)
         c_cls = max(self.level_ch[0], min(c.num_classes, 100))
+        q = self.act_int8
         outs = []
         for i, f in enumerate(feats):
-            box = ConvBN(c_box, kernel=3, dtype=self.dtype, name=f"box{i}_cv1")(f, train)
-            box = ConvBN(c_box, kernel=3, dtype=self.dtype, name=f"box{i}_cv2")(box, train)
+            box = ConvBN(c_box, kernel=3, dtype=self.dtype, act_int8=q,
+                         name=f"box{i}_cv1")(f, train)
+            box = ConvBN(c_box, kernel=3, dtype=self.dtype, act_int8=q,
+                         name=f"box{i}_cv2")(box, train)
             # DFL bin prior: decay the bias over distance bins so the
             # initial expected ltrb distance is ~1.5 strides instead of
             # the uniform-softmax 7.5. Random-init boxes then start near
@@ -180,8 +208,10 @@ class DetectHead(nn.Module):
             # head (see detect_loss.assign's relative-floor note).
             # Imported checkpoints overwrite these values.
             prior = math.log(5 / c.num_classes / (640 / c.strides[i]) ** 2)
-            cls = ConvBN(c_cls, kernel=3, dtype=self.dtype, name=f"cls{i}_cv1")(f, train)
-            cls = ConvBN(c_cls, kernel=3, dtype=self.dtype, name=f"cls{i}_cv2")(cls, train)
+            cls = ConvBN(c_cls, kernel=3, dtype=self.dtype, act_int8=q,
+                         name=f"cls{i}_cv1")(f, train)
+            cls = ConvBN(c_cls, kernel=3, dtype=self.dtype, act_int8=q,
+                         name=f"cls{i}_cv2")(cls, train)
             cls = nn.Conv(c.num_classes, (1, 1), dtype=jnp.float32, name=f"cls{i}_out",
                           bias_init=nn.initializers.constant(prior))(
                 cls.astype(jnp.float32)
@@ -230,46 +260,68 @@ class YOLOv8(nn.Module):
         """
         c = self.cfg
         d, ch = c.depth, c.ch
+        q = c.act_int8
         x = x.astype(self.dtype)
 
         # Backbone
-        if c.s2d_stem:
-            b, h, w, ci = x.shape
-            x = x.reshape(b, h // 2, 2, w // 2, 2, ci)
-            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * ci)
-            x = ConvBN(ch(64), dtype=self.dtype, name="stem")(x, train)             # P1
+        if c.stem == "s2d":
+            # Accepts either the raw [B, S, S, 3] plane (folds it here) or
+            # the pre-folded [B, S/2, S/2, 12] plane straight out of
+            # ops/preprocess.preprocess_letterbox_fused.
+            if x.shape[-1] == 3:
+                from ..ops.preprocess import space_to_depth
+
+                x = space_to_depth(x)
+            x = pad_channels(x, c.stem_pad_c)
+            # Stride-1 2x2 conv, pad ((1,0),(1,0)): the lossless fold of
+            # the classic stride-2 3x3 conv onto the s2d plane — output
+            # pixel p of the classic stem reads input rows 2p-1..2p+1,
+            # which land in s2d rows p-1 (offset 1) and p (offsets 0/1);
+            # the leading pad supplies the p-1 = -1 zero row exactly like
+            # the classic conv's top padding. Taps the classic kernel
+            # never reads are zero in the folded kernel
+            # (models/import_weights.py s2d_fold_kernel). Kept fp even
+            # under act_int8 (first-layer exemption, standard PTQ rule).
+            x = ConvBN(ch(64), kernel=2, stride=1, padding=((1, 0), (1, 0)),
+                       dtype=self.dtype, name="stem")(x, train)              # P1
         else:
             # Lane-fill: zero input planes cost bandwidth but let XLA
             # tile the stem conv with full input-channel vectors.
             x = pad_channels(x, c.stem_pad_c)
             x = ConvBN(ch(64), stride=2, dtype=self.dtype, name="stem")(x, train)   # P1
-        x = ConvBN(ch(128), stride=2, dtype=self.dtype, name="down2")(x, train)     # P2
-        x = C2f(ch(128), d(3), True, self.dtype, name="c2f_2")(x, train)
-        x = ConvBN(ch(256), stride=2, dtype=self.dtype, name="down3")(x, train)     # P3
-        p3 = C2f(ch(256), d(6), True, self.dtype, name="c2f_3")(x, train)
-        x = ConvBN(ch(512), stride=2, dtype=self.dtype, name="down4")(p3, train)    # P4
-        p4 = C2f(ch(512), d(6), True, self.dtype, name="c2f_4")(x, train)
-        x = ConvBN(ch(1024), stride=2, dtype=self.dtype, name="down5")(p4, train)   # P5
-        x = C2f(ch(1024), d(3), True, self.dtype, name="c2f_5")(x, train)
-        p5 = SPPF(ch(1024), self.dtype, name="sppf")(x, train)
+        x = ConvBN(ch(128), stride=2, dtype=self.dtype, act_int8=q,
+                   name="down2")(x, train)                                   # P2
+        x = C2f(ch(128), d(3), True, self.dtype, q, name="c2f_2")(x, train)
+        x = ConvBN(ch(256), stride=2, dtype=self.dtype, act_int8=q,
+                   name="down3")(x, train)                                   # P3
+        p3 = C2f(ch(256), d(6), True, self.dtype, q, name="c2f_3")(x, train)
+        x = ConvBN(ch(512), stride=2, dtype=self.dtype, act_int8=q,
+                   name="down4")(p3, train)                                  # P4
+        p4 = C2f(ch(512), d(6), True, self.dtype, q, name="c2f_4")(x, train)
+        x = ConvBN(ch(1024), stride=2, dtype=self.dtype, act_int8=q,
+                   name="down5")(p4, train)                                  # P5
+        x = C2f(ch(1024), d(3), True, self.dtype, q, name="c2f_5")(x, train)
+        p5 = SPPF(ch(1024), self.dtype, q, name="sppf")(x, train)
 
         # PAN-FPN neck
         x = jnp.concatenate([_upsample2(p5), p4], axis=-1)
-        n4 = C2f(ch(512), d(3), False, self.dtype, name="neck_up4")(x, train)
+        n4 = C2f(ch(512), d(3), False, self.dtype, q, name="neck_up4")(x, train)
         x = jnp.concatenate([_upsample2(n4), p3], axis=-1)
-        n3 = C2f(ch(256), d(3), False, self.dtype, name="neck_up3")(x, train)       # out P3
-        x = ConvBN(ch(256), stride=2, dtype=self.dtype, name="neck_down4")(n3, train)
-        o4 = C2f(ch(512), d(3), False, self.dtype, name="neck_out4")(
+        n3 = C2f(ch(256), d(3), False, self.dtype, q, name="neck_up3")(x, train)  # out P3
+        x = ConvBN(ch(256), stride=2, dtype=self.dtype, act_int8=q,
+                   name="neck_down4")(n3, train)
+        o4 = C2f(ch(512), d(3), False, self.dtype, q, name="neck_out4")(
             jnp.concatenate([x, n4], axis=-1), train
         )                                                                            # out P4
-        x = ConvBN(ch(512), stride=2, dtype=self.dtype, name="neck_down5")(o4, train)
-        o5 = C2f(ch(1024), d(3), False, self.dtype, name="neck_out5")(
+        x = ConvBN(ch(512), stride=2, dtype=self.dtype, act_int8=q,
+                   name="neck_down5")(o4, train)
+        o5 = C2f(ch(1024), d(3), False, self.dtype, q, name="neck_out5")(
             jnp.concatenate([x, p5], axis=-1), train
         )                                                                            # out P5
 
         levels = [n3, o4, o5]
         head_out = DetectHead(
-            c, [f.shape[-1] for f in levels], self.dtype, name="detect"
+            c, [f.shape[-1] for f in levels], self.dtype, q, name="detect"
         )(levels, train)
 
         if decode is False:

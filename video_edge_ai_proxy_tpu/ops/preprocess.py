@@ -46,7 +46,13 @@ def _resize_matrix(src: int, dst: int) -> np.ndarray:
     return out
 
 
-def resize_bilinear_mxu(x: jnp.ndarray, dst_hw: tuple[int, int]) -> jnp.ndarray:
+def resize_bilinear_mxu(
+    x: jnp.ndarray,
+    dst_hw: tuple[int, int],
+    *,
+    in_scale: float | None = None,
+    out_dtype: jnp.dtype | None = None,
+) -> jnp.ndarray:
     """Separable bilinear resize as two dense matmuls.
 
     [N, H, W, C] -> [N, h, w, C]. On TPU a gather-based image resize of
@@ -54,18 +60,36 @@ def resize_bilinear_mxu(x: jnp.ndarray, dst_hw: tuple[int, int]) -> jnp.ndarray:
     the same linear map as [h,H] and [w,W] contractions puts it on the MXU
     (~2 ms measured, bounded by the u8->bf16 cast). Weights are trace-time
     constants (lru-cached per geometry).
+
+    ``in_scale`` (round 15, the fused-stem path): accept integer (uint8)
+    input directly and fold the ``in_scale`` normalization constant into
+    the trace-time row matrix. The resize is linear, so
+    ``resize(x * s) == resize_with_scaled_weights(x)`` exactly in exact
+    arithmetic — but the per-pixel ``astype(...) * s`` elementwise pass
+    over the FULL-RES plane disappears: the only op touching the source
+    plane is the first contraction, whose operand convert XLA fuses into
+    the matmul read. ``out_dtype`` names the compute/output dtype for this
+    path (default bfloat16).
     """
-    if not jnp.issubdtype(x.dtype, jnp.floating):
-        raise TypeError(
-            f"resize_bilinear_mxu needs a float input, got {x.dtype}; "
-            "scale uint8 frames first (frames.astype(...) / 255)"
-        )
+    if in_scale is None:
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            raise TypeError(
+                f"resize_bilinear_mxu needs a float input, got {x.dtype}; "
+                "scale uint8 frames first (frames.astype(...) / 255) or "
+                "pass in_scale= to fold the scale into the resize weights"
+            )
+        dtype = x.dtype
+        scale = 1.0
+    else:
+        dtype = out_dtype or jnp.bfloat16
+        scale = float(in_scale)
+        x = x.astype(dtype)
     h, w = x.shape[1], x.shape[2]
     th, tw = dst_hw
     if (h, w) == (th, tw):
-        return x
-    rh = jnp.asarray(_resize_matrix(h, th), x.dtype)
-    rw = jnp.asarray(_resize_matrix(w, tw), x.dtype)
+        return x * jnp.asarray(scale, dtype) if scale != 1.0 else x
+    rh = jnp.asarray(_resize_matrix(h, th) * scale, dtype)
+    rw = jnp.asarray(_resize_matrix(w, tw), dtype)
     y = jnp.einsum("hH,nHWc->nhWc", rh, x)
     return jnp.einsum("wW,nhWc->nhwc", rw, y)
 
@@ -174,6 +198,96 @@ def preprocess_letterbox(
         constant_values=pad_value,
     )
     return x.astype(out_dtype), params
+
+
+@functools.lru_cache(maxsize=64)
+def _letterbox_axis_matrix(src: int, new: int, dst: int, offset: int,
+                           scale: float = 1.0) -> np.ndarray:
+    """[dst, src] matrix for one letterbox axis: the [new, src] resize
+    matrix embedded at ``offset``, zero rows elsewhere (the padding band),
+    with an optional constant ``scale`` folded into the weights. A single
+    contraction with this matrix resizes AND places the image inside the
+    letterboxed canvas — no separate ``jnp.pad`` pass."""
+    m = np.zeros((dst, src), np.float32)
+    m[offset:offset + new] = _resize_matrix(src, new)
+    return m * scale
+
+
+def space_to_depth(x: jnp.ndarray) -> jnp.ndarray:
+    """[N, H, W, C] -> [N, H/2, W/2, 4C]: fold 2x2 spatial blocks into
+    channels. Channel layout is ``(2a + b) * C + c`` for row offset ``a``,
+    column offset ``b`` — the SAME layout models/yolov8.py's in-graph fold
+    and models/import_weights.py's kernel rewrite assume, kept in one
+    place so the three can never drift."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+
+
+def preprocess_letterbox_fused(
+    frames_u8: jnp.ndarray,
+    dst: int = 640,
+    pad_value: float = 114.0 / 255.0,
+    out_dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[jnp.ndarray, LetterboxParams]:
+    """Fused letterbox + normalize + space-to-depth megakernel (round 15).
+
+    [N, H, W, 3] uint8 BGR -> [N, dst/2, dst/2, 12] letterboxed RGB in
+    [0, 1], already folded into the s2d layout the ``stem="s2d"`` detect
+    stem consumes, plus the same LetterboxParams as
+    :func:`preprocess_letterbox`.
+
+    Why a separate kernel (BASELINE.md round-5 rejected the bare s2d fold
+    at 0.85x: a standalone 2x2 fold of the full-size bf16 plane is a pure
+    VPU relayout, ~1.5 ms of new cost): here the fold is FREE — the
+    letterbox row/column matrices are split by output parity at trace
+    time, so the two resize matmuls emit the [n, h, w, a, b, c] blocked
+    layout directly and the s2d "reshape" is just the final axis collapse
+    XLA folds into the matmul output layout. On top of that the 1080p
+    source plane is read exactly once (MFU_yolo_r05: the two-pass path's
+    u8->bf16 cast pass made preprocess 2.7 ms): 1/255 rides the row
+    matrix (resize_bilinear_mxu's in_scale trick), the pad value is a
+    trace-time additive mask on the SMALL plane, and the BGR->RGB flip
+    happens on the folded output's 3-channel groups.
+
+    Numerics: same linear map as the two-pass path, different summation
+    order/rounding points -> tolerance parity with
+    ``space_to_depth(preprocess_letterbox(...))``, not bit parity
+    (tests/test_stem_s2d.py pins the tolerance). The classic path is
+    untouched — its replay checksums stay bit-identical.
+    """
+    if dst % 2:
+        raise ValueError(f"preprocess_letterbox_fused needs an even dst, got {dst}")
+    params = letterbox_params(frames_u8.shape[1:3], dst)
+    src_h, src_w = frames_u8.shape[1], frames_u8.shape[2]
+    top = int(round(params.pad_y))
+    left = int(round(params.pad_x))
+    half = dst // 2
+    # Parity-split letterbox matrices ([2, dst/2, src]): row a of the
+    # output's 2x2 block comes from the even/odd rows of the full [dst,
+    # src] matrix. 1/255 folds into the row matrix; both are trace-time
+    # constants per (geometry, dst).
+    rh = _letterbox_axis_matrix(src_h, params.new_h, dst, top, 1.0 / 255.0)
+    rw = _letterbox_axis_matrix(src_w, params.new_w, dst, left)
+    rh2 = jnp.asarray(np.stack([rh[0::2], rh[1::2]]), out_dtype)
+    rw2 = jnp.asarray(np.stack([rw[0::2], rw[1::2]]), out_dtype)
+    x = frames_u8.astype(out_dtype)          # fuses into the first matmul
+    y = jnp.einsum("ahH,nHWc->nahWc", rh2, x)
+    y = jnp.einsum("bwW,nahWc->nhwabc", rw2, y)
+    # Pad band: the zero rows of the letterbox matrices left exact zeros
+    # outside the resized image; add the pad value there via a trace-time
+    # constant mask in the SAME blocked layout (n h w a b broadcast c).
+    inside_r = np.zeros((dst,), np.float32)
+    inside_r[top:top + params.new_h] = 1.0
+    inside_c = np.zeros((dst,), np.float32)
+    inside_c[left:left + params.new_w] = 1.0
+    outside = (1.0 - np.outer(inside_r, inside_c)) * pad_value
+    outside = outside.reshape(half, 2, half, 2).transpose(0, 2, 1, 3)
+    y = y + jnp.asarray(outside, out_dtype)[None, :, :, :, :, None]
+    # BGR -> RGB on the 3-channel groups, then collapse (a, b, c) ->
+    # (2a + b) * 3 + c: the space_to_depth layout (see above).
+    y = y[..., ::-1]
+    return y.reshape(y.shape[0], half, half, 12).astype(out_dtype), params
 
 
 def unletterbox_boxes(

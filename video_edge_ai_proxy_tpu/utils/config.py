@@ -148,7 +148,22 @@ class EngineConfig:
     # (models/quantize.py): int8 device/HBM residency (checkpoints stay
     # full precision on disk), bf16 compute,
     # dequantize fused in-graph. "" = full precision.
+    # "int8_act" (round 15, detect family only) = the above PLUS int8
+    # activation compute: a calibration pass over synthetic frames at
+    # warmup observes per-conv input ranges, then every conv except the
+    # stem and head out-convs runs int8 x int8 on the MXU
+    # (models/common.py _Int8Conv). Accuracy-gated by the tolerance
+    # committed in tools/bench_levers.py.
     quantize: str = ""
+    # Detect-family stem variant (round 15). "classic" (default) = stock
+    # stride-2 3x3 stem, replay checksums bit-identical to prior rounds.
+    # "s2d" = space-to-depth: the fused letterbox+s2d preprocess
+    # (ops/preprocess.py preprocess_letterbox_fused) reads the 1080p
+    # plane once and feeds a 320²x12 plane to a stride-1 2x2 stem;
+    # classic checkpoints fold in losslessly at load
+    # (models/import_weights.py s2d_fold_kernel). Non-detect models
+    # ignore this.
+    stem: str = "classic"
     # Fill Detection.track_id / AnnotateRequest.object_tracking_id with a
     # per-stream SORT-style tracker (engine/tracker.py). Host-side numpy on
     # NMS output — negligible next to a device batch.
