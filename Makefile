@@ -3,7 +3,7 @@
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
-	roi-smoke fleet-obs-smoke stem-smoke
+	roi-smoke fleet-obs-smoke stem-smoke router-smoke
 
 all: proto native
 
@@ -185,6 +185,27 @@ stem-smoke:
 		print('stem: fold maxdiff %.2g px, fused maxdiff %.2g, int8 mAP50 %.3f, %d engine frames' \
 			% (d['fold_box_maxdiff_px'], d['fused_vs_two_pass_maxdiff'], \
 			   d['int8_act_map50_vs_fp'], d['engine_frames_served']))"
+
+# Fleet-router acceptance (round 13 = r16): 3 serve-only members, 6
+# replay streams placed by serve/router.py's consistent-hash ring, then
+# two fault legs. Gates (in tools/router_smoke.py, exit non-zero on
+# breach): burn leg — the forced-burn member's ladder reaches
+# shed_to_fleet and the router migrates its streams BEFORE the local
+# ladder hits bucket_downshift; kill leg — every stream of a SIGKILLed
+# member re-placed, detect->resumed within one scrape interval; the
+# frame-conservation ledger balances for every stream (zero lost, zero
+# duplicated across the drain->cutover->resume handoffs); every
+# migration has a stitched worker->bus->engine->client lineage chain;
+# and vep_router_* exposition is lint-clean. Commits ROUTER_r01.json.
+router-smoke:
+	python tools/router_smoke.py | tee /tmp/vep_router_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_router_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); \
+		print('router: %d members / %d streams, burn handoff %.1fs, kill detect->resumed %.2fs (wall %.2fs), ledger lost=%d dup=%d' \
+			% (d['members'], d['streams'], d['burn_migrate_s'], \
+			   d['kill_replace_detect_s'], d['kill_replace_wall_s'], \
+			   d['ledger']['lost'], d['ledger']['duplicated']))"
 
 roi-smoke:
 	python tools/roi_smoke.py | tee /tmp/vep_roi_smoke.json

@@ -68,6 +68,40 @@ class TestCollector:
         groups = col.collect()
         assert [g.bucket for g in groups] == [2, 2, 1]
 
+    def test_cursor_rebases_when_ring_restarts(self, bus):
+        """Stop/start re-add (fleet migration, crash-restart) recreates
+        the ring with sequence numbering restarting below the collector's
+        cursor. The stale cursor must be dropped — otherwise every frame
+        on the new ring reads as already-seen until its seq catches up
+        (seconds of invisible loss at low fps)."""
+        bus.create_stream("cam1", 64 * 64 * 3)
+        col = Collector(bus, buckets=(1, 2, 4))
+        for v in (1, 2, 3, 4, 5):
+            _publish(bus, "cam1", value=v)
+        assert col.collect()[0].frames[0, 0, 0, 0] == 5   # cursor now 5
+        bus.drop_stream("cam1")                           # ring recreated
+        bus.create_stream("cam1", 64 * 64 * 3)
+        _publish(bus, "cam1", value=9)                    # seq 1 < cursor
+        groups = col.collect()
+        assert groups and groups[0].frames[0, 0, 0, 0] == 9
+        assert col.collect() == []                        # cursor rebased
+
+    def test_cursor_rebases_on_fast_path_too(self, bus):
+        """Same restart signal must fire on the pooled fast path (the
+        steady-state read), not just the generic first-sight path."""
+        bus.create_stream("cam1", 64 * 64 * 3)
+        col = Collector(bus, buckets=(1, 2, 4))
+        _publish(bus, "cam1", value=1)
+        col.collect()                                     # generic path
+        for v in (2, 3, 4):
+            _publish(bus, "cam1", value=v)
+        assert col.collect()[0].frames[0, 0, 0, 0] == 4   # fast path, cursor 4
+        bus.drop_stream("cam1")
+        bus.create_stream("cam1", 64 * 64 * 3)
+        _publish(bus, "cam1", value=7)                    # seq 1 < cursor
+        groups = col.collect()
+        assert groups and groups[0].frames[0, 0, 0, 0] == 7
+
     def test_clip_assembly(self, bus):
         bus.create_stream("cam1", 32 * 32 * 3)
         col = Collector(bus, buckets=(1, 2), clip_len=3)

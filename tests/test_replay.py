@@ -157,6 +157,36 @@ class TestReplaySource:
         for (_, a), b in zip(got, want):
             np.testing.assert_array_equal(a, b)
 
+    def test_start_resumes_mid_gop_with_keyframe_entry(self, tmp_path):
+        """Migration resume leg: ``start=N`` slices to the handoff cursor
+        and must report the FIRST remaining packet as a keyframe even
+        mid-GOP — trace events decode standalone, and a fresh worker's
+        lazy-decode valve would otherwise skip exactly the cursor packet
+        (no client-activity stamp exists yet on the destination)."""
+        path = str(tmp_path / "r.vtrace")
+        record_synthetic_trace(path, ["cam0"], width=32, height=24,
+                               fps=30.0, gop=8, frames=12)
+        src = open_source(f"replay://{path}?device=cam0&pace=0&start=5")
+        src.open()
+        pkts = []
+        while (pkt := src.grab()) is not None:
+            pkts.append(pkt)
+        assert [p.packet for p in pkts] == list(range(5, 12))
+        assert pkts[0].is_keyframe            # cursor packet promoted
+        assert not pkts[2].is_keyframe        # packet 7: recorded flag kept
+        assert pkts[3].is_keyframe            # packet 8: real gop boundary
+
+    def test_start_zero_keeps_recorded_keyframe_flags(self, tmp_path):
+        path = str(tmp_path / "r.vtrace")
+        record_synthetic_trace(path, ["cam0"], width=32, height=24,
+                               fps=30.0, gop=8, frames=4)
+        src = open_source(f"replay://{path}?device=cam0&pace=0")
+        src.open()
+        flags = []
+        while (pkt := src.grab()) is not None:
+            flags.append(pkt.is_keyframe)
+        assert flags == [True, False, False, False]
+
     def test_ambiguous_device_errors(self, tmp_path):
         path = str(tmp_path / "multi.vtrace")
         record_synthetic_trace(path, ["a", "b"], width=32, height=24,

@@ -148,6 +148,35 @@ def gate(current: dict, trajectory: list, tolerance: float,
     return report
 
 
+def router_replace_info(baseline_dir: str):
+    """Newest committed ROUTER_r*.json's re-placement latency, or None.
+
+    Round 13 informational carry-through: perf-gate logs show the fleet
+    router's measured kill-leg latency (detect->resumed and wall
+    kill->resumed, plus the conservation-ledger verdict) next to the fps
+    verdict. NEVER gated here — router_smoke.py hard-gates its own run;
+    this is trend visibility only.
+    """
+    paths = sorted(glob.glob(os.path.join(baseline_dir, "ROUTER_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        kill = art.get("kill") if isinstance(art, dict) else None
+        if isinstance(kill, dict):
+            return {
+                "artifact": os.path.basename(path),
+                "members": art.get("members"),
+                "streams": art.get("streams"),
+                "replace_detect_s": kill.get("replace_detect_s"),
+                "replace_wall_s": kill.get("replace_wall_s"),
+                "ledger_balanced": art.get("ledger", {}).get("balanced"),
+            }
+    return None
+
+
 def stem_stage_info(baseline_dir: str):
     """Newest committed MFU_yolo_*.json's stem-stage row, or None.
 
@@ -202,6 +231,9 @@ def main(argv=None) -> int:
     stem = stem_stage_info(args.baseline_dir)
     if stem is not None:
         report["stem_stage"] = stem          # informational, never gated
+    router = router_replace_info(args.baseline_dir)
+    if router is not None:
+        report["router_replace"] = router    # informational, never gated
     print(json.dumps(report, indent=2))
     return 0 if report["passed"] else 1
 

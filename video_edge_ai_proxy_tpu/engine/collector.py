@@ -308,6 +308,24 @@ class Collector:
         eff = tuple(b for b in self._buckets if b <= cap)
         return eff or self._buckets[:1]
 
+    def _rebase_if_restarted(self, device_id: str) -> bool:
+        """A producer that recreates its ring (stop/start stream re-add,
+        worker crash-restart) restarts sequence numbering below our
+        cursor, so ``read_latest*(min_seq=cursor)`` would treat every
+        frame on the new ring as already-seen until its seq caught up —
+        seconds of invisibly dropped frames at low fps. A head strictly
+        below the cursor is impossible on a monotonic ring, so it is an
+        unambiguous restart signal: drop the cursor (callers retry the
+        read in the same pass). ``head()`` None (backend without cheap
+        heads) keeps the old behavior. Returns True when rebased."""
+        cursor = self._cursors.get(device_id, 0)
+        if cursor:
+            head = self._bus.head(device_id)
+            if head is not None and head < cursor:
+                self._cursors.pop(device_id, None)
+                return True
+        return False
+
     def _note_read(self, device_id: str, seq: int, meta) -> None:
         """Every cursor advance funnels here: counts latest-wins skips and
         stamps the frame's ``collect`` lineage span. ``pub_ms`` rides the
@@ -620,6 +638,10 @@ class Collector:
         for device_id, key in win["of"].items():
             cursor = self._cursors.get(device_id, 0)
             head = self._bus.head(device_id)
+            if head is not None and head < cursor:
+                # Ring recreated under us — see _rebase_if_restarted.
+                self._cursors.pop(device_id, None)
+                cursor = 0
             if head is not None and head <= cursor:
                 continue   # idle ring: one cheap load, no read setup
             g = win["groups"][key]
@@ -729,6 +751,10 @@ class Collector:
                         device_id, batch[len(ids)],
                         min_seq=self._cursors.get(device_id, 0),
                     )
+                    if res is None and self._rebase_if_restarted(device_id):
+                        res = self._bus.read_latest_into(
+                            device_id, batch[len(ids)], min_seq=0,
+                        )
                     if res is None:
                         continue
                     if isinstance(res, Frame):   # geometry drifted
@@ -766,6 +792,8 @@ class Collector:
             frame = self._bus.read_latest(
                 device_id, min_seq=self._cursors.get(device_id, 0)
             )
+            if frame is None and self._rebase_if_restarted(device_id):
+                frame = self._bus.read_latest(device_id, min_seq=0)
             if frame is None:
                 continue
             self._note_read(device_id, frame.seq, frame.meta)

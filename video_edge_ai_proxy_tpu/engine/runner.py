@@ -2104,11 +2104,16 @@ class InferenceEngine:
             )
 
     def _apply_rung_cap(self, rung: str) -> None:
-        """Rung 2+ (bucket_downshift): hide the largest batch bucket so
+        """bucket_downshift and above: hide the largest batch bucket so
         new batches run the next-smaller (cheaper, typically
-        already-compiled) device program; below rung 2 the cap clears."""
+        already-compiled) device program; below it the cap clears.
+        Keyed on the rung NAME, not a raw index — r16 inserted
+        shed_to_fleet between shed and bucket_downshift, and a
+        fleet-shedding engine must NOT also be shrinking its programs
+        (horizontal re-placement engages before vertical degradation)."""
         cap = None
-        if _RUNG_IDX[rung] >= 2 and len(self._buckets) > 1:
+        if _RUNG_IDX[rung] >= _RUNG_IDX["bucket_downshift"] \
+                and len(self._buckets) > 1:
             cap = self._buckets[-2]
         self._collector.set_bucket_cap(cap)
 

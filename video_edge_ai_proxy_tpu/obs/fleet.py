@@ -35,6 +35,16 @@ Fleet metric families (all gauges unless noted):
 - ``vep_fleet_member_stale{instance}`` — staleness flag (dead OR older
   than the staleness bound)
 - ``vep_fleet_member_health_score{instance}`` — ranked health in [0, 1]
+- ``vep_fleet_member_health_score_ema{instance}`` — EMA-smoothed score
+  (r16: the flap-free signal the router's placement decisions read)
+- ``vep_fleet_member_healthy{instance}`` — hysteresis-banded verdict:
+  flips healthy at ``score_ema >= healthy_above`` and unhealthy at
+  ``score_ema <= unhealthy_below``; holds in between, so one noisy
+  scrape cannot bounce a member in and out of the placement ring
+- ``vep_fleet_member_health_state_age_seconds{instance}`` — seconds
+  since the last healthy/unhealthy flip (``healthy_since`` /
+  ``unhealthy_since``: the router requires a minimum healthy age before
+  a member takes migrated streams)
 - ``vep_fleet_member_slo_burning{instance}``
 - ``vep_fleet_member_ladder_rung{instance}``
 - ``vep_fleet_member_streams{instance}``
@@ -148,6 +158,13 @@ class MemberState:
         self.families: List[dict] = []
         self.stats: dict = {}
         self.slo: dict = {}
+        # r16 flap-free health (updated once per scrape pass, never at
+        # read time): EMA of the instantaneous score + a hysteresis-banded
+        # healthy verdict with entry timestamps.
+        self.score_ema: Optional[float] = None
+        self.healthy: Optional[bool] = None
+        self.healthy_since: Optional[float] = None    # time.monotonic()
+        self.unhealthy_since: Optional[float] = None
 
     # -- derived health signals --
 
@@ -180,7 +197,8 @@ class FleetAggregator:
 
     def __init__(self, members, *, scrape_interval_s: float = 2.0,
                  stale_after_s: Optional[float] = None,
-                 timeout_s: float = 2.0):
+                 timeout_s: float = 2.0, ema_alpha: float = 0.4,
+                 healthy_above: float = 0.7, unhealthy_below: float = 0.4):
         self._members: List[MemberState] = []
         for i, spec in enumerate(members):
             name, sep, url = str(spec).partition("=")
@@ -191,6 +209,18 @@ class FleetAggregator:
         self.stale_after_s = (float(stale_after_s) if stale_after_s
                               else self.scrape_interval_s)
         self.timeout_s = float(timeout_s)
+        # r16 flap suppression: the EMA smooths the instantaneous score
+        # and the two thresholds form a hysteresis band — a member flips
+        # healthy only at >= healthy_above and unhealthy only at
+        # <= unhealthy_below, holding its previous verdict in between.
+        self.ema_alpha = float(ema_alpha)
+        self.healthy_above = float(healthy_above)
+        self.unhealthy_below = float(unhealthy_below)
+        if not (0.0 <= self.unhealthy_below <= self.healthy_above <= 1.0):
+            raise ValueError(
+                f"hysteresis band must satisfy 0 <= unhealthy_below <= "
+                f"healthy_above <= 1, got [{unhealthy_below}, "
+                f"{healthy_above}]")
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -250,10 +280,50 @@ class FleetAggregator:
                     m.alive = False
                     m.last_err = f"{type(e).__name__}: {e}"
                     m.failures += 1
+        # One EMA/hysteresis update per PASS (not per read): health()
+        # stays a pure view, so concurrent readers cannot double-fold a
+        # sample into the EMA or race the band state.
+        now = time.monotonic()
+        with self._lock:
+            for m in self._members:
+                score = self._raw_score(m, now)
+                m.score_ema = score if m.score_ema is None else (
+                    self.ema_alpha * score
+                    + (1.0 - self.ema_alpha) * m.score_ema)
+                if m.score_ema >= self.healthy_above:
+                    verdict = True
+                elif m.score_ema <= self.unhealthy_below:
+                    verdict = False
+                else:
+                    # Mid-band: hold the previous verdict; a brand-new
+                    # member starting mid-band is optimistically healthy
+                    # (the placement ring would otherwise be empty at
+                    # boot).
+                    verdict = m.healthy if m.healthy is not None else True
+                if verdict != m.healthy:
+                    m.healthy = verdict
+                    if verdict:
+                        m.healthy_since = now
+                        m.unhealthy_since = None
+                    else:
+                        m.unhealthy_since = now
+                        m.healthy_since = None
         self._last_scrape_wall_ms = (time.monotonic() - t0) * 1000.0
         return self.health()
 
     # -- health --
+
+    def _raw_score(self, m: MemberState, now: float) -> float:
+        """Instantaneous health score in [0, 1] (the r14 formula); the
+        EMA/hysteresis layer on top is what the router consumes."""
+        staleness = m.staleness_s(now)
+        stale = (not m.alive) or staleness is None \
+            or staleness > self.stale_after_s
+        if (not m.alive and m.last_ok is None) or stale:
+            return 0.0
+        return max(0.0, min(1.0, (
+            1.0 - (0.5 if m.burning() else 0.0)
+            - 0.15 * m.ladder_rung() - 0.02 * m.streams())))
 
     def _member_health(self, m: MemberState, now: float) -> dict:
         staleness = m.staleness_s(now)
@@ -262,12 +332,7 @@ class FleetAggregator:
         rung = m.ladder_rung()
         streams = m.streams()
         burning = m.burning()
-        if not m.alive and m.last_ok is None:
-            score = 0.0
-        else:
-            score = 0.0 if stale else max(0.0, min(1.0, (
-                1.0 - (0.5 if burning else 0.0)
-                - 0.15 * rung - 0.02 * streams)))
+        score = self._raw_score(m, now)
         return {
             "instance": m.name,
             "url": m.base_url,
@@ -279,6 +344,13 @@ class FleetAggregator:
             "ladder_rung": rung,
             "streams": streams,
             "score": round(score, 4),
+            "score_ema": round(m.score_ema, 4)
+            if m.score_ema is not None else None,
+            "healthy": m.healthy,
+            "healthy_since_s": round(now - m.healthy_since, 3)
+            if m.healthy_since is not None else None,
+            "unhealthy_since_s": round(now - m.unhealthy_since, 3)
+            if m.unhealthy_since is not None else None,
             "scrapes": m.scrapes,
             "failures": m.failures,
             "last_err": m.last_err,
@@ -402,6 +474,20 @@ class FleetAggregator:
         fam("vep_fleet_member_health_score", "gauge",
             "Ranked member health in [0,1] (router placement input)",
             lambda r: r["score"])
+        fam("vep_fleet_member_health_score_ema", "gauge",
+            "EMA-smoothed member health score (flap-free router signal)",
+            lambda r: r["score_ema"] if r["score_ema"] is not None
+            else -1.0)
+        fam("vep_fleet_member_healthy", "gauge",
+            "Hysteresis-banded member health verdict (1=healthy)",
+            lambda r: -1.0 if r["healthy"] is None
+            else (1.0 if r["healthy"] else 0.0))
+        fam("vep_fleet_member_health_state_age_seconds", "gauge",
+            "Seconds since the member's last healthy/unhealthy flip",
+            lambda r: r["healthy_since_s"]
+            if r["healthy_since_s"] is not None
+            else (r["unhealthy_since_s"]
+                  if r["unhealthy_since_s"] is not None else -1.0))
         fam("vep_fleet_member_slo_burning", "gauge",
             "1 when the member's SLO engine reports burning",
             lambda r: 1.0 if r["slo_burning"] else 0.0)

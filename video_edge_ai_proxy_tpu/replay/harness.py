@@ -993,12 +993,21 @@ def run_e2e(
 def _fleet_member_main(argv=None) -> None:
     """Entry for ONE fleet-soak member subprocess (``python -m
     video_edge_ai_proxy_tpu.replay.harness --instance m0 ...``), spawned
-    by :func:`run_fleet_obs`. Protocol over stdout (JSON lines; server
-    logs go to stderr): ``{"ready": ..., "rest_port", "grpc_port"}``
-    after boot, ``{"quiesced": ...}`` after the replay stream stopped and
-    drained (counters static — the parent's conservation-scrape window),
-    then the member blocks on stdin until the parent releases it, dumps
-    its span rings to ``--spans-out`` and exits."""
+    by :func:`run_fleet_obs` / :func:`run_router_soak`. Protocol over
+    stdout (JSON lines; server logs go to stderr): ``{"ready": ...,
+    "rest_port", "grpc_port"}`` after boot, ``{"quiesced": ...}`` after
+    the replay stream stopped and drained (counters static — the
+    parent's conservation-scrape window), then the member blocks on
+    stdin until the parent releases it, dumps its span rings to
+    ``--spans-out`` and exits.
+
+    ``--serve-only`` (r16, router soak): boot NO stream of its own — the
+    fleet router places streams over REST — and run a stdin command loop
+    instead of the timed window: ``burn`` forces the engine's SLO-burn
+    verdict on (deterministic ladder pressure; pair with ``--slo-off``
+    so the real SLO engine never recomputes it), ``calm`` clears it,
+    ``exit`` releases the member. Each command is acked with a JSON
+    line."""
     import argparse
     import json
     import shutil
@@ -1007,8 +1016,12 @@ def _fleet_member_main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--instance", required=True)
     ap.add_argument("--workdir", required=True)
-    ap.add_argument("--trace", required=True)
-    ap.add_argument("--device", required=True)
+    ap.add_argument("--trace", default="",
+                    help="replay trace for the self-started stream "
+                         "(ignored with --serve-only)")
+    ap.add_argument("--device", default="",
+                    help="self-started stream name (ignored with "
+                         "--serve-only)")
     ap.add_argument("--model", default="tiny_yolov8")
     ap.add_argument("--duration", type=float, default=12.0)
     ap.add_argument("--warmup", type=float, default=8.0,
@@ -1016,7 +1029,38 @@ def _fleet_member_main(argv=None) -> None:
                          "(covers worker boot + first-geometry compile)")
     ap.add_argument("--spans-out", required=True)
     ap.add_argument("--native", action="store_true")
+    ap.add_argument("--serve-only", action="store_true")
+    ap.add_argument("--slo-off", action="store_true",
+                    help="disable the SLO engine so the burn flag is "
+                         "script-controlled, not recomputed per window")
+    ap.add_argument("--ladder-escalate", type=float, default=None,
+                    help="override engine.ladder_escalate_after_s (the "
+                         "router soak spaces rungs so migration lands "
+                         "between shed_to_fleet and bucket_downshift)")
+    ap.add_argument("--shed-staleness-ms", type=float, default=None,
+                    help="override engine.shed_staleness_ms (the router "
+                         "soak sets it high so the shed rung itself "
+                         "drops nothing and the conservation ledger "
+                         "stays attributable to migration alone)")
+    ap.add_argument("--batch-bucket", type=int, default=0,
+                    help="pin a single collector batch bucket so a "
+                         "migrated stream joining mid-soak never "
+                         "triggers a new device program (compile would "
+                         "drop frames via latest-frame-wins)")
+    ap.add_argument("--ladder-slo-only", action="store_true",
+                    help="neuter the ladder's physical pressure inputs "
+                         "(queue depth / tick lag) so the injected SLO "
+                         "burn is the ONLY rung driver — on the CPU "
+                         "backend an inference tick takes ~20x the 10ms "
+                         "tick budget, which would walk every member's "
+                         "ladder and make the router soak ping-pong")
+    ap.add_argument("--trace-every", type=int, default=None,
+                    help="override obs.sample_every (the router soak "
+                         "traces every frame so short post-migration "
+                         "residence still yields a stitchable chain)")
     args = ap.parse_args(argv)
+    if not args.serve_only and (not args.trace or not args.device):
+        ap.error("--trace/--device required without --serve-only")
     if not args.native:
         import jax
 
@@ -1035,27 +1079,68 @@ def _fleet_member_main(argv=None) -> None:
     cfg.obs.trace = True
     cfg.obs.sample_every = 4
     cfg.obs.instance = args.instance   # const instance label on /metrics
+    if args.slo_off:
+        cfg.engine.slo = False
+    if args.ladder_escalate is not None:
+        cfg.engine.ladder_escalate_after_s = args.ladder_escalate
+    if args.shed_staleness_ms is not None:
+        cfg.engine.shed_staleness_ms = args.shed_staleness_ms
+    if args.batch_bucket:
+        cfg.engine.batch_buckets = (args.batch_bucket,)
+    if args.trace_every is not None:
+        cfg.obs.sample_every = args.trace_every
     srv = Server(cfg, data_dir=args.workdir, grpc_port=0, rest_port=0,
                  enable_engine=True)
     srv.start()
+    if args.ladder_slo_only and srv.engine is not None \
+            and srv.engine.ladder is not None:
+        # Physical pressure (drain depth / tick lag vs the 10ms budget)
+        # is unavoidable on the CPU backend; push both thresholds out of
+        # reach so observe()'s slo_burning input is the only escalation
+        # driver and the soak's rung walk is script-controlled.
+        srv.engine.ladder.depth_threshold = 10**9
+        srv.engine.ladder.lag_factor = 10**9
     print(json.dumps({
         "ready": True, "instance": args.instance,
         "rest_port": srv._rest.bound_port,
         "grpc_port": srv.bound_grpc_port,
     }), flush=True)
     try:
-        srv.process_manager.start(StreamProcess(
-            name=args.device,
-            rtsp_endpoint=(
-                f"replay://{args.trace}?device={args.device}&pace=1&loop=1"
-            ),
-        ))
-        time.sleep(args.warmup + args.duration)
-        srv.process_manager.stop(args.device)
-        time.sleep(1.0)   # engine drain: counters static after this
-        print(json.dumps({"quiesced": True, "instance": args.instance}),
-              flush=True)
-        sys.stdin.readline()   # parent finished its conservation scrapes
+        if args.serve_only:
+            # Router-soak mode: the router owns placement; this process
+            # only answers burn/calm/exit (ack each so the parent can
+            # sequence without sleeps).
+            for line in sys.stdin:
+                cmd = line.strip()
+                if cmd == "burn":
+                    if srv.engine is not None:
+                        srv.engine._slo_burning = True
+                elif cmd == "calm":
+                    if srv.engine is not None:
+                        srv.engine._slo_burning = False
+                elif cmd == "exit":
+                    print(json.dumps({"ack": "exit",
+                                      "instance": args.instance}),
+                          flush=True)
+                    break
+                else:
+                    continue
+                print(json.dumps({"ack": cmd, "instance": args.instance}),
+                      flush=True)
+        else:
+            srv.process_manager.start(StreamProcess(
+                name=args.device,
+                rtsp_endpoint=(
+                    f"replay://{args.trace}?device={args.device}"
+                    "&pace=1&loop=1"
+                ),
+            ))
+            time.sleep(args.warmup + args.duration)
+            srv.process_manager.stop(args.device)
+            time.sleep(1.0)   # engine drain: counters static after this
+            print(json.dumps({"quiesced": True, "instance": args.instance}),
+                  flush=True)
+            sys.stdin.readline()   # parent finished conservation scrapes
     finally:
         events = tracer.events()
         with open(args.spans_out, "w") as f:
@@ -1321,6 +1406,445 @@ def run_fleet_obs(
             "span_events_per_member": [len(s) for s in member_spans],
         }
     finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()   # by PID via Popen handle — never pkill
+        if workdir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_router_soak(
+    *, n_members: int = 3, streams_per_member: int = 2,
+    width: int = 128, height: int = 96, fps: float = 2.0,
+    model: str = "tiny_yolov8", scrape_interval_s: float = 1.0,
+    ladder_escalate_s: float = 8.0, native: bool = False,
+    workdir: Optional[str] = None,
+) -> dict:
+    """r16 fleet-router soak: N REAL serve-only server processes, one
+    :class:`~..serve.router.StreamRouter` placing ``n_members *
+    streams_per_member`` replay streams across them, then two fault
+    legs with hard gates (the ``ROUTER_r01.json`` payload):
+
+    - **burn leg** — force the SLO-burn verdict on one member
+      (stdin ``burn``; the member runs ``--slo-off`` so nothing
+      recomputes the flag). Its ladder walks shed → shed_to_fleet; the
+      router sees the rung and gracefully migrates the member's streams
+      (drain→cutover→resume at the replay cursor). Gate: at migration
+      completion the member's ladder shows ``shed_to_fleet >= 1`` and
+      ``bucket_downshift == 0`` transitions — horizontal re-placement
+      engaged BEFORE the local ladder shrank device programs.
+    - **kill leg** — SIGKILL one member. Gate: every one of its streams
+      is re-placed with detection-to-resumed latency within one scrape
+      interval (detection itself is bounded by the scrape cadence; the
+      wall-clock kill→resumed bound is ``scrape_interval + 1s``).
+
+    Cross-cutting gates: the frame-conservation ledger balances for
+    EVERY stream (packet ids gap-free from first delivery, zero
+    duplicates — exactly-once across the handoffs, warmup ramp excluded
+    by the first-delivery baseline); every completed migration has a
+    stitched worker→bus→engine→client lineage (span chain
+    collect+device+emit for a trace id the destination's gRPC client
+    also received — and the source's too on the graceful leg); and the
+    router's ``vep_router_*`` exposition is ``lint_exposition``-clean.
+
+    Determinism levers: members pin ONE batch bucket (a migrated stream
+    joining mid-soak must not trigger a compile — latest-frame-wins
+    would drop frames and corrupt the ledger), shed staleness is set
+    above the soak length (the shed rung itself drops nothing),
+    ``ladder_escalate_s`` spaces the rungs so migration has a full
+    window between shed_to_fleet and bucket_downshift, ``fps`` sits
+    well below the CPU backend's per-member tick rate (latest-frame-wins
+    never overwrites an uncollected frame, so steady state is lossless
+    and the ledger attributes any gap to migration), and members run
+    ``--ladder-slo-only`` (physical tick-lag pressure is unavoidable on
+    CPU and would walk EVERY member's ladder — the injected burn must be
+    the only rung driver or the fleet ping-pongs).
+    """
+    import json as _json
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    import grpc
+
+    from ..obs import registry as obs_registry
+    from ..obs.metrics import lint_exposition
+    from ..proto import pb, pb_grpc
+    from ..serve.router import StreamRouter
+
+    tmp = workdir or tempfile.mkdtemp(prefix="vep_router_")
+    member_names = [f"m{i}" for i in range(n_members)]
+    bucket = 1
+    while bucket < n_members * streams_per_member + 2:
+        bucket *= 2
+    procs: list = []
+    spans_paths: list = []
+    router: Optional[StreamRouter] = None
+    stop = threading.Event()
+    threads: list = []
+    try:
+        for i, mname in enumerate(member_names):
+            spans_out = os.path.join(tmp, f"{mname}_spans.json")
+            spans_paths.append(spans_out)
+            member_dir = os.path.join(tmp, mname)
+            os.makedirs(member_dir, exist_ok=True)
+            cmd = [
+                sys.executable, "-m",
+                "video_edge_ai_proxy_tpu.replay.harness",
+                "--instance", mname, "--workdir", member_dir,
+                "--model", model, "--spans-out", spans_out,
+                "--serve-only", "--slo-off", "--ladder-slo-only",
+                "--ladder-escalate", str(ladder_escalate_s),
+                "--shed-staleness-ms", "60000",
+                "--batch-bucket", str(bucket),
+                "--trace-every", "1",
+            ]
+            if native:
+                cmd.append("--native")
+            env = dict(os.environ)
+            if not native:
+                env["JAX_PLATFORMS"] = "cpu"
+            procs.append(subprocess.Popen(
+                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=open(os.path.join(tmp, f"{mname}.stderr"), "w"),
+                env=env, text=True))
+
+        def read_msg(proc, key, timeout_s=240.0):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    raise SystemExit(
+                        f"router-soak member died (rc={proc.poll()}); "
+                        f"see {tmp}/m*.stderr")
+                try:
+                    msg = _json.loads(line)
+                except ValueError:
+                    continue
+                if key in msg:
+                    return msg
+            raise SystemExit(f"router-soak member: no {key!r} within "
+                             f"{timeout_s}s")
+
+        def send_cmd(idx: int, cmd: str, ack: bool = True):
+            procs[idx].stdin.write(cmd + "\n")
+            procs[idx].stdin.flush()
+            if ack:
+                read_msg(procs[idx], "ack", timeout_s=30.0)
+
+        boots = [read_msg(p, "ready") for p in procs]
+        rest_ports = [b["rest_port"] for b in boots]
+        grpc_ports = [b["grpc_port"] for b in boots]
+
+        router = StreamRouter(
+            [f"{m}=http://127.0.0.1:{rest_ports[i]}"
+             for i, m in enumerate(member_names)],
+            scrape_interval_s=scrape_interval_s,
+            max_moves_per_pass=n_members * streams_per_member,
+            # Drain poll/settle must cover a full CPU inference tick
+            # (~0.2-0.4s): a frame collected just before the stop lands
+            # on the src's counter up to one tick AFTER it first reads
+            # static, and a cursor read inside that window would resume
+            # the dst on an already-delivered packet (duplicate).
+            drain_timeout_s=5.0, drain_poll_s=0.5)
+        router.run_pass()                       # first health view
+        attach_errors = {k: v for k, v in router.attach().items() if v}
+
+        # Balanced initial placement by CONSTRUCTION of the names: walk
+        # candidate stream names and keep the first streams_per_member
+        # that consistent-hash onto each member — every member compiles
+        # its (single) device program during warmup, so neither fault
+        # leg's destination ever compiles on a migrated stream's frames.
+        per_member: dict = {m: [] for m in member_names}
+        cand = 0
+        while any(len(v) < streams_per_member for v in per_member.values()):
+            name = f"cam{cand:03d}"
+            cand += 1
+            owner = router.ring.place(name)
+            if owner and len(per_member[owner]) < streams_per_member:
+                per_member[owner].append(name)
+            if cand > 10_000:
+                raise SystemExit("hash search failed to balance placement")
+        stream_names = [n for m in member_names for n in per_member[m]]
+        # One long trace per stream: frames must OUTLAST the soak
+        # (loop/EOF-restart would re-deliver packet ids and fake a
+        # conservation violation).
+        for name in stream_names:
+            record_synthetic_trace(
+                os.path.join(tmp, f"{name}.vtrace"), [name],
+                width=width, height=height, fps=fps, gop=30,
+                frames=int(fps * 240))
+
+        # Per-member result consumers feed the router's conservation
+        # ledger: (stream, member, packet, trace_id) for every delivered
+        # InferenceResult — the client side of the lineage chain.
+        tids: dict = {m: {} for m in member_names}
+
+        def client(i: int) -> None:
+            mname = member_names[i]
+            channel = grpc.insecure_channel(f"127.0.0.1:{grpc_ports[i]}")
+            stub = pb_grpc.ImageStub(channel)
+            while not stop.is_set():
+                try:
+                    # NO deadline: a deadline-kicked re-subscribe loop
+                    # would miss the results emitted during each gap and
+                    # fake conservation-ledger losses. Streams keep
+                    # flowing until shutdown, so the stop flag is always
+                    # reached; a dead member raises instead.
+                    for res in stub.Inference(pb.InferenceRequest()):
+                        if stop.is_set():
+                            break
+                        if not res.device_id:
+                            continue
+                        router.ledger.note_delivery(
+                            res.device_id, mname, res.frame_packet,
+                            res.trace_id)
+                        if res.trace_id:
+                            tids[mname].setdefault(
+                                res.device_id, set()).add(res.trace_id)
+                except grpc.RpcError:
+                    if not stop.is_set():
+                        time.sleep(0.25)
+            channel.close()
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_members)]
+        for t in threads:
+            t.start()
+
+        for name in stream_names:
+            placed = router.add_stream(
+                name,
+                f"replay://{tmp}/{name}.vtrace?device={name}&pace=1&loop=0",
+                priority=stream_names.index(name))
+            assert placed in per_member and name in per_member[placed]
+
+        # Warmup: every stream delivering (worker boot + the one compile
+        # per member), then let the pipeline settle.
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            if all(router.ledger.next_cursor(n) is not None
+                   for n in stream_names):
+                break
+            time.sleep(0.25)
+        else:
+            raise SystemExit(
+                "warmup: not every stream delivered results; see "
+                f"{tmp}/m*.stderr")
+        time.sleep(2.0)
+        # Restart the conservation window at steady state: each stream's
+        # first delivery (the compile trigger) predates the ~20 frames
+        # latest-frame-wins overwrote during its member's compile, so
+        # the warmup ramp would read as losses. Post-reset the pipeline
+        # is lossless and every gap is a migration bug. Deliveries (and
+        # with them the migration cursors) repopulate within a frame
+        # interval — long before the burn leg's first migration.
+        router.ledger.reset()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(router.ledger.next_cursor(n) is not None
+                   for n in stream_names):
+                break
+            time.sleep(0.1)
+        router.start()                          # background control loop
+
+        # ---- burn leg: m0 burns; ladder must hand off BEFORE downshift.
+        burn_member = member_names[0]
+        burn_streams = list(per_member[burn_member])
+        send_cmd(0, "burn")
+        t_burn = time.monotonic()
+        deadline = t_burn + 2 * ladder_escalate_s + 3 * scrape_interval_s \
+            + 10.0
+        while time.monotonic() < deadline:
+            if not router.streams_on(burn_member):
+                break
+            time.sleep(0.05)
+        burn_evacuated = not router.streams_on(burn_member)
+        t_burn_done = time.monotonic()
+        # Ladder state AT migration completion — then calm immediately,
+        # before idle burn pressure walks the member any further.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rest_ports[0]}/api/v1/router",
+                timeout=5) as r:
+            burn_ladder = _json.loads(r.read())
+        send_cmd(0, "calm")
+        burn_transitions = burn_ladder.get("transitions", {})
+        # Wait out the ladder's recovery walk (one rung per
+        # recover_after_s): while the burn member still reports
+        # shed_to_fleet or above, the router would immediately re-shed
+        # any stream the kill leg evacuates onto it.
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rest_ports[0]}/api/v1/router",
+                    timeout=5) as r:
+                if _json.loads(r.read()).get("rung") in ("normal", "shed"):
+                    break
+            time.sleep(0.25)
+        time.sleep(3.0)                         # resumed streams deliver
+
+        # ---- kill leg: SIGKILL the last member; the router must
+        # re-place its streams within one scrape interval of detection.
+        kill_idx = n_members - 1
+        kill_member = member_names[kill_idx]
+        kill_streams = list(router.streams_on(kill_member))
+        procs[kill_idx].kill()   # by PID via Popen handle — never pkill
+        procs[kill_idx].wait(timeout=10)
+        t_kill = time.monotonic()
+        deadline = t_kill + 3 * scrape_interval_s + 10.0
+        while time.monotonic() < deadline:
+            if not router.streams_on(kill_member):
+                break
+            time.sleep(0.02)
+        kill_wall_s = time.monotonic() - t_kill
+        kill_evacuated = not router.streams_on(kill_member)
+        time.sleep(4.0)                         # resumed streams deliver
+
+        router.stop()
+        migrations = list(router.ledger.migrations)
+        kill_migs = [m for m in migrations if m["reason"] == "member_dead"]
+        burn_migs = [m for m in migrations
+                     if m["src"] == burn_member and m["ok"]]
+        kill_detect_s = max(
+            (m["replace_s"] for m in kill_migs if m.get("ok")),
+            default=None)
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        balance = router.ledger.balance()
+
+        # Release survivors -> span dumps; the killed member left none.
+        for i, p in enumerate(procs):
+            if i == kill_idx:
+                continue
+            try:
+                send_cmd(i, "exit", ack=False)
+                p.stdin.close()
+            except (BrokenPipeError, OSError):
+                pass
+        for i, p in enumerate(procs):
+            if i != kill_idx:
+                p.wait(timeout=60)
+
+        member_spans: dict = {}
+        for mname, path in zip(member_names, spans_paths):
+            if not os.path.exists(path):
+                member_spans[mname] = []
+                continue
+            with open(path) as f:
+                member_spans[mname] = _json.load(f).get("events", [])
+
+        def stitched(mname: str, stream: str) -> bool:
+            """A trace id with the full collect+device+emit span chain on
+            ``mname`` that ``mname``'s gRPC client also delivered for
+            ``stream`` — worker->bus->engine->client, one id."""
+            stages_by_tid: dict = {}
+            for ev in member_spans.get(mname, []):
+                tid = ev.get("trace_id")
+                if tid:
+                    stages_by_tid.setdefault(tid, set()).add(ev["stage"])
+            want = tids.get(mname, {}).get(stream, set())
+            return any({"collect", "device", "emit"} <= stages
+                       and tid in want
+                       for tid, stages in stages_by_tid.items())
+
+        lineage = []
+        for m in migrations:
+            if not m.get("ok"):
+                continue
+            row = {"stream": m["stream"], "src": m["src"],
+                   "dst": m["dst"], "reason": m["reason"],
+                   "dst_stitched": stitched(m["dst"], m["stream"])}
+            if (not row["dst_stitched"] and m["dst"] == kill_member
+                    and not member_spans.get(kill_member)):
+                # A burn-leg migration may land on the member the kill
+                # leg later SIGKILLs — the kill forfeits its span dump,
+                # so the on-wire trace ids its gRPC client DID deliver
+                # for the stream are the surviving lineage evidence.
+                row["dst_stitched"] = bool(
+                    tids.get(kill_member, {}).get(m["stream"]))
+                row["dst_evidence"] = \
+                    "client-delivered trace ids (span dump lost to kill)"
+            if m["src"] != kill_member:
+                row["src_stitched"] = stitched(m["src"], m["stream"])
+            lineage.append(row)
+        lineage_ok = bool(lineage) and all(
+            r["dst_stitched"] and r.get("src_stitched", True)
+            for r in lineage)
+
+        exposition = obs_registry.render()
+        lint_errors = lint_exposition(exposition)
+        router_families = sorted({
+            line.split()[2] for line in exposition.splitlines()
+            if line.startswith("# TYPE vep_router_")})
+
+        gates = {
+            "attach_clean": not attach_errors,
+            "burn_streams_evacuated": burn_evacuated and bool(burn_migs),
+            "burn_shed_to_fleet_before_downshift": (
+                burn_transitions.get("shed_to_fleet", 0) >= 1
+                and burn_transitions.get("bucket_downshift", 0) == 0),
+            "kill_streams_replaced": (
+                kill_evacuated and bool(kill_streams)
+                and all(m.get("ok") for m in kill_migs)),
+            "kill_replace_within_scrape": (
+                kill_detect_s is not None
+                and kill_detect_s <= scrape_interval_s),
+            "kill_replace_wall_bounded": (
+                kill_wall_s <= scrape_interval_s + 1.0),
+            "ledger_balanced": balance["balanced"],
+            "migrated_lineage_stitched": lineage_ok,
+            "router_metrics_lint_clean": (
+                not lint_errors and len(router_families) >= 6),
+        }
+        return {
+            "metric": f"fleet_router_{n_members}x{streams_per_member}_"
+                      f"{model}",
+            "pipeline": (
+                f"{n_members}x serve-only member <- StreamRouter "
+                "(consistent hash + burn/kill migration) <- per-member "
+                "gRPC clients -> conservation ledger"),
+            "members": n_members,
+            "streams": len(stream_names),
+            "fps": fps,
+            "model": model,
+            "scrape_interval_s": scrape_interval_s,
+            "ladder_escalate_s": ladder_escalate_s,
+            "gates": gates,
+            "placement": per_member,
+            "burn": {
+                "member": burn_member,
+                "streams": burn_streams,
+                "migrate_s": round(t_burn_done - t_burn, 3),
+                "transitions_at_migration": burn_transitions,
+                "ladder": burn_ladder,
+                "migrations": burn_migs,
+            },
+            "kill": {
+                "member": kill_member,
+                "streams": kill_streams,
+                "replace_detect_s": kill_detect_s,
+                "replace_wall_s": round(kill_wall_s, 3),
+                "migrations": kill_migs,
+            },
+            "ledger": {
+                "balanced": balance["balanced"],
+                "lost": balance["lost"],
+                "duplicated": balance["duplicated"],
+                "streams": balance["streams"],
+            },
+            "lineage": lineage,
+            "lint_errors": lint_errors[:10],
+            "router_families": router_families,
+            "router_snapshot": router.snapshot(),
+        }
+    finally:
+        stop.set()
+        if router is not None:
+            router.stop()
         for p in procs:
             if p.poll() is None:
                 p.kill()   # by PID via Popen handle — never pkill

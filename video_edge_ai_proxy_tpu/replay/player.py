@@ -13,12 +13,17 @@ Two consumers (ISSUE r6 tentpole part 2):
   for the lockstep determinism harness (replay/harness.py), which needs
   every frame delivered exactly once with no wall clock in the loop.
 
-URL: ``replay:///abs/path.vtrace?device=cam0&pace=1&loop=0``
+URL: ``replay:///abs/path.vtrace?device=cam0&pace=1&loop=0&start=0``
 ``device`` defaults to the trace's only stream (error if ambiguous);
 ``loop=1`` restarts at EOF instead of returning None (soaks longer than
 the trace); without it EOF falls into the worker's reconnect loop, which
 re-opens the source and replays from the start anyway — ``loop=0`` exists
-so bounded runs (tests) actually terminate.
+so bounded runs (tests) actually terminate. ``start=N`` (r16) skips the
+first N frame events and paces from the (N+1)-th arrival offset — the
+fleet router's migration "resume" leg: the destination member re-opens
+the stream at the source's handoff cursor, so recorded packet ids (and
+therefore the content-derived trace ids) stay disjoint across the
+handoff and the conservation ledger can prove exactly-once delivery.
 """
 
 from __future__ import annotations
@@ -99,6 +104,11 @@ class ReplaySource(VideoSource):
         self.device = q.get("device", "")
         self.pace = q.get("pace", "1") not in ("0", "false")
         self.loop = q.get("loop", "0") in ("1", "true")
+        try:
+            self.start = max(0, int(q.get("start", "0")))
+        except ValueError:
+            raise ValueError(
+                f"replay url start={q.get('start')!r} is not an integer")
         self._player: Optional[TracePlayer] = None
         self._events: list[dict] = []
         self._i = -1
@@ -118,10 +128,16 @@ class ReplaySource(VideoSource):
                     f"{self._player.devices}; pass ?device=<id>")
             self.device = self._player.devices[0]
         self._events = self._player.frame_events(self.device)
+        if self.start:
+            # Resume leg: replay from the handoff cursor. Pacing re-bases
+            # on the first REMAINING event below, so inter-arrival gaps
+            # after the cutover match the recording from that point.
+            self._events = self._events[self.start:]
         if not self._events:
             raise ConnectionError(
                 f"trace {self.trace_path} has no frames for "
-                f"device {self.device!r}")
+                f"device {self.device!r}"
+                + (f" at start={self.start}" if self.start else ""))
         info = self._player.stream_info(self.device) or {}
         first = self._events[0]
         shape = first.get("shape") or [
@@ -151,9 +167,17 @@ class ReplaySource(VideoSource):
             if delay > 0:
                 time.sleep(delay)
         self._cur = ev
+        # Trace events decode standalone (synth math / zlib round-trip),
+        # so a start= resume point is a legitimate decode entry even
+        # mid-GOP: report it as a keyframe. Without this the worker's
+        # lazy-decode valve (_should_decode) skips exactly the cursor
+        # packet — no client-activity stamp exists yet on a
+        # freshly-booted migration destination — and the conservation
+        # ledger reads a one-frame loss per handoff.
+        key = bool(ev["key"]) or (self._i == 0 and self.start > 0)
         return PacketInfo(
             packet=ev["packet"],
-            is_keyframe=ev["key"],
+            is_keyframe=key,
             pts=ev["pts"],
             dts=ev["dts"],
             timestamp_ms=int(time.time() * 1000),

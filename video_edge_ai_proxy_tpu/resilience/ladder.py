@@ -12,9 +12,21 @@ Rungs, in escalation order (each includes the previous):
 1. ``normal``           — nothing.
 2. ``shed``             — drop frames older than a staleness bound
                           before dispatch (oldest-first, per group).
-3. ``bucket_downshift`` — cap the collector's batch bucket at the
+3. ``shed_to_fleet``    — ask the FLEET ROUTER to move this engine's
+                          lowest-priority streams to healthy peers
+                          (serve/router.py scrapes ``vep_ladder_rung``
+                          and executes the drain→cutover→resume
+                          migration). Engine-side behavior is identical
+                          to ``shed``; the rung exists so horizontal
+                          re-placement engages BEFORE the local ladder
+                          starts shrinking device programs. Skipped
+                          entirely (the walk goes shed →
+                          bucket_downshift, same as pre-r16) unless a
+                          router registered via :meth:`register_fleet`
+                          — single-engine deployments never see it.
+4. ``bucket_downshift`` — cap the collector's batch bucket at the
                           next-smaller size so device programs shrink.
-4. ``admission_pause``  — pause admission for a deterministic half of
+5. ``admission_pause``  — pause admission for a deterministic half of
                           the streams; the rest keep their latency SLO.
 
 Pressure is ``queue_depth >= depth_threshold`` (drain backpressure),
@@ -45,7 +57,9 @@ log = logging.getLogger(__name__)
 
 __all__ = ["RUNGS", "DegradationLadder"]
 
-RUNGS = ("normal", "shed", "bucket_downshift", "admission_pause")
+RUNGS = ("normal", "shed", "shed_to_fleet", "bucket_downshift",
+         "admission_pause")
+_FLEET_IDX = RUNGS.index("shed_to_fleet")
 
 
 class DegradationLadder:
@@ -71,11 +85,17 @@ class DegradationLadder:
         self._rung = 0
         self._pressure_since: Optional[float] = None
         self._calm_since: Optional[float] = None
+        # Fleet-router hook (r16): None means the shed_to_fleet rung is
+        # skipped by the escalate/recover walk, preserving the exact
+        # pre-r16 rung sequence and timings for single-engine engines.
+        self._fleet_cb: Optional[Callable[[bool], None]] = None
+        self._fleet_info: Optional[Dict] = None
         #: transition counts by target rung name, for soak artifacts.
         self.transitions: Dict[str, int] = {}
         self._m_rung = obs_registry.gauge(
             "vep_ladder_rung",
-            "Engine degradation ladder rung (0=normal .. 3=admission_pause)",
+            "Engine degradation ladder rung (0=normal .. 4=admission_pause;"
+            " 2=shed_to_fleet only when a fleet router is attached)",
         ).labels()
         self._m_trans = obs_registry.counter(
             "vep_ladder_transitions_total", "Degradation ladder transitions", ("to",)
@@ -92,6 +112,43 @@ class DegradationLadder:
         self._m_rung.set(idx)
         self._m_trans.labels(name).inc()
 
+    def _step(self, direction: int) -> int:
+        """Next rung index one step in ``direction`` (+1 escalate /
+        -1 recover), skipping shed_to_fleet when no router is registered
+        so unrouted deployments keep the pre-r16 4-rung walk. Caller
+        holds self._lock."""
+        nxt = self._rung + direction
+        if nxt == _FLEET_IDX and self._fleet_cb is None:
+            nxt += direction
+        return nxt
+
+    # -- fleet router hook (r16) --
+
+    def register_fleet(self, callback: Callable[[bool], None],
+                       info: Optional[Dict] = None) -> None:
+        """Arm the shed_to_fleet rung. ``callback(active)`` fires with
+        True on entering the rung and False on leaving it (either
+        direction) — outside the ladder lock, exceptions swallowed; keep
+        it non-blocking (set a flag/gauge, wake a router thread).
+        ``info`` is surfaced verbatim in :meth:`snapshot` and the
+        /api/v1/router state route (who attached, from where)."""
+        with self._lock:
+            self._fleet_cb = callback
+            self._fleet_info = dict(info or {})
+
+    def unregister_fleet(self) -> None:
+        """Disarm shed_to_fleet (walk reverts to the 4-rung sequence).
+        If currently AT the rung, the next transition steps over it."""
+        with self._lock:
+            self._fleet_cb = None
+            self._fleet_info = None
+
+    @property
+    def fleet_info(self) -> Optional[Dict]:
+        with self._lock:
+            return dict(self._fleet_info) if self._fleet_info is not None \
+                else None
+
     def observe(self, *, queue_depth: int, tick_lag_s: float,
                 tick_budget_s: float, slo_burning: bool = False) -> str:
         """Feed one tick's pressure signals; returns the current rung name.
@@ -104,7 +161,9 @@ class DegradationLadder:
             or tick_lag_s > self.lag_factor * tick_budget_s
             or slo_burning
         )
+        fleet_edge: Optional[bool] = None
         with self._lock:
+            was_fleet = self._rung == _FLEET_IDX
             if pressure:
                 self._calm_since = None
                 if self._pressure_since is None:
@@ -113,7 +172,7 @@ class DegradationLadder:
                     now - self._pressure_since >= self.escalate_after_s
                     and self._rung < len(RUNGS) - 1
                 ):
-                    self._to(self._rung + 1)
+                    self._to(self._step(+1))
                     self._pressure_since = now
             else:
                 self._pressure_since = None
@@ -121,11 +180,20 @@ class DegradationLadder:
                     if self._calm_since is None:
                         self._calm_since = now
                     elif now - self._calm_since >= self.recover_after_s:
-                        self._to(self._rung - 1)
+                        self._to(self._step(-1))
                         self._calm_since = now
                 else:
                     self._calm_since = None
+            is_fleet = self._rung == _FLEET_IDX
+            if is_fleet != was_fleet:
+                fleet_edge = is_fleet
+            cb = self._fleet_cb
             rung = self._rung
+        if fleet_edge is not None and cb is not None:
+            try:
+                cb(fleet_edge)
+            except Exception:  # noqa: BLE001 — router hook must not kill ticks
+                log.exception("fleet shed callback failed")
         if self._watchdog is not None:
             # Watchdog opens one "degraded" episode across the whole
             # excursion and logs recovery when the ladder returns to normal.
@@ -149,4 +217,9 @@ class DegradationLadder:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"rung": RUNGS[self._rung], "transitions": dict(self.transitions)}
+            out = {"rung": RUNGS[self._rung],
+                   "transitions": dict(self.transitions),
+                   "fleet_attached": self._fleet_cb is not None}
+            if self._fleet_info is not None:
+                out["fleet"] = dict(self._fleet_info)
+            return out

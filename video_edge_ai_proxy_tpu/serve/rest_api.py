@@ -488,6 +488,69 @@ def build_app(
     app.router.add_get("/api/v1/fleet/stats", fleet_stats)
     app.router.add_get("/api/v1/fleet/metrics", fleet_metrics)
 
+    def _ladder_or_error():
+        """Router surface preconditions (r16): the routes manipulate the
+        degradation ladder's fleet hook, so they need a running engine
+        with the ladder enabled — 400 otherwise, same kill-switch
+        convention as /api/v1/slo."""
+        if engine is None:
+            return None, _error(400, "engine not running")
+        if engine.ladder is None:
+            return None, _error(
+                400, "degradation ladder disabled (engine.ladder config)")
+        return engine.ladder, None
+
+    async def router_attach(request: web.Request) -> web.Response:
+        """Fleet router arms this member's shed_to_fleet rung
+        (serve/router.py r16). The registered callback mirrors the rung
+        edge into ``vep_fleet_shed_active`` so the router's scrape loop
+        (and any Prometheus alert) sees the shed *request* without a
+        second RPC; the router executes the actual migration."""
+        ladder, err = _ladder_or_error()
+        if err is not None:
+            return err
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return _error(400, "JSON object body expected")
+        shed_gauge = obs_registry.gauge(
+            "vep_fleet_shed_active",
+            "1 while the ladder sits at shed_to_fleet asking the fleet "
+            "router to move streams away").labels()
+        shed_gauge.set(0)
+        ladder.register_fleet(
+            lambda active: shed_gauge.set(1 if active else 0),
+            {"router": str(body.get("router", "")),
+             "url": str(body.get("url", "")),
+             "via": "rest"},
+        )
+        return web.json_response(ladder.snapshot())
+
+    async def router_detach(_request: web.Request) -> web.Response:
+        ladder, err = _ladder_or_error()
+        if err is not None:
+            return err
+        ladder.unregister_fleet()
+        obs_registry.gauge(
+            "vep_fleet_shed_active",
+            "1 while the ladder sits at shed_to_fleet asking the fleet "
+            "router to move streams away").labels().set(0)
+        return web.json_response(ladder.snapshot())
+
+    async def router_state(_request: web.Request) -> web.Response:
+        """Who (if anyone) is routing this member + the live ladder
+        rung/transition view the router reasons about."""
+        ladder, err = _ladder_or_error()
+        if err is not None:
+            return err
+        return web.json_response(ladder.snapshot())
+
+    app.router.add_post("/api/v1/router/attach", router_attach)
+    app.router.add_post("/api/v1/router/detach", router_detach)
+    app.router.add_get("/api/v1/router", router_state)
+
     async def options(_request: web.Request) -> web.Response:
         return web.Response(status=204)
 

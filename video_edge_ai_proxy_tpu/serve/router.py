@@ -1,0 +1,791 @@
+"""Fleet router: consistent-hash stream placement + burn-driven,
+lineage-verified live migration (ROADMAP item 3; MultiStream, arxiv
+2207.06078).
+
+No reference counterpart: the reference is strictly single-box (one
+Chrysalis server owns every camera). This module turns N independent
+engine members into ONE serving fleet:
+
+- :class:`HashRing` — consistent hashing with health-weighted virtual
+  nodes. Placement is stable (adding/removing a member moves ~1/N of
+  the keys, tests/test_router.py pins it) and deterministic (FNV-1a over
+  ``member#vnode`` / stream name, no process-seeded hashing).
+- :class:`MemberClient` — stdlib-urllib REST client for one member
+  (start/stop stream, stats), guarded by a per-member
+  :class:`~..resilience.breaker.CircuitBreaker` so a dead member fails
+  fast instead of stalling every control-loop pass on timeouts.
+- :class:`MigrationLedger` — the frame-conservation proof plane. The
+  result consumer feeds every delivery (``stream, member, packet``,
+  joined by the r14 on-wire ``trace_id``); :meth:`MigrationLedger.balance`
+  then proves exactly-once across a handoff: delivered packets form a
+  gap-free run from the first delivery with zero duplicates, even when
+  delivery crossed members mid-stream.
+- :class:`StreamRouter` — the control loop. One pass per scrape
+  interval: scrape members (its private
+  :class:`~..obs.fleet.FleetAggregator`), rebuild the ring from the
+  hysteresis-banded ``healthy`` verdicts (obs/fleet.py r16), fail over
+  every stream of a DEAD member immediately, and gracefully migrate
+  streams OFF a member whose SLO burn fired or whose ladder reached
+  ``shed_to_fleet`` (resilience/ladder.py r16 rung — armed on the member
+  by :meth:`StreamRouter.attach`, so a burning engine sheds streams to
+  healthy peers BEFORE its local ladder starts shrinking device
+  programs).
+
+Migration is an explicit drain→cutover→resume protocol:
+
+1. **drain** — stop ingest on the source member and poll its per-stream
+   stats until the emitted-frame counter is static (everything the
+   worker published has left the engine);
+2. **cutover** — flip the stream's placement in the router registry;
+3. **resume** — start the stream on the destination with the replay
+   cursor (``replay://...&start=<next>``) from ``cursor_source`` — the
+   result plane's next-undelivered index — so recorded packet ids (and
+   the content-derived trace ids minted from them) stay disjoint across
+   the handoff. A killed member skips (1): the replay-from-cursor resume
+   re-produces exactly the frames that died in flight.
+
+jax-free and importable without a backend by design (stdlib + the pure
+Python obs/resilience planes only): the router runs as its own process
+(``python -m video_edge_ai_proxy_tpu.serve.router``) in front of the
+members' gRPC/REST, never inside one.
+
+Metric families (obs registry, lint-clean under ``lint_exposition``):
+
+- ``vep_router_members`` / ``vep_router_ring_members`` — configured vs
+  currently-placeable members
+- ``vep_router_streams`` — streams under management
+- ``vep_router_placements_total{member}`` — stream starts per member
+- ``vep_router_migrations_total{reason}`` — reason in
+  ``member_dead | shed_to_fleet | slo_burn | unhealthy | admin``
+- ``vep_router_migration_failures_total{reason}``
+- ``vep_router_replace_seconds`` — detection→resumed latency histogram
+  (the kill-one-member acceptance number)
+- ``vep_router_ledger_lost_frames`` / ``vep_router_ledger_dup_frames``
+  — conservation ledger verdict gauges (0/0 = balanced)
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs, urlencode, urlsplit, urlunsplit
+
+from ..obs import registry as obs_registry
+from ..obs.fleet import FleetAggregator
+from ..resilience.breaker import BreakerOpen, CircuitBreaker
+from ..resilience.ladder import RUNGS
+
+log = logging.getLogger(__name__)
+
+__all__ = ["HashRing", "MemberClient", "MigrationLedger", "StreamRouter"]
+
+_FLEET_RUNG_IDX = RUNGS.index("shed_to_fleet")
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+
+
+def _hash64(key: str) -> int:
+    """FNV-1a 64-bit + a splitmix64 finalizer — deterministic across
+    processes/runs (placement must not depend on PYTHONHASHSEED), same
+    hash family as the r14 on-wire trace ids. The avalanche pass
+    matters: raw FNV of short keys ("m0#17") clusters on the ring and
+    can starve a member of its share entirely."""
+    h = _FNV_OFFSET
+    for b in key.encode():
+        h = ((h ^ b) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    h = ((h ^ (h >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    h = ((h ^ (h >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return h ^ (h >> 31)
+
+
+class HashRing:
+    """Consistent-hash ring with weighted virtual nodes.
+
+    ``weight`` scales a member's virtual-node count (``base_vnodes`` at
+    weight 1.0, floor 1) — the router quantizes health scores into
+    coarse weight bands before calling :meth:`set_weight`, so only a
+    banded health change (not per-scrape score noise) re-shapes the
+    ring. Not thread-safe; the router mutates it under its own lock.
+    """
+
+    def __init__(self, base_vnodes: int = 64):
+        if base_vnodes < 1:
+            raise ValueError(f"base_vnodes must be >= 1, got {base_vnodes}")
+        self.base_vnodes = int(base_vnodes)
+        self._weights: Dict[str, float] = {}
+        self._points: List[Tuple[int, str]] = []   # sorted (hash, member)
+        self._hashes: List[int] = []
+
+    def _rebuild(self) -> None:
+        points: List[Tuple[int, str]] = []
+        for member, weight in self._weights.items():
+            vnodes = max(1, int(round(self.base_vnodes * weight)))
+            for i in range(vnodes):
+                points.append((_hash64(f"{member}#{i}"), member))
+        points.sort()
+        self._points = points
+        self._hashes = [h for h, _ in points]
+
+    def add(self, member: str, weight: float = 1.0) -> None:
+        self._weights[member] = max(0.0, float(weight))
+        self._rebuild()
+
+    def remove(self, member: str) -> None:
+        if self._weights.pop(member, None) is not None:
+            self._rebuild()
+
+    def set_weight(self, member: str, weight: float) -> None:
+        if member not in self._weights:
+            raise KeyError(member)
+        if self._weights[member] != weight:
+            self._weights[member] = max(0.0, float(weight))
+            self._rebuild()
+
+    @property
+    def members(self) -> List[str]:
+        return sorted(self._weights)
+
+    def place(self, key: str, exclude: Sequence[str] = ()) -> Optional[str]:
+        """First member clockwise from hash(key), skipping ``exclude``
+        (the failover path excludes the member being evacuated). None
+        when the ring is empty or fully excluded."""
+        if not self._points:
+            return None
+        excluded = set(exclude)
+        start = bisect.bisect_right(self._hashes, _hash64(key))
+        n = len(self._points)
+        seen: set = set()
+        for off in range(n):
+            member = self._points[(start + off) % n][1]
+            if member in excluded or member in seen:
+                seen.add(member)
+                continue
+            return member
+        return None
+
+
+class MemberClient:
+    """REST client for one engine member, breaker-guarded.
+
+    Every call goes through the member's :class:`CircuitBreaker`
+    (``vep_breaker_state{dep="router_<member>"}``): after
+    ``failure_threshold`` consecutive faults the router fails fast on
+    this member — no connect timeouts burning the control loop — and a
+    half-open probe re-admits it. Timeouts are short: the router's pass
+    must complete well inside one scrape interval.
+    """
+
+    def __init__(self, name: str, base_url: str, *, timeout_s: float = 2.0,
+                 failure_threshold: int = 3, recovery_timeout_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self.breaker = CircuitBreaker(
+            f"router_{name}", failure_threshold=failure_threshold,
+            recovery_timeout_s=recovery_timeout_s, clock=clock)
+
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> bytes:
+        import urllib.request
+
+        def call() -> bytes:
+            req = urllib.request.Request(
+                self.base_url + path, method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+                headers={"Content-Type": "application/json"}
+                if body is not None else {})
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.read()
+
+        return self.breaker.call(call)
+
+    # -- member control surface (serve/rest_api.py routes) --
+
+    def start_stream(self, name: str, rtsp_endpoint: str,
+                     inference_model: str = "",
+                     annotation_policy: str = "") -> None:
+        self._request("POST", "/api/v1/process", {
+            "name": name, "rtsp_endpoint": rtsp_endpoint,
+            "inference_model": inference_model,
+            "annotation_policy": annotation_policy,
+        })
+
+    def stop_stream(self, name: str) -> None:
+        self._request("DELETE", f"/api/v1/process/{name}")
+
+    def stats(self) -> dict:
+        return json.loads(self._request("GET", "/api/v1/stats"))
+
+    def stream_frames(self, name: str) -> Optional[int]:
+        """Emitted-frame count for one stream from /api/v1/stats (the
+        drain probe: static count == engine drained), None when the
+        engine no longer reports it."""
+        eng = (self.stats() or {}).get("engine") or {}
+        st = (eng.get("streams") or {}).get(name)
+        return int(st["frames"]) if st and "frames" in st else None
+
+    def attach_router(self, router: str, url: str = "") -> dict:
+        return json.loads(self._request(
+            "POST", "/api/v1/router/attach",
+            {"router": router, "url": url}))
+
+    def detach_router(self) -> None:
+        self._request("POST", "/api/v1/router/detach")
+
+
+class MigrationLedger:
+    """Frame-conservation accounting across live migrations.
+
+    The result consumer calls :meth:`note_delivery` for every
+    ``InferenceResult`` it receives (``frame_packet`` + the member it
+    subscribed; the on-wire ``trace_id`` ties the entry back to the
+    frame's worker→bus→engine lineage). :meth:`balance` then checks the
+    exactly-once invariant per stream: delivered packet ids form one
+    gap-free run from the FIRST delivered packet (warmup ramp before
+    first delivery is placement, not migration, and is excluded by
+    construction) with no packet delivered twice — across however many
+    members served the stream.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # stream -> packet -> [members...] (len > 1 == duplicate)
+        self._seen: Dict[str, Dict[int, List[str]]] = {}
+        self.migrations: List[dict] = []
+        self._m_lost = obs_registry.gauge(
+            "vep_router_ledger_lost_frames",
+            "Conservation ledger: packets missing inside the delivered "
+            "range, all streams (0 = balanced)").labels()
+        self._m_dup = obs_registry.gauge(
+            "vep_router_ledger_dup_frames",
+            "Conservation ledger: packets delivered more than once, all "
+            "streams (0 = balanced)").labels()
+
+    def note_delivery(self, stream: str, member: str, packet: int,
+                      trace_id: int = 0) -> None:
+        with self._lock:
+            owners = self._seen.setdefault(stream, {}).setdefault(
+                int(packet), [])
+            owners.append(member)
+
+    def record_migration(self, entry: dict) -> None:
+        with self._lock:
+            self.migrations.append(dict(entry))
+
+    def reset(self) -> None:
+        """Drop recorded deliveries; the conservation window restarts at
+        the next delivery per stream. Soaks call this after warmup: a
+        stream's FIRST frame is only delivered after the compile it
+        triggers, so it anchors the baseline while the frames that
+        arrived DURING the compile were overwritten (latest-frame-wins)
+        and would read as losses. Post-reset steady state is lossless,
+        leaving any later gap attributable to a handoff."""
+        with self._lock:
+            self._seen.clear()
+
+    def next_cursor(self, stream: str) -> Optional[int]:
+        """Next undelivered packet index (max delivered + 1) — the
+        resume cursor for a replay-backed stream. None before any
+        delivery."""
+        with self._lock:
+            seen = self._seen.get(stream)
+            return (max(seen) + 1) if seen else None
+
+    def balance(self, stream: Optional[str] = None) -> dict:
+        """Conservation verdict. ``stream`` None checks every stream.
+        ``balanced`` is True iff zero lost AND zero duplicated."""
+        with self._lock:
+            streams = ([stream] if stream is not None
+                       else sorted(self._seen))
+            rows = []
+            total_lost = total_dup = 0
+            for s in streams:
+                seen = self._seen.get(s, {})
+                if not seen:
+                    rows.append({"stream": s, "delivered": 0,
+                                 "lost": 0, "duplicated": 0})
+                    continue
+                lo, hi = min(seen), max(seen)
+                missing = [p for p in range(lo, hi + 1) if p not in seen]
+                dups = {p: owners for p, owners in seen.items()
+                        if len(owners) > 1}
+                members = sorted({m for owners in seen.values()
+                                  for m in owners})
+                total_lost += len(missing)
+                total_dup += sum(len(o) - 1 for o in dups.values())
+                rows.append({
+                    "stream": s, "delivered": len(seen),
+                    "range": [lo, hi], "members": members,
+                    "lost": len(missing), "missing": missing[:20],
+                    "duplicated": sum(len(o) - 1 for o in dups.values()),
+                    "dup_examples": dict(sorted(dups.items())[:5]),
+                })
+        self._m_lost.set(total_lost)
+        self._m_dup.set(total_dup)
+        return {"balanced": total_lost == 0 and total_dup == 0,
+                "lost": total_lost, "duplicated": total_dup,
+                "streams": rows}
+
+
+class StreamRouter:
+    """Consistent-hash placement + health-driven re-placement over N
+    engine members.
+
+    ``members``: ``"name=http://host:port"`` specs (FleetAggregator
+    syntax). ``cursor_source(stream)`` returns the next-undelivered
+    packet index for a replay-backed stream (defaults to the router's
+    own ledger when deliveries are fed to it; None disables cursor
+    resume — live sources re-attach at "now", at-least-once).
+    ``client_factory`` is injectable for tests (scripted members, no
+    sockets). The clock is injectable so migration tests run sleep-free.
+    """
+
+    def __init__(
+        self,
+        members: Sequence[str],
+        *,
+        scrape_interval_s: float = 1.0,
+        base_vnodes: int = 64,
+        max_moves_per_pass: int = 2,
+        min_healthy_age_s: float = 0.0,
+        drain_timeout_s: float = 8.0,
+        drain_poll_s: float = 0.25,
+        ema_alpha: float = 0.4,
+        healthy_above: float = 0.7,
+        unhealthy_below: float = 0.4,
+        cursor_source: Optional[Callable[[str], Optional[int]]] = None,
+        client_factory: Optional[Callable[[str, str], MemberClient]] = None,
+        fleet: Optional[FleetAggregator] = None,
+        name: str = "router0",
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.name = name
+        self._clock = clock
+        self._sleep = sleep
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.max_moves_per_pass = int(max_moves_per_pass)
+        self.min_healthy_age_s = float(min_healthy_age_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.drain_poll_s = float(drain_poll_s)
+        self.fleet = fleet or FleetAggregator(
+            members, scrape_interval_s=scrape_interval_s,
+            ema_alpha=ema_alpha, healthy_above=healthy_above,
+            unhealthy_below=unhealthy_below)
+        factory = client_factory or (
+            lambda n, url: MemberClient(n, url, clock=clock))
+        self.clients: Dict[str, MemberClient] = {
+            m.name: factory(m.name, m.base_url)
+            for m in self.fleet._members}
+        self.ring = HashRing(base_vnodes=base_vnodes)
+        self.ledger = MigrationLedger()
+        self._cursor_source = cursor_source or self.ledger.next_cursor
+        self._lock = threading.RLock()
+        # stream -> {url, model, policy, priority, member, placed_at,
+        #            migrations}
+        self._streams: Dict[str, dict] = {}
+        self._evacuated: Dict[str, float] = {}   # member -> detect time
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.passes = 0
+        self.last_replace_s: Optional[float] = None
+        self._m_members = obs_registry.gauge(
+            "vep_router_members", "Configured fleet members").labels()
+        self._m_ring = obs_registry.gauge(
+            "vep_router_ring_members",
+            "Members currently in the placement ring (healthy, breaker "
+            "closed)").labels()
+        self._m_streams = obs_registry.gauge(
+            "vep_router_streams", "Streams under router management"
+        ).labels()
+        self._m_placements = obs_registry.counter(
+            "vep_router_placements_total",
+            "Stream starts issued per member", ("member",))
+        self._m_migrations = obs_registry.counter(
+            "vep_router_migrations_total",
+            "Completed live migrations by trigger", ("reason",))
+        self._m_mig_fail = obs_registry.counter(
+            "vep_router_migration_failures_total",
+            "Migrations that failed (stream left unplaced or on source)",
+            ("reason",))
+        self._m_replace = obs_registry.histogram(
+            "vep_router_replace_seconds",
+            "Detection-to-resumed latency of a re-placement").labels()
+        self._m_members.set(len(self.clients))
+        self._m_streams.set(0)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def attach(self) -> Dict[str, Optional[str]]:
+        """Arm the shed_to_fleet rung on every reachable member (POST
+        /api/v1/router/attach). Members without an engine/ladder answer
+        400 — recorded, not fatal (a member booted engine-less can still
+        take streams; it just never *requests* shedding)."""
+        out: Dict[str, Optional[str]] = {}
+        for name, client in sorted(self.clients.items()):
+            try:
+                client.attach_router(self.name, "")
+                out[name] = None
+            except Exception as e:  # noqa: BLE001 — per-member fault
+                out[name] = f"{type(e).__name__}: {e}"
+        return out
+
+    def detach(self) -> None:
+        for client in self.clients.values():
+            try:
+                client.detach_router()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="stream-router", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.drain_timeout_s + 5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_pass()
+            except Exception:  # noqa: BLE001 — control loop must survive
+                log.exception("router pass failed")
+            self._stop.wait(self.scrape_interval_s)
+
+    # -- placement ---------------------------------------------------------
+
+    def _refresh_ring(self, health: List[dict]) -> None:
+        """Rebuild ring membership/weights from the hysteresis-banded
+        health view. Weight = score_ema quantized to quarter bands, so
+        only a banded change re-shapes the ring (flap containment on top
+        of the aggregator's own hysteresis)."""
+        with self._lock:
+            current = set(self.ring.members)
+            for row in health:
+                member = row["instance"]
+                ok = (row["up"] and not row["stale"]
+                      and row.get("healthy", True) is not False
+                      and self.clients[member].breaker.state != "open")
+                if ok and self.min_healthy_age_s > 0.0:
+                    age = row.get("healthy_since_s")
+                    if age is not None and age < self.min_healthy_age_s \
+                            and member not in current:
+                        ok = False   # too fresh to take traffic
+                ema = row.get("score_ema")
+                band = (max(1.0, round((ema if ema is not None else 1.0)
+                                       * 4) ) / 4.0)
+                if ok and member not in current:
+                    self.ring.add(member, band)
+                elif ok:
+                    self.ring.set_weight(member, band)
+                elif member in current:
+                    self.ring.remove(member)
+            self._m_ring.set(len(self.ring.members))
+
+    def add_stream(self, name: str, rtsp_endpoint: str, *,
+                   priority: int = 0, inference_model: str = "",
+                   annotation_policy: str = "") -> str:
+        """Place a new stream on the ring and start it there. Returns
+        the member name. Raises RuntimeError when no member is
+        placeable."""
+        with self._lock:
+            if name in self._streams:
+                raise ValueError(f"stream {name!r} already routed")
+            member = self.ring.place(name)
+            if member is None:
+                raise RuntimeError(
+                    "no placeable member (ring empty — all members dead, "
+                    "unhealthy, or breaker-open)")
+            self.clients[member].start_stream(
+                name, rtsp_endpoint, inference_model, annotation_policy)
+            self._streams[name] = {
+                "url": rtsp_endpoint, "model": inference_model,
+                "policy": annotation_policy, "priority": int(priority),
+                "member": member, "placed_at": self._clock(),
+                "migrations": 0,
+            }
+            self._m_placements.labels(member).inc()
+            self._m_streams.set(len(self._streams))
+            return member
+
+    def remove_stream(self, name: str) -> None:
+        with self._lock:
+            rec = self._streams.pop(name, None)
+            self._m_streams.set(len(self._streams))
+        if rec is not None:
+            try:
+                self.clients[rec["member"]].stop_stream(name)
+            except Exception:  # noqa: BLE001 — member may already be gone
+                log.warning("stop of %s on %s failed", name, rec["member"])
+
+    def streams_on(self, member: str) -> List[str]:
+        """This member's streams, lowest priority first (shed order)."""
+        with self._lock:
+            rows = [(rec["priority"], n) for n, rec in self._streams.items()
+                    if rec["member"] == member]
+        return [n for _, n in sorted(rows)]
+
+    # -- migration protocol ------------------------------------------------
+
+    def _resume_url(self, url: str, cursor: Optional[int]) -> str:
+        """Rewrite a replay:// url's ``start`` to the handoff cursor;
+        any other scheme (a live camera has no cursor) passes through."""
+        if cursor is None or not url.startswith("replay://"):
+            return url
+        parts = urlsplit(url)
+        q = {k: v[-1] for k, v in parse_qs(parts.query).items()}
+        q["start"] = str(int(cursor))
+        return urlunsplit(parts._replace(query=urlencode(q)))
+
+    def _drain(self, client: MemberClient, stream: str,
+               deadline: float) -> bool:
+        """Poll the source's per-stream emitted-frame counter until it
+        is static across two polls (engine drained everything the worker
+        published) or the stream vanishes from stats."""
+        last: Optional[int] = None
+        while self._clock() < deadline:
+            try:
+                frames = client.stream_frames(stream)
+            except Exception:  # noqa: BLE001 — source died mid-drain
+                return False
+            if frames is None or frames == last:
+                return True
+            last = frames
+            self._sleep(self.drain_poll_s)
+        return False
+
+    def migrate(self, stream: str, *, reason: str = "admin",
+                dst: Optional[str] = None, graceful: bool = True,
+                detected_at: Optional[float] = None) -> Optional[str]:
+        """drain→cutover→resume one stream. ``graceful=False`` is the
+        dead-member path (source unreachable: no stop, no drain — the
+        cursor resume re-produces the frames that died in flight).
+        Returns the destination member, or None on failure (stream stays
+        registered; the next pass retries)."""
+        t_detect = detected_at if detected_at is not None else self._clock()
+        with self._lock:
+            rec = self._streams.get(stream)
+            if rec is None:
+                raise KeyError(stream)
+            src = rec["member"]
+            if dst is None:
+                dst = self.ring.place(stream, exclude=(src,))
+        if dst is None or dst == src:
+            self._m_mig_fail.labels(reason).inc()
+            log.warning("no migration target for %s (src=%s)", stream, src)
+            return None
+        entry = {"stream": stream, "src": src, "dst": dst,
+                 "reason": reason, "graceful": bool(graceful)}
+        drained = False
+        if graceful:
+            try:
+                self.clients[src].stop_stream(stream)
+                drained = self._drain(
+                    self.clients[src], stream,
+                    self._clock() + self.drain_timeout_s)
+            except Exception:  # noqa: BLE001 — fall through
+                # Source died mid-drain: continue on the dead-member path
+                # (cursor resume covers the in-flight tail).
+                log.warning("drain of %s on %s failed; cursor resume",
+                            stream, src)
+            if drained:
+                # Settle one poll interval so results the engine emitted
+                # right before going static finish their subscriber push
+                # — the cursor read next must see every delivery, or the
+                # resume leg would re-produce an already-delivered frame.
+                self._sleep(self.drain_poll_s)
+        entry["drained"] = drained
+        cursor = None
+        try:
+            cursor = self._cursor_source(stream)
+        except Exception:  # noqa: BLE001 — cursor plane optional
+            log.exception("cursor source failed for %s", stream)
+        entry["cursor"] = cursor
+        try:
+            self.clients[dst].start_stream(
+                stream, self._resume_url(rec["url"], cursor),
+                rec["model"], rec["policy"])
+        except Exception as e:  # noqa: BLE001 — destination refused
+            self._m_mig_fail.labels(reason).inc()
+            entry.update(ok=False, error=f"{type(e).__name__}: {e}")
+            self.ledger.record_migration(entry)
+            return None
+        t_done = self._clock()
+        with self._lock:
+            rec["member"] = dst
+            rec["placed_at"] = t_done
+            rec["migrations"] += 1
+        replace_s = max(0.0, t_done - t_detect)
+        self.last_replace_s = replace_s
+        self._m_replace.observe(replace_s)
+        self._m_migrations.labels(reason).inc()
+        self._m_placements.labels(dst).inc()
+        entry.update(ok=True, replace_s=round(replace_s, 4))
+        self.ledger.record_migration(entry)
+        log.info("migrated %s: %s -> %s (%s, %.2fs, cursor=%s)",
+                 stream, src, dst, reason, replace_s, cursor)
+        return dst
+
+    # -- the control loop --------------------------------------------------
+
+    def run_pass(self) -> dict:
+        """One scrape→decide→act pass (the background loop calls this
+        every scrape interval; tests call it directly). Dead members
+        fail over every stream this same pass — re-placement latency is
+        bounded by one scrape interval by construction."""
+        self.fleet.scrape_once()
+        health = self.fleet.health()
+        t_pass = self._clock()
+        self._refresh_ring(health)
+        moved: List[dict] = []
+        by_name = {row["instance"]: row for row in health}
+        # 1) dead members: evacuate everything, immediately.
+        for member, row in sorted(by_name.items()):
+            if row["up"] and not row["stale"]:
+                self._evacuated.pop(member, None)
+                continue
+            detect = self._evacuated.setdefault(member, t_pass)
+            for stream in self.streams_on(member):
+                dst = self.migrate(stream, reason="member_dead",
+                                   graceful=False, detected_at=detect)
+                moved.append({"stream": stream, "dst": dst,
+                              "reason": "member_dead"})
+        # 2) shedding members: burn fired, ladder reached shed_to_fleet,
+        #    or the hysteresis band flipped unhealthy — move the
+        #    lowest-priority streams to healthy peers, bounded per pass
+        #    (a burning member drains gradually, not in one stampede).
+        budget = self.max_moves_per_pass
+        for member, row in sorted(by_name.items()):
+            if budget <= 0:
+                break
+            if not row["up"] or row["stale"]:
+                continue
+            shedding = (
+                bool(row.get("slo_burning"))
+                or float(row.get("ladder_rung") or 0.0) >= _FLEET_RUNG_IDX
+                or row.get("healthy") is False
+            )
+            if not shedding:
+                continue
+            reason = ("slo_burn" if row.get("slo_burning")
+                      else "shed_to_fleet"
+                      if float(row.get("ladder_rung") or 0.0)
+                      >= _FLEET_RUNG_IDX else "unhealthy")
+            for stream in self.streams_on(member)[:budget]:
+                dst = self.migrate(stream, reason=reason,
+                                   detected_at=t_pass)
+                moved.append({"stream": stream, "dst": dst,
+                              "reason": reason})
+                budget -= 1
+        self.passes += 1
+        return {"health": health, "moved": moved,
+                "ring": self.ring.members}
+
+    # -- admin -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            streams = {n: dict(rec) for n, rec in self._streams.items()}
+        return {
+            "name": self.name,
+            "members": sorted(self.clients),
+            "ring": self.ring.members,
+            "passes": self.passes,
+            "scrape_interval_s": self.scrape_interval_s,
+            "streams": streams,
+            "breakers": {n: c.breaker.snapshot()
+                         for n, c in sorted(self.clients.items())},
+            "migrations": list(self.ledger.migrations),
+            "last_replace_s": self.last_replace_s,
+            "health": self.fleet.health(),
+        }
+
+
+def main(argv=None) -> None:
+    """Standalone router process: place streams across members, watch
+    health, migrate on burn/death; admin plane on stdlib http.server.
+
+    Usage::
+
+      python -m video_edge_ai_proxy_tpu.serve.router \\
+          --members m0=http://h0:8080 m1=http://h1:8080 --port 9091 \\
+          --stream cam0=rtsp://... --stream cam1=replay:///t.vtrace?...
+    """
+    import argparse
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--members", nargs="+", required=True,
+                    help="member specs: name=http://host:port")
+    ap.add_argument("--stream", action="append", default=[],
+                    help="stream spec: name=<rtsp/replay url> "
+                         "(repeatable)")
+    ap.add_argument("--port", type=int, default=9091)
+    ap.add_argument("--scrape-interval", type=float, default=1.0)
+    ap.add_argument("--vnodes", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    router = StreamRouter(
+        args.members, scrape_interval_s=args.scrape_interval,
+        base_vnodes=args.vnodes)
+    router.run_pass()           # first placement view before streams land
+    attach = router.attach()
+    for spec in args.stream:
+        name, sep, url = spec.partition("=")
+        if not sep:
+            raise SystemExit(f"--stream {spec!r}: expected name=url")
+        member = router.add_stream(name, url)
+        print(json.dumps({"placed": name, "member": member}), flush=True)
+    router.start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            path = self.path.split("?")[0]
+            if path == "/metrics":
+                body = obs_registry.render().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif path == "/api/v1/router/stats":
+                body = json.dumps(router.snapshot()).encode()
+                ctype = "application/json"
+            elif path == "/api/v1/router/ledger":
+                body = json.dumps(router.ledger.balance()).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
+    print(json.dumps({"router": router.name, "port": srv.server_port,
+                      "members": sorted(router.clients),
+                      "attach_errors": {k: v for k, v in attach.items()
+                                        if v}}), flush=True)
+    try:
+        srv.serve_forever()
+    finally:
+        router.stop()
+        router.detach()
+
+
+if __name__ == "__main__":
+    main()

@@ -44,7 +44,10 @@ def make_admin_handler(engine) -> grpc.GenericRpcHandler:
     raw: ``channel.unary_unary("/vep.Admin/ProfileCapture")(b'{"ms":500}')``
     -> bundle manifest JSON (= ``POST /api/v1/profile?ms=N``), or
     ``channel.unary_unary("/vep.Admin/Quality")(b"")`` -> the quality
-    snapshot JSON (= ``GET /api/v1/quality``). Status mapping mirrors
+    snapshot JSON (= ``GET /api/v1/quality``), or
+    ``channel.unary_unary("/vep.Admin/RouterState")(b"")`` -> the
+    degradation-ladder/fleet-router attachment JSON (= ``GET
+    /api/v1/router``). Status mapping mirrors
     REST: INVALID_ARGUMENT for a bad duration (=400),
     FAILED_PRECONDITION when the subsystem is kill-switched (=the 400
     disabled-endpoint answer), ABORTED when a capture is already in
@@ -89,6 +92,17 @@ def make_admin_handler(engine) -> grpc.GenericRpcHandler:
                         if engine.canary is not None else None)
         return json.dumps(out).encode()
 
+    def router_state(request: bytes, context):
+        """Ladder rung + fleet-router attachment view (r16; = ``GET
+        /api/v1/router``): which router (if any) armed shed_to_fleet on
+        this member, current rung, transition counts."""
+        if engine is None or engine.ladder is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "degradation ladder disabled (engine.ladder config)",
+            )
+        return json.dumps(engine.ladder.snapshot()).encode()
+
     # Identity serializers: the wire format IS the JSON bytes.
     def _rpc(fn):
         return grpc.unary_unary_rpc_method_handler(
@@ -99,7 +113,8 @@ def make_admin_handler(engine) -> grpc.GenericRpcHandler:
 
     return grpc.method_handlers_generic_handler(
         "vep.Admin", {"ProfileCapture": _rpc(profile_capture),
-                      "Quality": _rpc(quality)}
+                      "Quality": _rpc(quality),
+                      "RouterState": _rpc(router_state)}
     )
 
 

@@ -319,6 +319,30 @@ class ObsConfig:
 
 
 @dataclass
+class RouterConfig:
+    """Fleet router tier (r16, serve/router.py): consistent-hash stream
+    placement across engine members + burn-driven live migration. Only
+    the dedicated router process reads this block (``python -m
+    video_edge_ai_proxy_tpu.serve.router``); engine members need nothing
+    beyond their normal REST surface — the router attaches to them."""
+
+    members: tuple = ()             # "name=http://host:port" specs
+    port: int = 9091                # router admin plane (/metrics, stats)
+    scrape_interval_s: float = 1.0  # health poll + decision-pass cadence;
+                                    # bounds re-placement latency
+    vnodes: int = 64                # virtual nodes per member at weight 1
+    max_moves_per_pass: int = 2     # graceful-migration budget per pass
+                                    # (dead-member failover is unbounded)
+    min_healthy_age_s: float = 0.0  # keep a freshly-healthy member out of
+                                    # the ring until its verdict has aged
+    drain_timeout_s: float = 8.0    # max wait for the source engine to
+                                    # flush a stream before cutover
+    ema_alpha: float = 0.4          # health-score smoothing (obs/fleet.py)
+    healthy_above: float = 0.7      # hysteresis band: healthy at/above
+    unhealthy_below: float = 0.4    # ... unhealthy at/below; hold between
+
+
+@dataclass
 class RunnerConfig:
     """Worker isolation runner (SURVEY.md §7.5 "subprocess first, Docker
     optional"). "subprocess": RLIMIT_AS + niceness containment (default).
@@ -357,6 +381,7 @@ class Config:
     buffer: BufferConfig = field(default_factory=BufferConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    router: RouterConfig = field(default_factory=RouterConfig)
 
 
 def _merge(dc: Any, data: dict[str, Any]) -> Any:
