@@ -3,7 +3,7 @@
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
-	roi-smoke fleet-obs-smoke stem-smoke router-smoke
+	roi-smoke fleet-obs-smoke stem-smoke router-smoke cascade-smoke
 
 all: proto native
 
@@ -206,6 +206,16 @@ router-smoke:
 			% (d['members'], d['streams'], d['burn_migrate_s'], \
 			   d['kill_replace_detect_s'], d['kill_replace_wall_s'], \
 			   d['ledger']['lost'], d['ledger']['duplicated']))"
+
+cascade-smoke:
+	python tools/cascade_smoke.py | tee /tmp/vep_cascade_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_cascade_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); \
+		print('cascade: head cadence 1/%d exact, enter latency %d ticks (<= %d), %d/%d enter/exit uplinked, slot high water %d' \
+			% (d['cascade_every_n'], d['cascade_event_latency_ticks'], \
+			   d['gates']['max_event_latency_ticks'], d['uplink_enter_requests'], \
+			   d['uplink_exit_requests'], d['slot_high_water']))"
 
 roi-smoke:
 	python tools/roi_smoke.py | tee /tmp/vep_roi_smoke.json

@@ -1,0 +1,574 @@
+"""CASCADE temporal serving tests (temporal/ package + engine wiring).
+
+Covers the three layers separately, then the engine end-to-end:
+
+- ``TrackStatePool`` (temporal/state_pool.py): slot lifecycle, permanent
+  zero row 0, time-ordered ring gather, growth, bucket padding.
+- ``TrackEventTracker`` (temporal/events.py): two-sided hysteresis,
+  exactly-once transitions, flap reset.
+- ``CascadeScheduler`` (temporal/scheduler.py): harvest -> scatter ->
+  cadence dispatch with a scripted head, TTL expiry, stream GC pop.
+- Engine (engine/runner.py): cascade=False structural inertness and the
+  bit-identical emitted-checksum pin (r13 roi=False / r15 stem="classic"
+  convention), the event fan-out (uplink exactly-once + archive trigger
+  + metrics), and the no-host-round-trip invariant on the state pool.
+
+Scenes reuse the blob-gauge contract (models/blob.py, tests/test_roi.py):
+an "anomalous" blob flickers its BLUE channel +-15 each frame — large
+inter-frame luma diff for the anomaly scorer, while the RED channel (the
+class bin) and green brightness stay fixed, so the detector's class id
+and therefore the tracker's id never waver.
+"""
+
+import json
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+from video_edge_ai_proxy_tpu.proto import pb
+from video_edge_ai_proxy_tpu.temporal import (
+    CascadeScheduler,
+    TrackEventTracker,
+    TrackStatePool,
+)
+from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+
+def _meta(w=64, h=64, ts=None):
+    return FrameMeta(
+        width=w, height=h, channels=3,
+        timestamp_ms=ts or int(time.time() * 1000), is_keyframe=True,
+    )
+
+
+def _blob_frame(delta=0, box=(20, 20, 40, 40), key=1, h=64, w=64):
+    """Gray frame with one color-keyed blob; ``delta`` shifts the BLUE
+    channel (luma flicker without touching the red class bin)."""
+    frame = np.full((h, w, 3), 114, np.uint8)
+    x0, y0, x1, y1 = box
+    frame[y0:y1, x0:x1] = (64 + delta, 255, key * 32 + 16)
+    return frame
+
+
+def _det(track_id, box=(20, 20, 40, 40), class_id=1):
+    x0, y0, x1, y1 = box
+    return pb.Detection(
+        box=pb.BoundingBox(left=x0, top=y0, width=x1 - x0, height=y1 - y0),
+        class_id=class_id, confidence=0.9, track_id=str(track_id),
+    )
+
+
+# ---------------------------------------------------------------------------
+# state pool
+
+
+class TestTrackStatePool:
+    def _tiles(self, n, side=8, value=0):
+        return np.full((n, side, side, 3), value, np.uint8)
+
+    def test_slot_assign_free_reuse_and_row0_reserved(self):
+        pool = TrackStatePool(side=8, clip_len=2)
+        pool.scatter(["a"], self._tiles(1, value=10))
+        pool.scatter(["b"], self._tiles(1, value=20))
+        assert len(pool) == 2 and "a" in pool and "b" in pool
+        assert pool.high_water == 2          # rows 1 and 2; row 0 reserved
+        row_a = pool.pop("a")
+        assert row_a == 1 and len(pool) == 1
+        # The freed row is reused before any new row is cut.
+        pool.scatter(["c"], self._tiles(1, value=30))
+        assert pool.high_water == 2          # conservation across churn
+        assert np.asarray(pool.array[0]).max() == 0   # row 0 stays zero
+
+    def test_gather_is_time_ordered_oldest_first(self):
+        pool = TrackStatePool(side=4, clip_len=3)
+        # 5 writes into a 3-deep ring: survivors are writes 3,4,5.
+        for v in (1, 2, 3, 4, 5):
+            pool.scatter(["t"], self._tiles(1, side=4, value=v))
+        assert pool.full("t")
+        slot_idx, time_idx = pool.gather_indices(["t"], bucket=4)
+        clips = np.asarray(pool.gather(slot_idx, time_idx))
+        assert clips.shape == (4, 3, 4, 4, 3)
+        # Oldest-first unroll of the ring.
+        assert [int(clips[0, j, 0, 0, 0]) for j in range(3)] == [3, 4, 5]
+        # Padded bucket slots gather permanent-zero row 0, never stale
+        # track state.
+        assert clips[1:].max() == 0
+
+    def test_growth_preserves_content(self):
+        pool = TrackStatePool(side=4, clip_len=2)
+        pool.scatter(["keep"], self._tiles(1, side=4, value=99))
+        pool.scatter(["keep"], self._tiles(1, side=4, value=98))
+        # Force past the initial capacity (grows in _GROW=8 increments).
+        for i in range(12):
+            pool.scatter([f"t{i}"], self._tiles(1, side=4, value=i))
+        assert pool.array.shape[0] > 8
+        slot_idx, time_idx = pool.gather_indices(["keep"], bucket=4)
+        clips = np.asarray(pool.gather(slot_idx, time_idx))
+        assert [int(clips[0, j, 0, 0, 0]) for j in range(2)] == [99, 98]
+
+    def test_full_requires_clip_len_frames(self):
+        pool = TrackStatePool(side=4, clip_len=3)
+        for i in range(2):
+            pool.scatter(["t"], self._tiles(1, side=4, value=i))
+            assert not pool.full("t")
+        pool.scatter(["t"], self._tiles(1, side=4, value=9))
+        assert pool.full("t")
+
+    def test_bucketed_scatter_pads_by_repeating_last(self):
+        pool = TrackStatePool(side=4, clip_len=2)
+        aux = pool.scatter(["a", "b"], self._tiles(2, side=4, value=5),
+                           bucket=4)
+        # Two int32 index vectors of bucket length.
+        assert aux == 2 * 4 * 4
+        assert len(pool) == 2
+        slot_idx, time_idx = pool.gather_indices(["a", "b"], bucket=4)
+        pool.scatter(["a", "b"], self._tiles(2, side=4, value=6), bucket=4)
+        assert pool.full("a") and pool.full("b")
+
+
+# ---------------------------------------------------------------------------
+# event hysteresis
+
+
+class TestTrackEventTracker:
+    def test_enter_exit_fire_exactly_once(self):
+        ev = TrackEventTracker(threshold=0.5, enter_n=2, exit_n=2)
+        assert ev.observe("t", 0.9) is None        # run 1 of 2
+        assert ev.observe("t", 0.9) == "enter"     # run 2: fires
+        for _ in range(5):                         # persists: silent
+            assert ev.observe("t", 0.9) is None
+        assert ev.active("t")
+        assert ev.observe("t", 0.1) is None
+        assert ev.observe("t", 0.1) == "exit"
+        assert not ev.active("t")
+        for _ in range(5):
+            assert ev.observe("t", 0.1) is None
+
+    def test_flap_resets_run_and_fires_nothing(self):
+        ev = TrackEventTracker(threshold=0.5, enter_n=3, exit_n=2)
+        # hot, hot, cold, hot, hot, cold ... never 3 consecutive.
+        for _ in range(4):
+            assert ev.observe("t", 0.9) is None
+            assert ev.observe("t", 0.9) is None
+            assert ev.observe("t", 0.1) is None    # flap: run resets
+        assert not ev.active("t")
+
+    def test_pop_restarts_cold_without_event(self):
+        ev = TrackEventTracker(enter_n=1, exit_n=1)
+        assert ev.observe("t", 0.9) == "enter"
+        assert ev.pop("t") is not None
+        assert "t" not in ev
+        # Reappearing key starts cold: the enter fires again, the
+        # removal itself fired nothing.
+        assert ev.observe("t", 0.9) == "enter"
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+
+
+def _scripted_head(score, calls):
+    """Engine-head stand-in: constant score, records each dispatch."""
+
+    def head(pool, slot_idx, time_idx, n_real):
+        bucket = int(slot_idx.shape[0])
+        calls.append({"bucket": bucket, "n_real": n_real,
+                      "slots": [int(s) for s in slot_idx[:n_real]]})
+        return {
+            "event_score": np.full((bucket,), score, np.float32),
+            "features": np.zeros((bucket, 3), np.float32),
+            "logits": np.zeros((bucket, 2), np.float32),
+        }, 0.5
+
+    return head
+
+
+class TestCascadeScheduler:
+    def _sched(self, **kw):
+        kw.setdefault("model", "tiny_videomae")   # side 32, clip_len 4
+        kw.setdefault("every_n", 3)
+        return CascadeScheduler(**kw)
+
+    def test_head_runs_at_exact_cadence_with_full_clips_only(self):
+        calls = []
+        sched = self._sched()
+        sched.head = _scripted_head(0.9, calls)
+        frame = _blob_frame()
+        for _ in range(12):
+            sched.harvest("camA", frame, [_det(1)], _meta())
+            sched.tick()
+        # Clip fills at tick 4; cadence ticks are 3, 6, 9, 12 — the head
+        # must have run on exactly the cadence ticks with a full clip.
+        assert list(sched.head_ticks) == [6, 9, 12]
+        assert all(b - a == 3 for a, b in
+                   zip(sched.head_ticks, list(sched.head_ticks)[1:]))
+        assert sched.head_dispatches == 3
+        assert all(c["n_real"] == 1 and c["bucket"] == 4 for c in calls)
+        snap = sched.snapshot()
+        assert snap["ticks"] == 12 and snap["head_dispatches"] == 3
+        assert snap["tracks"]["camA#1"]["observed"] == 3
+
+    def test_ttl_expiry_frees_slot_and_reuses_it(self):
+        sched = self._sched(ttl_ticks=2)
+        sched.head = _scripted_head(0.9, [])
+        frame = _blob_frame()
+        sched.harvest("camA", frame, [_det(1)], _meta())
+        sched.tick()
+        assert sched.snapshot()["slots_in_use"] == 1
+        for _ in range(3):                       # coast past the TTL
+            sched.tick()
+        snap = sched.snapshot()
+        assert snap["slots_in_use"] == 0 and not snap["tracks"]
+        # A new track reclaims the freed row: high water stays put.
+        sched.harvest("camA", frame, [_det(2)], _meta())
+        sched.tick()
+        assert sched.snapshot()["slot_high_water"] == 1
+
+    def test_pop_stream_drops_all_its_tracks_without_events(self):
+        sched = self._sched(every_n=1, enter_n=1)
+        calls = []
+        sched.head = _scripted_head(0.9, calls)
+        frame = _blob_frame()
+        for _ in range(4):                       # fill clips, fire enters
+            sched.harvest("camA", frame, [_det(1)], _meta())
+            sched.harvest("camB", frame, [_det(1)], _meta())
+            res = sched.tick()
+        assert sorted(sched) == ["camA", "camB"]
+        before = dict(sched.snapshot()["event_counts"])
+        sched.pop("camA")
+        assert sorted(sched) == ["camB"]
+        snap = sched.snapshot()
+        assert snap["slots_in_use"] == 1
+        assert all(k.startswith("camB#") for k in snap["tracks"])
+        # GC fired no exit events for the removed stream.
+        assert snap["event_counts"] == before
+        assert res is not None
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end (hand-stepped, test_roi.py _tick convention)
+
+
+class _AnnSink:
+    def __init__(self):
+        self.items = []
+
+    def publish(self, payload):
+        self.items.append(payload)
+
+
+class _ArchiveStub:
+    """ingest/archive.py SegmentArchiver duck type (.submit only)."""
+
+    def __init__(self):
+        self.segments = []
+
+    def submit(self, seg):
+        self.segments.append(seg)
+
+
+def _cascade_engine(bus, ann=None, archiver=None, **cfg_kw):
+    from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+
+    cfg = EngineConfig(
+        model="tiny_blob_gauge", batch_buckets=(1, 2, 4), tick_ms=5,
+        prefetch=False, track=True, cascade=True,
+        cascade_model="tiny_videomae", cascade_every_n=2, **cfg_kw,
+    )
+    eng = InferenceEngine(bus, cfg, annotations=ann or _AnnSink(),
+                          archiver=archiver)
+    eng.warmup()
+    eng._drain_q = queue.Queue(maxsize=8)
+    return eng
+
+
+def _subscribe(eng):
+    q = queue.Queue()
+    with eng._sub_lock:
+        eng._subscribers.append((q, None))
+    return q
+
+
+def _tick(eng, results_q):
+    """One engine tick by hand: collect -> dispatch -> drain/emit (the
+    harvest tap) -> cascade tick, the same order _run interleaves."""
+    groups = eng._collector.collect()
+    eng._dispatch(groups, time.perf_counter())
+    while True:
+        try:
+            inflight = eng._drain_q.get_nowait()
+        except queue.Empty:
+            break
+        try:
+            eng._emit(inflight)
+        finally:
+            eng._collector.release(inflight.group)
+            eng._drain_q.task_done()
+    if eng._cascade is not None:
+        eng._cascade_tick()
+    out = []
+    while True:
+        try:
+            out.append(results_q.get_nowait())
+        except queue.Empty:
+            return out
+
+
+class TestCascadeEngine:
+    def test_cascade_off_is_structurally_inert(self):
+        """cfg.cascade=False (the default): no scheduler, no pool, no
+        head program — the tick pipeline cannot even reach a cascade
+        branch (ISSUE 14 acceptance: default-off is structural)."""
+        from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+
+        bus = MemoryFrameBus()
+        try:
+            eng = InferenceEngine(
+                bus, EngineConfig(model="tiny_blob_gauge",
+                                  batch_buckets=(1, 2), tick_ms=5))
+            assert eng._cascade is None and eng.cascade is None
+            assert not any(k[0].startswith("cascade:")
+                           for k in eng._step_cache)
+        finally:
+            bus.close()
+
+    def test_mesh_serving_disables_cascade(self):
+        from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+
+        bus = MemoryFrameBus()
+        try:
+            eng = InferenceEngine(
+                bus, EngineConfig(model="tiny_blob_gauge", cascade=True,
+                                  mesh="dp=8"))
+            assert eng._cascade is None
+        finally:
+            bus.close()
+
+    def test_cascade_on_emitted_checksum_bit_identical(self):
+        """The cascade is a pure tap: with flickering tracked blobs the
+        detect outputs an engine emits must fold the SAME device-output
+        checksum with the cascade on (head running) as off — stage 2 may
+        add work, never change stage-1 results (the r13 roi=False /
+        r15 stem pin, applied to cascade=False)."""
+        from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+        from video_edge_ai_proxy_tpu.replay.checksum import (
+            CHECKSUM_MASK,
+            device_checksum,
+            finalize_checksum,
+        )
+
+        def run(cascade):
+            b = MemoryFrameBus()
+            try:
+                b.create_stream("cam1", 64 * 64 * 3)
+                if cascade:
+                    eng = _cascade_engine(b)
+                else:
+                    eng = InferenceEngine(
+                        b, EngineConfig(model="tiny_blob_gauge",
+                                        batch_buckets=(1, 2, 4), tick_ms=5,
+                                        prefetch=False, track=True),
+                        annotations=_AnnSink())
+                    eng.warmup()
+                    eng._drain_q = queue.Queue(maxsize=8)
+                sub = _subscribe(eng)
+                carry = 0
+                for f in range(8):
+                    delta = 15 if f % 2 == 0 else -15
+                    b.publish("cam1", _blob_frame(delta), _meta())
+                    groups = eng._collector.collect()
+                    eng._dispatch(groups, time.perf_counter())
+                    inflight = eng._drain_q.get(timeout=10)
+                    part = int(np.asarray(
+                        device_checksum(inflight.outputs)))
+                    carry = (carry + part) & CHECKSUM_MASK
+                    eng._emit(inflight)
+                    eng._collector.release(inflight.group)
+                    eng._drain_q.task_done()
+                    if eng._cascade is not None:
+                        eng._cascade_tick()
+                if cascade:     # the cascade actually ran on this pass
+                    assert eng._cascade.head_dispatches > 0
+                while not sub.empty():
+                    sub.get_nowait()
+                return finalize_checksum(carry)
+            finally:
+                b.close()
+
+        assert run(cascade=True) == run(cascade=False)
+
+    def test_event_fanout_uplink_archive_metrics_exactly_once(self,
+                                                              monkeypatch):
+        """The full story on one engine: a flickering blob enters (one
+        uplink AnnotateRequest, one archive segment), goes static and
+        exits (one more request, no segment); a permanently static blob
+        on a second stream never fires (zero false positives). The live
+        state-pool array must never cross to the host while any of this
+        runs (the no-D2H acceptance)."""
+        import jax
+
+        bus = MemoryFrameBus()
+        ann = _AnnSink()
+        arch = _ArchiveStub()
+        try:
+            for did in ("camA", "camB"):
+                bus.create_stream(did, 64 * 64 * 3)
+            eng = _cascade_engine(bus, ann=ann, archiver=arch)
+            sched = eng._cascade
+
+            # Host-fetch tripwire on the live pool array, re-read at
+            # call time (scatter replaces it functionally every tick).
+            real_asarray = np.asarray
+            real_get = jax.device_get
+
+            def _pool_array():
+                pool = sched._pool
+                return None if pool is None else pool.array
+
+            def guard_asarray(obj, *a, **kw):
+                assert obj is not _pool_array(), "state pool fetched D2H"
+                return real_asarray(obj, *a, **kw)
+
+            def guard_get(obj, *a, **kw):
+                assert obj is not _pool_array(), "state pool fetched D2H"
+                return real_get(obj, *a, **kw)
+
+            monkeypatch.setattr(np, "asarray", guard_asarray)
+            monkeypatch.setattr(jax, "device_get", guard_get)
+
+            sub = _subscribe(eng)
+            for f in range(16):
+                # camA: flicker for 8 ticks, then freeze. camB: static.
+                delta = (15 if f % 2 == 0 else -15) if f < 8 else 15
+                bus.publish("camA", _blob_frame(delta, key=1), _meta())
+                bus.publish("camB", _blob_frame(0, key=2), _meta())
+                _tick(eng, sub)
+
+            reqs = [pb.AnnotateRequest.FromString(p) for p in ann.items]
+            casc = [r for r in reqs if r.type == "cascade"]
+            enters = [r for r in casc if r.object_type == "anomaly_enter"]
+            exits = [r for r in casc if r.object_type == "anomaly_exit"]
+            assert len(enters) == 1                # exactly once
+            assert len(exits) == 1
+            assert enters[0].device_name == "camA"
+            assert enters[0].object_tracking_id != ""
+            assert enters[0].ml_model == "temporal.cascade"
+            assert enters[0].ml_model_version == "tiny_videomae"
+            assert enters[0].confidence > 0.5
+            assert exits[0].confidence < 0.5
+            # Zero false positives on the static stream.
+            assert all(r.device_name == "camA" for r in casc)
+
+            # Archive: one clip segment, enter only, tile-shaped frames.
+            assert len(arch.segments) == 1
+            seg = arch.segments[0]
+            assert seg.device_id == "cascade_camA"
+            assert seg.frames and seg.frames[0].shape == (32, 32, 3)
+            assert seg.end_ts_ms > seg.start_ts_ms
+
+            # Head ran at exactly the 1/N cadence once clips filled.
+            hts = list(sched.head_ticks)
+            assert hts and all(b - a == 2 for a, b in zip(hts, hts[1:]))
+
+            # Metrics/obs surface.
+            snap = eng.perf.snapshot()["cascade"]
+            assert snap["ticks"] == 16
+            assert snap["events"] == {"enter": 1, "exit": 1}
+            assert snap["head_batches"] == len(hts)
+            assert snap["slot_high_water"] == 2    # two tracks, two rows
+            api = sched.snapshot()
+            assert api["event_counts"] == {"enter": 1, "exit": 1}
+            assert json.dumps(api["events"])       # JSON-able log
+        finally:
+            bus.close()
+
+    def test_track_churn_conserves_pool_slots(self):
+        """Slot-conservation gate at engine scale: tracks that expire
+        (TTL) hand their rows back, so high water stays bounded by the
+        peak concurrent track count across churn waves."""
+        bus = MemoryFrameBus()
+        try:
+            bus.create_stream("camA", 64 * 64 * 3)
+            eng = _cascade_engine(bus, cascade_track_ttl_ticks=2)
+            sched = eng._cascade
+            sub = _subscribe(eng)
+            frame = _blob_frame()
+            for wave in range(3):
+                # 2 live ticks with a blob, then 4 empty ticks: the
+                # tracker coasts (default max_misses=30 keeps the id),
+                # but the cascade TTL reaps the slot between waves.
+                for _ in range(2):
+                    bus.publish("camA", frame, _meta())
+                    _tick(eng, sub)
+                for _ in range(4):
+                    bus.publish("camA", np.full((64, 64, 3), 114, np.uint8),
+                                _meta())
+                    _tick(eng, sub)
+            assert sched.snapshot()["slot_high_water"] <= 2
+        finally:
+            bus.close()
+
+
+# ---------------------------------------------------------------------------
+# REST surface (r9 disabled-endpoint convention)
+
+
+class TestCascadeEndpointConvention:
+    def test_disabled_cascade_answers_400_envelope(self):
+        import urllib.error
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        bus = MemoryFrameBus()
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5))
+        assert eng.cascade is None
+
+        class _PM:
+            def list(self):
+                return []
+
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/api/v1/cascade")
+            assert ei.value.code == 400
+            body = json.loads(ei.value.read())
+            assert set(body) == {"code", "message"}
+            assert "engine.cascade" in body["message"]
+        finally:
+            srv.stop()
+            bus.close()
+
+    def test_enabled_cascade_serves_snapshot(self):
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        bus = MemoryFrameBus()
+        eng = _cascade_engine(bus)
+
+        class _PM:
+            def list(self):
+                return []
+
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            with urllib.request.urlopen(base + "/api/v1/cascade") as r:
+                body = json.loads(r.read())
+            assert body["model"] == "tiny_videomae"
+            assert body["every_n"] == 2
+            assert body["ticks"] == 0
+        finally:
+            srv.stop()
+            bus.close()

@@ -422,6 +422,58 @@ class TestBreakerIsolation:
 
 
 # ---------------------------------------------------------------------------
+# health-aware admission (admit)
+
+
+class TestAdmit:
+    def test_admit_picks_healthiest_member(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        fleet.rows["m0"].update(score_ema=0.55)
+        fleet.rows["m1"].update(score_ema=0.95)
+        fleet.rows["m2"].update(score_ema=0.80)
+        # Every new stream lands on the best-scored member, regardless of
+        # where the hash would have put it.
+        for i in range(5):
+            assert router.admit(f"cam{i}", f"rtsp://cam{i}") == "m1"
+        assert len(members["m1"].started) == 5
+        assert all(router._streams[f"cam{i}"]["member"] == "m1"
+                   for i in range(5))
+        # Placement only — nothing is marked as a migration.
+        assert all(v["migrations"] == 0 for v in router._streams.values())
+
+    def test_admit_skips_unplaceable_members(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # Best score belongs to members that are not placeable: one
+        # breaker-open, one flagged unhealthy. Admission must skip both.
+        fleet.rows["m0"].update(score_ema=0.99)
+        br = router.clients["m0"].breaker
+        for _ in range(br.failure_threshold):
+            br.record_failure()
+        fleet.rows["m1"].update(score_ema=0.98, healthy=False)
+        fleet.rows["m2"].update(score_ema=0.40)
+        assert router.admit("cam0", "rtsp://cam0") == "m2"
+
+    def test_admit_falls_back_to_hash_and_raises_like_add_stream(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # No usable score signal -> consistent-hash placement.
+        for row in fleet.rows.values():
+            row["score_ema"] = None
+        owner = router.ring.place("cam0")
+        assert router.admit("cam0", "rtsp://cam0") == owner
+        with pytest.raises(ValueError):
+            router.admit("cam0", "rtsp://cam0")
+        # Ring emptied (all members dead) -> fail closed.
+        for row in fleet.rows.values():
+            row.update(up=False, healthy=False)
+        router.run_pass()
+        with pytest.raises(RuntimeError):
+            router.admit("cam9", "rtsp://cam9")
+
+
+# ---------------------------------------------------------------------------
 # ladder hook (resilience/ladder.py shed_to_fleet)
 
 

@@ -85,6 +85,62 @@ class TestIoUTracker:
         assert near == a and far != a
 
 
+class TestCoastReacquire:
+    """Track-id stability across coast -> reacquire: the property the
+    temporal cascade (temporal/scheduler.py) leans on — its per-track
+    clip buffers and event hysteresis are keyed by track_id, so an id
+    that churns across a short occlusion would reset clip history and
+    re-fire enter events for the same physical object."""
+
+    def test_long_coast_reacquires_at_extrapolated_position(self):
+        """An object lost for most of the miss budget is still the same
+        track when it reappears where the velocity carried it — and a
+        detection somewhere else entirely is NOT captured by the coast."""
+        tr = IoUTracker(max_misses=10)
+        ids = [tr.update([_box(10 + 5 * f, 10)], [0])[0] for f in range(6)]
+        assert len(set(ids)) == 1
+        for _ in range(8):                 # coast 8 of 10 allowed misses
+            tr.update([], [])
+        # Reappears ~where 5 px/frame extrapolation predicts (frame 13)...
+        (back,) = tr.update([_box(10 + 5 * 13, 10)], [0])
+        assert back == ids[0]
+        # ...and a far-away detection next frame opens a fresh id.
+        near, far = tr.update([_box(10 + 5 * 14, 10), _box(400, 400)], [0, 0])
+        assert near == ids[0] and far != ids[0]
+
+    def test_reacquire_does_not_steal_neighbor_id(self):
+        """Two same-class objects; one occluded for a few frames. When it
+        returns, it reclaims ITS id — the surviving neighbor's id never
+        swaps onto it (greedy matching pairs each with its own track)."""
+        tr = IoUTracker(max_misses=10)
+        a, b = tr.update([_box(10, 10), _box(80, 10)], [0, 0])
+        for f in range(1, 4):
+            a2, b2 = tr.update(
+                [_box(10 + 2 * f, 10), _box(80 + 2 * f, 10)], [0, 0])
+            assert (a2, b2) == (a, b)
+        for f in range(4, 7):              # a occluded, b keeps moving
+            (b3,) = tr.update([_box(80 + 2 * f, 10)], [0])
+            assert b3 == b
+        a4, b4 = tr.update(
+            [_box(10 + 2 * 7, 10), _box(80 + 2 * 7, 10)], [0, 0])
+        assert (a4, b4) == (a, b)          # no swap, both ids stable
+
+    def test_reacquire_resets_miss_budget(self):
+        """A successful reacquire zeroes the miss counter, so the track
+        survives a second occlusion of the same length instead of
+        expiring mid-coast on leftover misses."""
+        tr = IoUTracker(max_misses=4)
+        (tid,) = tr.update([_box(50, 50)], [0])
+        for _ in range(3):                 # first occlusion: 3 of 4 misses
+            tr.update([], [])
+        (back,) = tr.update([_box(50, 50)], [0])
+        assert back == tid
+        for _ in range(3):                 # second occlusion, same length
+            tr.update([], [])
+        (again,) = tr.update([_box(50, 50)], [0])
+        assert again == tid                # budget was reset at reacquire
+
+
 class TestTrackerCoasting:
     """The ROI-serving surface (engine/runner.py MOSAIC gate): tracks()
     snapshots, stored confidences, and empty-update coasting."""

@@ -177,6 +177,35 @@ def router_replace_info(baseline_dir: str):
     return None
 
 
+def cascade_info(baseline_dir: str):
+    """Newest committed CASCADE_r*.json's cadence/latency row, or None.
+
+    Round 14 informational carry-through: perf-gate logs show the
+    temporal cascade's measured head cadence and enter-event detect
+    latency next to the fps verdict. NEVER gated here —
+    cascade_smoke.py hard-gates its own run; this is trend visibility
+    only.
+    """
+    paths = sorted(glob.glob(os.path.join(baseline_dir, "CASCADE_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(art, dict) or "cascade_head_cadence" not in art:
+            continue
+        return {
+            "artifact": os.path.basename(path),
+            "cascade_every_n": art.get("cascade_every_n"),
+            "cascade_head_cadence": art.get("cascade_head_cadence"),
+            "cascade_event_latency_ticks": art.get(
+                "cascade_event_latency_ticks"),
+            "slot_high_water": art.get("slot_high_water"),
+        }
+    return None
+
+
 def stem_stage_info(baseline_dir: str):
     """Newest committed MFU_yolo_*.json's stem-stage row, or None.
 
@@ -234,6 +263,9 @@ def main(argv=None) -> int:
     router = router_replace_info(args.baseline_dir)
     if router is not None:
         report["router_replace"] = router    # informational, never gated
+    cascade = cascade_info(args.baseline_dir)
+    if cascade is not None:
+        report["cascade"] = cascade          # informational, never gated
     print(json.dumps(report, indent=2))
     return 0 if report["passed"] else 1
 

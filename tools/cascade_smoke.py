@@ -1,0 +1,265 @@
+"""CASCADE smoke: drive the detector→tracker→temporal-head cascade over
+a scripted anomaly scene and gate the ISSUE 14 acceptance criteria.
+
+One hand-stepped engine (the tests/test_roi.py ``_tick`` convention —
+collect → dispatch → drain/emit (the harvest tap) → cascade tick, so
+every tick is deterministic and cadence arithmetic is exact, no
+wall-clock jitter) serving blob-gauge streams (models/blob.py):
+
+- ``camA`` — the anomaly: static through a warm-up long enough to fill
+  its clip ring, then its blob's BLUE channel flickers ±15 per frame
+  (large inter-frame luma diff; the RED class bin never moves, so the
+  tracker id is stable), then static again for the exit.
+- ``camB``/``camC`` — permanently static tracks: the zero-false-positive
+  control.
+- ``camD`` — churn: appears for a couple of ticks and vanishes past the
+  cascade TTL, three waves, exercising pool-slot reuse.
+
+Gates, exit non-zero on breach:
+
+1. temporal head at exactly 1/N cadence (consecutive head ticks differ
+   by exactly ``cascade_every_n``),
+2. enter-event detect latency <= 2·N ticks from anomaly onset,
+3. ZERO events on the static control tracks,
+4. state-pool slot conservation: high water <= peak concurrent tracks,
+5. the enter event reaches the uplink exactly once (and the archive
+   sink exactly once).
+
+Runs in ~20 s on the CPU twin; wired as ``make cascade-smoke``. One
+JSON line on stdout; ``--out`` additionally writes the artifact
+(committed as CASCADE_r01.json). ``cascade_event_latency_ticks`` and
+``cascade_head_cadence`` are carried informationally by
+tools/bench_gate.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--native", action="store_true",
+                    help="use the environment's real backend instead of "
+                         "forcing CPU")
+    ap.add_argument("--every-n", type=int, default=4,
+                    help="cascade head cadence in ticks (default 4)")
+    ap.add_argument("--out", default="",
+                    help="also write the artifact JSON here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if not args.native:
+        jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+
+    import queue as _queue
+
+    import numpy as np
+
+    from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+    from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+    from video_edge_ai_proxy_tpu.engine import InferenceEngine
+    from video_edge_ai_proxy_tpu.ingest.archive import SegmentArchiver
+    from video_edge_ai_proxy_tpu.proto import pb
+    from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+    N = args.every_n
+    detector = "blob_gauge" if backend == "tpu" else "tiny_blob_gauge"
+    side = 640 if backend == "tpu" else 64
+    clip_len = 4                       # tiny_videomae
+
+    class AnnSink:                     # uplink duck type: publish only
+        def __init__(self):
+            self.items = []
+
+        def publish(self, payload):
+            self.items.append(payload)
+
+    def blob_frame(delta=0, box=(20, 20, 40, 40), key=1):
+        frame = np.full((side, side, 3), 114, np.uint8)
+        x0, y0, x1, y1 = box
+        frame[y0:y1, x0:x1] = (64 + delta, 255, key * 32 + 16)
+        return frame
+
+    bus = MemoryFrameBus()
+    ann = AnnSink()
+    tmpdir = tempfile.mkdtemp(prefix="vep_cascade_smoke_")
+    archiver = SegmentArchiver(tmpdir)
+    archiver.start()
+    try:
+        eng = InferenceEngine(
+            bus,
+            EngineConfig(
+                model=detector, batch_buckets=(1, 2, 4, 8), tick_ms=10,
+                prefetch=False, prof=False, track=True, cascade=True,
+                cascade_model="tiny_videomae", cascade_every_n=N,
+                cascade_track_ttl_ticks=4,
+            ),
+            annotations=ann, archiver=archiver,
+        )
+        eng.warmup()
+        sched = eng.cascade
+        assert sched is not None, "cascade failed to arm"
+        results_q: _queue.Queue = _queue.Queue()
+        with eng._sub_lock:
+            eng._subscribers.append((results_q, None))
+        eng._drain_q = _queue.Queue(maxsize=8)
+
+        streams = {
+            "camA": (1, (20, 20, 40, 40)),   # anomaly
+            "camB": (2, (8, 44, 28, 60)),    # static control
+            "camC": (4, (44, 8, 60, 24)),    # static control
+        }
+        churn_box = (44, 44, 60, 60)
+        for name in list(streams) + ["camD"]:
+            bus.create_stream(name, side * side * 3)
+
+        warmup = clip_len + 2 * N            # camA clip full + settled
+        flicker = 4 * N                      # anomaly window
+        recover = 6 * N                      # back to static (exit)
+        churn = 3 * (2 + 4 + 2)              # 3 waves of camD
+        total = warmup + flicker + recover + churn
+        onset = warmup + 1                   # first flickered tick
+        last_ts = 0
+
+        def step(tick):
+            nonlocal last_ts
+            ts = max(int(time.time() * 1000), last_ts + 1)
+            last_ts = ts
+            meta = lambda: FrameMeta(width=side, height=side, channels=3,
+                                     timestamp_ms=ts, is_keyframe=True)
+            for name, (key, box) in streams.items():
+                delta = 0
+                if name == "camA" and onset <= tick <= warmup + flicker:
+                    delta = 15 if tick % 2 == 0 else -15
+                bus.publish(name, blob_frame(delta, box, key), meta())
+            if tick > warmup + flicker + recover:
+                w = (tick - warmup - flicker - recover - 1) % 8
+                if w < 2:                    # camD alive 2 of every 8
+                    bus.publish("camD", blob_frame(0, churn_box, 6), meta())
+            groups = eng._collector.collect()
+            eng._dispatch(groups, time.perf_counter())
+            while True:
+                try:
+                    inflight = eng._drain_q.get_nowait()
+                except _queue.Empty:
+                    break
+                try:
+                    eng._emit(inflight)
+                finally:
+                    eng._collector.release(inflight.group)
+                    eng._drain_q.task_done()
+            eng._cascade_tick()
+            while True:
+                try:
+                    results_q.get_nowait()
+                except _queue.Empty:
+                    break
+
+        t0 = time.monotonic()
+        for tick in range(1, total + 1):
+            step(tick)
+        wall_s = time.monotonic() - t0
+
+        snap = sched.snapshot()
+        perf = eng.perf.snapshot()
+        reqs = [pb.AnnotateRequest.FromString(p) for p in ann.items]
+        casc = [r for r in reqs if r.type == "cascade"]
+        enters = [r for r in casc if r.object_type == "anomaly_enter"]
+        exits = [r for r in casc if r.object_type == "anomaly_exit"]
+        head_ticks = snap["head_ticks"]
+        gaps = [b - a for a, b in zip(head_ticks, head_ticks[1:])]
+        enter_events = [e for e in snap["events"] if e["kind"] == "enter"]
+        enter_tick = enter_events[0]["tick"] if enter_events else None
+        latency = (enter_tick - onset) if enter_tick is not None else None
+        # 4 concurrent tracks at peak: camA/B/C + one churn wave of camD.
+        peak_tracks = 4
+        # Archive thread is async: give it a moment to drain.
+        deadline = time.monotonic() + 10
+        while archiver.written < len(enters) and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        archiver.stop()
+        bus.close()
+
+    out = {
+        "tool": "cascade_smoke",
+        "backend": backend,
+        "detector": detector,
+        "cascade_model": "tiny_videomae",
+        "cascade_every_n": N,
+        "clip_len": clip_len,
+        "ticks": snap["ticks"],
+        "wall_s": round(wall_s, 2),
+        "harvested_tiles": snap["harvested"],
+        "head_dispatches": snap["head_dispatches"],
+        "head_tick_gaps": sorted(set(gaps)),
+        "cascade_head_cadence": snap["head_cadence"],
+        "onset_tick": onset,
+        "enter_tick": enter_tick,
+        "cascade_event_latency_ticks": latency,
+        "event_counts": snap["event_counts"],
+        "uplink_enter_requests": len(enters),
+        "uplink_exit_requests": len(exits),
+        "uplink_streams": sorted({r.device_name for r in casc}),
+        "archive_segments_written": archiver.written,
+        "slot_high_water": snap["slot_high_water"],
+        "peak_concurrent_tracks": peak_tracks,
+        "perf_cascade": perf.get("cascade"),
+        "gates": {
+            "head_cadence_exact_n": N,
+            "max_event_latency_ticks": 2 * N,
+            "max_static_track_events": 0,
+            "max_slot_high_water": peak_tracks,
+            "uplink_enter_exactly": 1,
+        },
+    }
+    print(json.dumps(out), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+
+    if not head_ticks or any(g != N for g in gaps):
+        raise SystemExit(
+            f"cascade_smoke: head cadence not exactly 1/{N}: head ticks "
+            f"{head_ticks}")
+    if latency is None or latency > 2 * N:
+        raise SystemExit(
+            f"cascade_smoke: enter latency {latency} ticks > {2 * N} "
+            f"(onset {onset}, enter {enter_tick})")
+    if any(r.device_name != "camA" for r in casc):
+        raise SystemExit(
+            f"cascade_smoke: event on a static track: {out['uplink_streams']}"
+        )
+    if out["slot_high_water"] > peak_tracks:
+        raise SystemExit(
+            f"cascade_smoke: slot high water {out['slot_high_water']} > "
+            f"peak concurrent tracks {peak_tracks} — slots leak across "
+            "churn")
+    if len(enters) != 1:
+        raise SystemExit(
+            f"cascade_smoke: {len(enters)} enter uplink deliveries "
+            "(expected exactly 1)")
+    if len(exits) != 1:
+        raise SystemExit(
+            f"cascade_smoke: {len(exits)} exit uplink deliveries "
+            "(expected exactly 1)")
+    if archiver.written != 1:
+        raise SystemExit(
+            f"cascade_smoke: {archiver.written} archive segments written "
+            "(expected exactly 1, the enter clip)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

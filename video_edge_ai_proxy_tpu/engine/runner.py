@@ -378,6 +378,41 @@ class _TimedStep:
         return self._jit(variables, *args)
 
 
+def _build_cascade_head(model, score_w, score_b):
+    """Temporal-head program body (CASCADE): uint8 clips -> VideoMAE
+    logits + pooled clip features + logistic anomaly score, one fused
+    program per (model, geometry, bucket) in the engine step cache.
+
+    Features, per clip slot: [0] temporal diff energy — mean absolute
+    luma difference between consecutive frames ([0,1] scale; exactly 0
+    for a pixel-static track, the zero-false-positive anchor), [1] clip
+    luma variance, [2] the head's max softmax probability. The logistic
+    ``sigmoid(w . f + b)`` is the flagship event model; the VideoMAE
+    logits ride the event payload for downstream consumers. f32 feature
+    math and softmax (CLAUDE.md numerics convention — the VideoMAE
+    encoder itself computes in bf16 internally)."""
+    import jax
+    import jax.numpy as jnp
+
+    w = jnp.asarray((tuple(score_w) + (0.0, 0.0, 0.0))[:3], jnp.float32)
+    b = jnp.float32(score_b)
+
+    def head(variables, clips):
+        x = clips.astype(jnp.float32) / 255.0
+        logits = model.apply(variables, x, train=False).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        luma = x.mean(axis=-1)
+        diff_energy = jnp.abs(luma[:, 1:] - luma[:, :-1]).mean(
+            axis=(1, 2, 3))
+        luma_var = jnp.var(luma, axis=(1, 2, 3))
+        top_prob = probs.max(axis=-1)
+        feats = jnp.stack([diff_energy, luma_var, top_prob], axis=-1)
+        score = jax.nn.sigmoid(feats @ w + b)
+        return {"event_score": score, "features": feats, "logits": logits}
+
+    return head
+
+
 class _ThumbPool:
     """Device-resident per-stream quality-thumbnail state (ROADMAP item
     5 host-work fold): one [capacity, th, tw] f32 device array plus a
@@ -661,10 +696,15 @@ class InferenceEngine:
         spec=None,                           # ModelSpec override (tests)
         model_resolver=None,                 # device_id -> model name or ""
         annotation_policy_resolver=None,     # device_id -> policy or ""
+        archiver=None,                       # .submit(GopSegment) duck type
     ):
         self._bus = bus
         self._cfg = cfg or EngineConfig()
         self._annotations = annotations
+        # Cascade event archive sink (ingest/archive.py SegmentArchiver
+        # duck type): "enter" events submit the track's recent tile
+        # history as a clip segment. None = no archive taps.
+        self._archiver = archiver
         self._spec = spec
         self._model = None
         self._variables = None
@@ -874,6 +914,32 @@ class InferenceEngine:
         elif self._cfg.roi:
             _note_feature_disabled(
                 "roi", "mesh_serving_single_chip_scatter_back")
+        # Temporal cascade serving (CASCADE, ROADMAP item 2): tracker-
+        # keyed device clip rings + cadence-1/N temporal head
+        # (temporal/scheduler.py). cascade=False leaves it None — every
+        # batch takes today's stateless path bit-identically (test-
+        # pinned kill switch, roi=False convention). Mesh serving stays
+        # stateless: the track state pool is not sharded, same
+        # restriction as the thumbnail pool.
+        self._cascade = None
+        if self._cfg.cascade and not self._cfg.mesh:
+            from ..temporal import CascadeScheduler
+
+            self._cascade = CascadeScheduler(
+                model=self._cfg.cascade_model,
+                every_n=self._cfg.cascade_every_n,
+                crop=self._cfg.cascade_crop,
+                clip_len=self._cfg.cascade_clip_len,
+                threshold=self._cfg.cascade_threshold,
+                enter_n=self._cfg.cascade_enter_n,
+                exit_n=self._cfg.cascade_exit_n,
+                ttl_ticks=self._cfg.cascade_track_ttl_ticks,
+                perf=self.perf,
+            )
+            self._cascade.head = self._cascade_head
+        elif self._cfg.cascade:
+            _note_feature_disabled(
+                "cascade", "mesh_serving_single_chip_state_pool")
         # H2D prefetch stage (cfg.prefetch): placement of collected
         # batches moves off the tick thread onto a dedicated transfer
         # thread, double-buffered at depth 2 to match the drain pipeline.
@@ -906,6 +972,12 @@ class InferenceEngine:
             if self._cfg.mesh:
                 _note_feature_disabled(
                     "quality_device_stats", "mesh_thumbnail_not_sharded")
+
+    @property
+    def cascade(self):
+        """The cascade scheduler, or None when cfg.cascade is off (the
+        REST endpoint keys its 400 on this, r9 convention)."""
+        return self._cascade
 
     # -- lifecycle --
 
@@ -1885,6 +1957,12 @@ class InferenceEngine:
                     groups = self._roi_transform(groups)
                 t_collect = time.time() if self._cfg.stage_trace else 0.0
                 self._dispatch(groups, t_collect)
+                if self._cascade is not None:
+                    # CASCADE: scatter harvested track tiles, run the
+                    # temporal head on cadence ticks, fan out events
+                    # (uplink / archive / metrics / spans). A pure tap —
+                    # the detect path above never branches on it.
+                    self._cascade_tick()
                 # Scope per-stream tracker state to streams that still
                 # exist: a long-lived engine with churning device_ids must
                 # not accumulate IoUTracker entries forever. Absence is
@@ -1893,7 +1971,8 @@ class InferenceEngine:
                 # that window must not reset the stream's track-id
                 # numbering (invariant in _assign_tracks).
                 if self._trackers or self._ann_state or self._thumbs \
-                        or (self._roi is not None and self._roi):
+                        or (self._roi is not None and self._roi) \
+                        or (self._cascade is not None and self._cascade):
                     now = time.monotonic()
                     # GC keys on bus PRESENCE, not on inference_streams():
                     # a live stream gated >grace (inference_model toggled
@@ -1903,9 +1982,12 @@ class InferenceEngine:
                     present = set(present)
                     roi_ids = set(self._roi) if self._roi is not None \
                         else set()
+                    casc_ids = set(self._cascade) \
+                        if self._cascade is not None else set()
                     with self._state_lock:
                         for d in (set(self._trackers) | set(self._ann_state)
-                                  | set(self._thumbs) | roi_ids):
+                                  | set(self._thumbs) | roi_ids
+                                  | casc_ids):
                             if d in present:
                                 self._tracker_absent.pop(d, None)
                                 continue
@@ -1928,6 +2010,11 @@ class InferenceEngine:
                                 # stream (first frame re-gates to full).
                                 if self._roi is not None:
                                     self._roi.pop(d, None)
+                                # Cascade track state goes with the
+                                # stream: device slots free, event
+                                # machines clear without firing.
+                                if self._cascade is not None:
+                                    self._cascade.pop(d, None)
                                 if self.quality is not None:
                                     self.quality.forget(d)
                                 del self._tracker_absent[d]
@@ -2542,6 +2629,18 @@ class InferenceEngine:
             # would freeze old tracks and hand their ids to the next
             # object that appears nearby.
             self._assign_tracks(device_id, spec.name, detections)
+            if (self._cascade is not None and group.frames.ndim == 4
+                    and group.crops is None):
+                # CASCADE harvest: letterbox each tracked detection's
+                # crop into its device clip ring (scattered next tick).
+                # Classic full-frame slots only — frames[i] is the
+                # leased host buffer, valid until _emit returns; canvas
+                # and clip slots have no per-stream full frame here.
+                try:
+                    self._cascade.harvest(
+                        device_id, group.frames[i], detections, meta)
+                except Exception:
+                    log.exception("cascade harvest failed; continuing")
         if self.quality is not None:
             self._observe_quality(host, i, device_id, meta, detections)
         latency = max(0.0, now_ms - meta.timestamp_ms) if meta.timestamp_ms else 0.0
@@ -2809,6 +2908,129 @@ class InferenceEngine:
             )
         for det, tid in zip(detections, ids):
             det.track_id = tid
+
+    # -- temporal cascade (CASCADE, temporal/scheduler.py) -----------------
+
+    def _cascade_head(self, pool, slot_idx, time_idx, n_real):
+        """Temporal-head dispatch for the cascade scheduler: device-side
+        time-ordered clip gather from the state pool, then one bucketed
+        program (VideoMAE head + logistic anomaly scorer) cached in the
+        engine step cache under its own ``cascade:`` model key. Returns
+        (host outputs, device_ms). The pool array itself never crosses
+        to the host — only the small outputs dict does; the two int32
+        index vectors are the aux H2D traffic (``vep_h2d_*``)."""
+        import jax
+
+        name = self._cfg.cascade_model
+        spec, model, variables = self._ensure_model(name)
+        bucket = int(slot_idx.shape[0])
+        side = pool.side
+        label = f"cascade:{name}"
+        key = (label, getattr(self._cfg, "stem", "classic"),
+               (side, side), bucket)
+        fn = self._step_cache.get(key)
+        if fn is None:
+            self._m_cache_miss.inc()
+            fn = _TimedStep(
+                jax.jit(_build_cascade_head(
+                    model, self._cfg.cascade_score_w,
+                    self._cfg.cascade_score_b)),
+                self.perf, label, (side, side), bucket)
+            self._step_cache[key] = fn
+        else:
+            self._m_cache_hit.inc()
+        t0 = time.perf_counter()
+        clips = pool.gather(slot_idx, time_idx)
+        outputs = fn(variables, clips)
+        host = {k: np.asarray(v) for k, v in outputs.items()}
+        device_ms = (time.perf_counter() - t0) * 1000.0
+        self.perf.note_h2d(
+            f"cascade/{name}", bucket,
+            int(slot_idx.nbytes + time_idx.nbytes), 0.0)
+        self.perf.note_batch(
+            f"cascade/{name}", (side, side), bucket, device_ms, n_real,
+            streams=0,  # head passes are not emitted frames: keep the
+                        # aggregate-fps window honest (quality pattern)
+        )
+        return host, device_ms
+
+    def _cascade_tick(self) -> None:
+        """Drive one scheduler tick and fan its outcome out: lineage
+        spans for sampled due tracks (the ``temporal`` stage joining
+        detect→track→temporal→emit) and per-event uplink / archive /
+        metrics emission. Never raises — the detect path must not feel
+        a cascade failure."""
+        try:
+            res = self._cascade.tick()
+        except Exception:
+            log.exception("cascade tick failed; continuing")
+            return
+        if tracer.enabled and res.head_ms is not None:
+            t_now = time.time()
+            for stream, meta in res.head_tracks:
+                if meta is None or not tracer.sampled(meta.packet):
+                    continue
+                tracer.record(
+                    stream, "temporal", meta.packet, ts=t_now,
+                    dur_ms=res.head_ms,
+                    trace_id=trace_id_of(meta, stream),
+                )
+        for ev in res.events:
+            self._cascade_emit_event(ev)
+
+    def _cascade_emit_event(self, ev: dict) -> None:
+        """One cascade event out three planes, each failing
+        independently: ``vep_cascade_events_total`` metrics, an
+        Annotate-shaped record on the existing uplink batch path
+        (type="cascade", retry+breaker+spool downstream), and — on
+        "enter" — the track's recent tile history into the archive sink
+        as a clip segment."""
+        kind = ev["kind"]
+        self.perf.note_cascade_event(kind)
+        meta = ev.get("meta")
+        now_ms = int(time.time() * 1000)
+        ts = (meta.timestamp_ms
+              if meta is not None and getattr(meta, "timestamp_ms", 0)
+              else now_ms)
+        log.info(
+            "cascade %s stream=%s track=%s score=%.3f tick=%d",
+            kind, ev["stream"], ev["track_id"], ev["score"], ev["tick"],
+        )
+        if self._annotations is not None:
+            try:
+                req = pb.AnnotateRequest(
+                    device_name=ev["stream"],
+                    type="cascade",
+                    start_timestamp=ts,
+                    object_type=f"anomaly_{kind}",
+                    object_tracking_id=str(ev["track_id"]),
+                    confidence=float(ev["score"]),
+                    ml_model="temporal.cascade",
+                    ml_model_version=self._cfg.cascade_model,
+                    width=(getattr(meta, "width", 0)
+                           if meta is not None else 0),
+                    height=(getattr(meta, "height", 0)
+                            if meta is not None else 0),
+                )
+                self._annotations.publish(req.SerializeToString())
+            except Exception:
+                log.exception("cascade uplink publish failed")
+        history = ev.get("history")
+        if kind == "enter" and self._archiver is not None and history:
+            try:
+                from ..ingest.archive import GopSegment
+
+                fps = max(1.0, 1000.0 / max(self._cfg.tick_ms, 1))
+                dur_ms = int(len(history) * 1000.0 / fps)
+                self._archiver.submit(GopSegment(
+                    device_id=f"cascade_{ev['stream']}",
+                    start_ts_ms=ts - dur_ms,
+                    end_ts_ms=ts,
+                    fps=fps,
+                    frames=list(history),
+                ))
+            except Exception:
+                log.exception("cascade archive trigger failed")
 
     def _to_detections(self, host: dict, i: int, spec=None) -> List[pb.Detection]:
         spec = spec or self._spec

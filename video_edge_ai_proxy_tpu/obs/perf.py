@@ -282,6 +282,31 @@ class PerfTracker:
         self._roi_fps = _RateWindow(window_s=fps_window_s)
         self._roi = {"idle": 0, "roi": 0, "full": 0, "crops": 0,
                      "canvases": 0, "unrouted": 0, "area_frac": None}
+        # Temporal cascade attribution (temporal/scheduler.py, engine
+        # cfg.cascade): detect runs every tick, the temporal head at
+        # cadence 1/N — the cadence gauge (head batches over cascade
+        # ticks) is the live form of the smoke artifact's
+        # cascade_head_cadence gate.
+        self._m_cascade_ticks = reg.counter(
+            "vep_cascade_ticks_total",
+            "Engine ticks observed by the cascade scheduler").labels()
+        self._m_cascade_head = reg.counter(
+            "vep_cascade_head_batches_total",
+            "Temporal-head batches dispatched (cadence ticks with due "
+            "tracks)").labels()
+        self._m_cascade_events = reg.counter(
+            "vep_cascade_events_total",
+            "Track event transitions fired by the hysteresis machine",
+            ("kind",))
+        self._m_cascade_tracks = reg.gauge(
+            "vep_cascade_tracks",
+            "Track slots live in the device-resident state pool").labels()
+        self._m_cascade_cadence = reg.gauge(
+            "vep_cascade_head_cadence",
+            "Cascade ticks per temporal-head batch (target: "
+            "cascade_every_n)").labels()
+        self._cascade = {"ticks": 0, "head_batches": 0, "head_slots": 0,
+                         "events": {}, "tracks": 0, "high_water": 0}
 
     # -- compile-time attribution ----------------------------------------
 
@@ -436,6 +461,48 @@ class PerfTracker:
     def roi_equivalent_fps(self) -> float:
         return self._roi_fps.rate(self._clock())
 
+    # -- temporal cascade attribution (cfg.cascade, temporal/) -------------
+
+    def note_cascade_tick(self) -> None:
+        """One engine tick seen by the cascade scheduler (fires whether
+        or not this tick is a head-cadence tick)."""
+        self._m_cascade_ticks.inc()
+        with self._lock:
+            self._cascade["ticks"] += 1
+            self._set_cascade_cadence_locked()
+
+    def note_cascade_head(self, slots: int) -> None:
+        """One temporal-head batch dispatched with ``slots`` live track
+        slots (device time/H2D ride note_batch/note_h2d under the
+        ``cascade/<model>`` key, same as every other program)."""
+        self._m_cascade_head.inc()
+        with self._lock:
+            self._cascade["head_batches"] += 1
+            self._cascade["head_slots"] += int(slots)
+            self._set_cascade_cadence_locked()
+
+    def note_cascade_event(self, kind: str) -> None:
+        """One hysteresis transition ("enter"/"exit") fired for a track."""
+        self._m_cascade_events.labels(kind).inc()
+        with self._lock:
+            ev = self._cascade["events"]
+            ev[kind] = ev.get(kind, 0) + 1
+
+    def note_cascade_slots(self, in_use: int, high_water: int) -> None:
+        """State-pool occupancy after a cascade tick (slot-conservation
+        evidence: in_use tracks live tracks, high_water stays bounded
+        across churn)."""
+        self._m_cascade_tracks.set(float(in_use))
+        with self._lock:
+            self._cascade["tracks"] = int(in_use)
+            self._cascade["high_water"] = max(
+                self._cascade["high_water"], int(high_water))
+
+    def _set_cascade_cadence_locked(self) -> None:
+        c = self._cascade
+        if c["head_batches"]:
+            self._m_cascade_cadence.set(c["ticks"] / c["head_batches"])
+
     def _make_h2d_cell(self, key: Tuple[str, int]) -> _H2DCell:
         model, bucket = key
         b = str(bucket)
@@ -539,5 +606,22 @@ class PerfTracker:
                 if roi["area_frac"] is not None else None,
                 "unrouted": roi["unrouted"],
                 "equivalent_fps": round(self.roi_equivalent_fps(), 1),
+            }
+        with self._lock:
+            casc = dict(self._cascade)
+            casc["events"] = dict(casc["events"])
+        if casc["ticks"] or casc["head_batches"]:
+            out["cascade"] = {
+                "ticks": casc["ticks"],
+                "head_batches": casc["head_batches"],
+                "head_cadence": round(
+                    casc["ticks"] / casc["head_batches"], 2)
+                if casc["head_batches"] else None,
+                "slots_per_head": round(
+                    casc["head_slots"] / casc["head_batches"], 2)
+                if casc["head_batches"] else None,
+                "events": casc["events"],
+                "tracks": casc["tracks"],
+                "slot_high_water": casc["high_water"],
             }
         return out

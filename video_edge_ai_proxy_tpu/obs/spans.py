@@ -19,6 +19,12 @@ Stage vocabulary (the segments a soak report breaks latency into):
 - ``submit``  — frame's batch was handed to the device drain thread.
 - ``device``  — jitted step drained; ``dur_ms`` = device wall time.
 - ``emit``    — postprocessed result published to the result plane.
+- ``temporal`` — cascade temporal-head pass consumed this frame's track
+  crop (temporal/scheduler.py); ``dur_ms`` = head device wall time for
+  the pass. Off the per-frame path (cadence 1/N), so lineages show the
+  detect→track→temporal→emit join only on head ticks. Not a LEG: the
+  stage rides ``stage_breakdown``'s per-stage table and Chrome export,
+  but the leg latency table stays per-frame.
 - ``dropped`` — terminal: the frame left the pipeline without a result
   (staleness shed, shutdown drain, unrouted ROI crop). Closing the
   lineage here keeps trace export and ``stage_breakdown`` honest about
@@ -46,7 +52,8 @@ import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional
 
-STAGES = ("publish", "collect", "submit", "device", "emit", "dropped")
+STAGES = ("publish", "collect", "submit", "device", "emit", "temporal",
+          "dropped")
 
 # Latency legs derivable from a complete lineage, in pipeline order.
 LEGS = ("ingest_bus", "batch", "device", "emit", "total")

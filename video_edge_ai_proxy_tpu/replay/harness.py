@@ -291,7 +291,7 @@ def run_fleet_soak(
     warmup_timeout_s: float = 1800.0, sample_every_s: float = 2.0,
     timeline_bin_s: float = 10.0, trace_sample_every: int = 4,
     profile_on_burn: bool = False, prof_dir: Optional[str] = None,
-    quality_kinds: tuple = (),
+    quality_kinds: tuple = (), engine_overrides: Optional[dict] = None,
 ) -> dict:
     """The >=120 s chaos soak. Returns the artifact's "soak" section.
 
@@ -415,9 +415,7 @@ def run_fleet_soak(
             # which even the saturated CPU soak serves losslessly.
             quality_canary_fps=2.0,
         )
-    eng = InferenceEngine(
-        bus,
-        EngineConfig(
+    eng_cfg = EngineConfig(
             model=default_model, tick_ms=tick_ms, stage_trace=True,
             batch_buckets=(1, 2, 4, 8, 16), track=False,
             annotation_emit="all",   # firehose: conservation needs volume
@@ -437,7 +435,18 @@ def run_fleet_soak(
             slo_warmup_s=(
                 10.0 if (profile_on_burn or has_quality) else 60.0),
             **qcfg,
-        ),
+    )
+    if engine_overrides:
+        # Engine-config passthrough (r17): cascade-enabled soak members
+        # (track=True + cascade=True + a tiny head model) ride the same
+        # harness without a parameter per knob; replace() keeps override
+        # keys validated against the dataclass fields.
+        import dataclasses as _dc
+
+        eng_cfg = _dc.replace(eng_cfg, **engine_overrides)
+    eng = InferenceEngine(
+        bus,
+        eng_cfg,
         model_resolver=lambda d: assignment.get(d, ""),
         annotations=ann_q,
     )

@@ -209,6 +209,10 @@ def build_app(
             # tools/obs_export.py --check).
             "quality": engine.quality.snapshot()
             if engine is not None and engine.quality is not None else None,
+            # r14 temporal cascade: scheduler/track/event state (the same
+            # snapshot /api/v1/cascade serves).
+            "cascade": engine.cascade.snapshot()
+            if engine is not None and engine.cascade is not None else None,
         }
         return web.json_response(out)
 
@@ -237,6 +241,18 @@ def build_app(
         out = await asyncio.to_thread(engine.quality.snapshot)
         out["canary"] = (engine.canary.snapshot()
                         if engine.canary is not None else None)
+        return web.json_response(out)
+
+    async def cascade(_request: web.Request) -> web.Response:
+        """Temporal cascade state (temporal/scheduler.py): head cadence,
+        per-track scores/activity, state-pool occupancy, recent events.
+        400 when the cascade is disabled (engine.cascade config, same
+        kill-switch convention as /api/v1/quality)."""
+        if engine is None:
+            return _error(400, "engine not running")
+        if engine.cascade is None:
+            return _error(400, "cascade disabled (engine.cascade config)")
+        out = await asyncio.to_thread(engine.cascade.snapshot)
         return web.json_response(out)
 
     async def trace(request: web.Request) -> web.Response:
@@ -453,6 +469,7 @@ def build_app(
     app.router.add_get("/api/v1/stats", stats)
     app.router.add_get("/api/v1/slo", slo)
     app.router.add_get("/api/v1/quality", quality)
+    app.router.add_get("/api/v1/cascade", cascade)
     app.router.add_get("/api/v1/trace", trace)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)

@@ -521,6 +521,55 @@ class StreamRouter:
             self._m_streams.set(len(self._streams))
             return member
 
+    def admit(self, name: str, rtsp_endpoint: str, *,
+              priority: int = 0, inference_model: str = "",
+              annotation_policy: str = "") -> str:
+        """Health-aware admission: place a NEW stream on the healthiest
+        ring member at attach time — placement only, existing streams
+        never move (that is run_pass's job). Healthiest = max score_ema
+        among placeable ring members in the latest health view; with no
+        scored candidates this degrades to the consistent-hash placement
+        (add_stream's path), so admission is never worse than hashing.
+        Raises like add_stream when nothing is placeable."""
+        health = self.fleet.health()
+        with self._lock:
+            if name in self._streams:
+                raise ValueError(f"stream {name!r} already routed")
+            members = set(self.ring.members)
+            best, best_score = None, None
+            for row in health:
+                member = row.get("instance")
+                if member not in members:
+                    continue
+                if not row.get("up") or row.get("stale"):
+                    continue
+                if row.get("healthy", True) is False:
+                    continue
+                client = self.clients.get(member)
+                if client is not None and client.breaker.state == "open":
+                    continue
+                score = row.get("score_ema")
+                if score is None:
+                    continue
+                if best_score is None or score > best_score:
+                    best, best_score = member, score
+            member = best if best is not None else self.ring.place(name)
+            if member is None:
+                raise RuntimeError(
+                    "no placeable member (ring empty — all members dead, "
+                    "unhealthy, or breaker-open)")
+            self.clients[member].start_stream(
+                name, rtsp_endpoint, inference_model, annotation_policy)
+            self._streams[name] = {
+                "url": rtsp_endpoint, "model": inference_model,
+                "policy": annotation_policy, "priority": int(priority),
+                "member": member, "placed_at": self._clock(),
+                "migrations": 0,
+            }
+            self._m_placements.labels(member).inc()
+            self._m_streams.set(len(self._streams))
+            return member
+
     def remove_stream(self, name: str) -> None:
         with self._lock:
             rec = self._streams.pop(name, None)

@@ -244,6 +244,7 @@ class Server:
         else:
             self.annotations = AnnotationQueue(**ann_kwargs)
         self.engine = None
+        self._cascade_archiver = None
         if enable_engine:
             try:
                 from ..engine import InferenceEngine
@@ -273,12 +274,21 @@ class Server:
                 engine_cfg = dataclasses.replace(
                     engine_cfg, prof_dir=os.path.join(data_dir, "prof")
                 )
+            if engine_cfg.cascade:
+                # Cascade enter-events archive their trigger clip (the
+                # track's recent tiles) as a GOP segment; park those
+                # next to the rest of the persistent state.
+                from ..ingest.archive import SegmentArchiver
+
+                self._cascade_archiver = SegmentArchiver(
+                    os.path.join(data_dir, "cascade_clips"))
             self.engine = InferenceEngine(
                 self.bus, engine_cfg, annotations=self.annotations,
                 model_resolver=self.process_manager.inference_model_of,
                 annotation_policy_resolver=(
                     self.process_manager.annotation_policy_of
                 ),
+                archiver=self._cascade_archiver,
             )
             if self.engine.slo is not None:
                 # One boot line naming the live objectives: operators see
@@ -306,6 +316,8 @@ class Server:
             log.info("resumed %d cameras from registry", resumed)
         self.cron.start()
         self.annotations.start()
+        if self._cascade_archiver is not None:
+            self._cascade_archiver.start()
         if self.engine is not None:
             self.engine.start()
 
@@ -373,6 +385,8 @@ class Server:
             self._rest.stop()
         if self.engine is not None:
             self.engine.stop()
+        if self._cascade_archiver is not None:
+            self._cascade_archiver.stop()
         self.annotations.stop()
         self.cron.stop()
         # Keep the registry: cameras resume on next boot (reference behavior —

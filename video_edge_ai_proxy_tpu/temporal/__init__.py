@@ -1,0 +1,25 @@
+"""Stage-wise temporal serving (CASCADE, ROADMAP item 2).
+
+Detector every tick, tracker-keyed ROI crops into a device-resident
+clip ring, temporal head at cadence 1/N as its own bucketed program in
+the engine step cache, event verdicts out through uplink / archive /
+metrics. Composition of existing plumbing (ViCoStream, arxiv 2606.19849
+stage-wise coordination; Jetson anomaly pipeline, arxiv 2307.16834
+end-to-end template): the r13 ``CropPlacement`` lineage and the r12
+``_ThumbPool`` device-state pattern, re-keyed from stream to track.
+
+Import-light: jax, the model registry, and the canvas packer load
+lazily on first use so control-plane imports never initialize a
+backend (CLAUDE.md rule).
+"""
+
+from .events import TrackEventTracker
+from .scheduler import CascadeScheduler, CascadeTickResult
+from .state_pool import TrackStatePool
+
+__all__ = [
+    "CascadeScheduler",
+    "CascadeTickResult",
+    "TrackEventTracker",
+    "TrackStatePool",
+]

@@ -1,0 +1,73 @@
+"""Per-track event hysteresis for the temporal cascade.
+
+Same two-sided debounce shape as the stream-quality verdict machine
+(obs/quality.py): a track's anomaly score must clear the threshold for
+``enter_n`` CONSECUTIVE cascade observations before an "enter" event
+fires, and sit below it for ``exit_n`` consecutive observations before
+the matching "exit" — a score that flaps across the threshold resets
+the run and fires nothing. Counts, not seconds: cascade observations
+are cadence-quantized (one per temporal-head pass, every
+``cascade_every_n`` ticks), so wall-clock debounce would alias against
+the head cadence.
+
+Exactly-once by construction: a transition fires only at the moment the
+active flag flips, so each enter/exit boundary produces exactly one
+event no matter how long the condition persists (the exactly-once
+uplink-delivery gate in CASCADE_r01.json rests on this).
+
+Pure Python, jax-free, no locking — the owning scheduler serializes
+access under its own lock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class TrackEventTracker:
+    """Enter/exit hysteresis state machines keyed by track."""
+
+    __slots__ = ("threshold", "enter_n", "exit_n", "_state")
+
+    def __init__(self, threshold: float = 0.5, enter_n: int = 2,
+                 exit_n: int = 2):
+        self.threshold = float(threshold)
+        self.enter_n = max(1, int(enter_n))
+        self.exit_n = max(1, int(exit_n))
+        # key -> [active, consecutive run toward the opposite state]
+        self._state: Dict[str, list] = {}
+
+    def observe(self, key: str, score: float) -> Optional[str]:
+        """Feed one cascade observation; returns "enter"/"exit" when the
+        track transitions, else None."""
+        st = self._state.setdefault(key, [False, 0])
+        hot = float(score) >= self.threshold
+        if st[0] == hot:
+            # Confirmation of the current state: any partial run toward
+            # the opposite state was a flap — reset it.
+            st[1] = 0
+            return None
+        st[1] += 1
+        if st[1] < (self.enter_n if hot else self.exit_n):
+            return None
+        st[0] = hot
+        st[1] = 0
+        return "enter" if hot else "exit"
+
+    def active(self, key: str) -> bool:
+        st = self._state.get(key)
+        return bool(st and st[0])
+
+    def active_keys(self) -> List[str]:
+        return [k for k, st in self._state.items() if st[0]]
+
+    def pop(self, key: str, default=None):
+        """Drop a track's machine (track expired or stream GC'd). A
+        reappearing key starts cold — no event fires for the removal."""
+        return self._state.pop(key, default)
+
+    def __len__(self) -> int:
+        return len(self._state)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._state

@@ -1,0 +1,364 @@
+"""Cascade scheduler: detect every tick, temporal head at cadence 1/N.
+
+Stage-wise coordinated serving (ViCoStream, arxiv 2606.19849; Jetson
+anomaly pipeline, arxiv 2307.16834): the per-frame detect megastep runs
+unchanged every engine tick; this scheduler taps its emitted detections
+(``harvest``, drain thread), letterboxes each tracked detection's box
+through the MOSAIC ``CanvasPacker`` into a ``side``×``side`` tile whose
+``CropPlacement`` provenance is keyed by TRACK ("stream#track_id")
+rather than stream, appends the tile to that track's device-resident
+clip ring (:class:`temporal.state_pool.TrackStatePool`), and every
+``every_n`` ticks dispatches the expensive stage — the VideoMAE
+temporal head plus a logistic anomaly scorer over pooled clip features
+— over all tracks holding a complete clip, as a separate bucketed
+program in the engine's step cache. Multi-rate programs, not dynamic
+control flow: the detect program never branches on the cascade.
+
+Threading: ``harvest`` runs on the engine drain thread (inside
+``_emit_slot``), ``tick`` on the engine tick thread, stream GC ``pop``
+under the engine state lock — all serialized by one internal lock,
+which is RELEASED around the head dispatch so device compile/compute
+never stalls result emission.
+
+The head itself is engine-owned (it needs the model registry, the step
+cache, and perf attribution): the engine assigns ``self.head`` a
+callable ``(pool, slot_idx, time_idx, n_real) -> (outputs, device_ms)``
+where ``outputs`` holds host arrays ``event_score [bucket]``,
+``features [bucket, 3]``, ``logits [bucket, num_classes]``. The pool
+array itself never crosses to the host (ISSUE 14 no-D2H acceptance).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from .events import TrackEventTracker
+from .state_pool import TrackStatePool
+
+log = logging.getLogger(__name__)
+
+# Head/scatter batch-size buckets (slot counts, same closed-shape-set
+# discipline as the frame-batch buckets in engine/collector.py). Due
+# tracks beyond the max bucket wait for the next cadence tick.
+BUCKETS = (4, 8, 16, 32, 64)
+
+
+def bucket_for(n: int) -> int:
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    return BUCKETS[-1]
+
+
+@dataclass
+class _Track:
+    """Host-side per-track record; the clip lives in the device pool."""
+
+    stream: str
+    track_id: str
+    tile: Optional[np.ndarray] = None      # latest [side, side, 3] u8
+    updated: bool = False                  # tile pending scatter
+    placement: object = None               # CropPlacement provenance
+    meta: object = None                    # source FrameMeta (span ids)
+    last_seen: int = 0                     # scheduler tick of last harvest
+    last_score: Optional[float] = None
+    observed: int = 0                      # head passes consumed
+    history: deque = field(default_factory=deque)  # archive tiles
+
+
+@dataclass
+class CascadeTickResult:
+    """One tick's outward-facing outcome, consumed by the engine."""
+
+    events: List[dict]
+    head_tracks: List[Tuple[str, object]]  # (stream, meta) per due track
+    head_ms: Optional[float]
+
+
+class CascadeScheduler:
+    """Tracker-keyed temporal state + cadence dispatch + event machine."""
+
+    def __init__(self, *, model: str, every_n: int = 4, crop: int = 0,
+                 clip_len: int = 0, threshold: float = 0.5,
+                 enter_n: int = 2, exit_n: int = 2, ttl_ticks: int = 30,
+                 perf=None, history_keep: int = 0, events_keep: int = 64):
+        self.model = str(model)
+        self.every_n = max(1, int(every_n))
+        self._crop = int(crop)
+        self._clip_len = int(clip_len)
+        self.ttl_ticks = max(1, int(ttl_ticks))
+        self.perf = perf
+        self._history_keep = int(history_keep)
+        self._lock = threading.Lock()
+        self._tracks: Dict[str, _Track] = {}
+        self._by_stream: Dict[str, Set[str]] = {}
+        self._events = TrackEventTracker(
+            threshold=threshold, enter_n=enter_n, exit_n=exit_n)
+        self._pool: Optional[TrackStatePool] = None
+        self._packer = None
+        self.side = 0
+        self.clip_len = 0
+        self.ticks = 0
+        self.head_dispatches = 0
+        self.head_ticks: deque = deque(maxlen=256)
+        self.harvested = 0
+        self._event_counts: Dict[str, int] = {}
+        self._events_log: deque = deque(maxlen=int(events_keep))
+        # Engine-assigned: (pool, slot_idx, time_idx, n_real) ->
+        # (host outputs dict, device_ms).
+        self.head: Optional[Callable] = None
+
+    # -- lazy geometry (registry imports jax; CLAUDE.md lazy-import rule) --
+
+    def _resolve(self) -> None:
+        if self._pool is not None:
+            return
+        from ..models import registry
+
+        spec = registry.get(self.model)
+        self.side = int(self._crop or spec.input_size)
+        self.clip_len = int(self._clip_len or spec.clip_len or 4)
+        from ..engine.collector import CanvasPacker
+
+        # One tile per pack call: max_canvases=1 makes the canvas the
+        # tile; gap=0 because there is nothing to separate. The packer's
+        # power-of-two decimation + min_crop inflation + 114-gray
+        # letterbox background all carry over unchanged.
+        self._packer = CanvasPacker(
+            side=self.side, gap=0, max_canvases=1,
+            min_crop=min(16, self.side))
+        self._pool = TrackStatePool(self.side, self.clip_len)
+
+    # -- stream-keyed dict protocol (engine GC union membership) -----------
+
+    def __bool__(self) -> bool:
+        return bool(self._by_stream)
+
+    def __len__(self) -> int:
+        return len(self._by_stream)
+
+    def __iter__(self):
+        with self._lock:
+            return iter(list(self._by_stream))
+
+    def pop(self, stream: str, default=None):
+        """Drop ALL of a stream's tracks (engine GC: stream left the
+        bus). Pool slots return to the free list; event machines clear
+        without firing (the stream is gone — no consumer)."""
+        with self._lock:
+            keys = self._by_stream.pop(stream, None)
+            if not keys:
+                return default
+            for key in keys:
+                self._tracks.pop(key, None)
+                if self._pool is not None:
+                    self._pool.pop(key, None)
+                self._events.pop(key, None)
+            return keys
+
+    # -- drain-thread tap ---------------------------------------------------
+
+    def harvest(self, stream: str, frame: np.ndarray, detections,
+                meta=None) -> int:
+        """Tap one emitted detect slot: letterbox each tracked
+        detection's box into this track's tile, pending scatter at the
+        next tick. ``frame`` is the leased host buffer — the packer blit
+        copies out of it, nothing retains a reference."""
+        tracked = [d for d in detections if getattr(d, "track_id", "")]
+        if not tracked:
+            return 0
+        self._resolve()
+        n = 0
+        with self._lock:
+            tick = self.ticks
+            for det in tracked:
+                x0 = det.box.left
+                y0 = det.box.top
+                box = (x0, y0, x0 + det.box.width, y0 + det.box.height)
+                key = f"{stream}#{det.track_id}"
+                canvases, placements, overflow = self._packer.pack(
+                    [(key, meta, frame, box)])
+                if overflow or not len(placements):
+                    continue
+                rec = self._tracks.get(key)
+                if rec is None:
+                    rec = _Track(stream=stream, track_id=str(det.track_id))
+                    if self._history_keep:
+                        rec.history = deque(maxlen=self._history_keep)
+                    else:
+                        rec.history = deque(maxlen=2 * self.clip_len)
+                    self._tracks[key] = rec
+                    self._by_stream.setdefault(stream, set()).add(key)
+                rec.tile = canvases[0]
+                rec.updated = True
+                rec.placement = placements[0]
+                rec.meta = meta
+                rec.last_seen = tick
+                rec.history.append(canvases[0])
+                n += 1
+            self.harvested += n
+        return n
+
+    # -- tick-thread drive ---------------------------------------------------
+
+    def tick(self) -> CascadeTickResult:
+        """One engine tick: batched scatter of harvested tiles, stale-
+        track expiry, and — on cadence ticks — the temporal-head pass
+        plus hysteresis evaluation. Returns fired events and the head
+        pass's (stream, meta) list for lineage spans."""
+        import time as _time
+
+        events: List[dict] = []
+        head_tracks: List[Tuple[str, object]] = []
+        head_ms: Optional[float] = None
+        due: List[str] = []
+        with self._lock:
+            self.ticks += 1
+            tick = self.ticks
+            if self.perf is not None:
+                self.perf.note_cascade_tick()
+            updated = [(k, r) for k, r in self._tracks.items() if r.updated]
+            if updated:
+                self._resolve()
+                keys = [k for k, _ in updated]
+                tiles = np.stack([r.tile for _, r in updated])
+                bucket = bucket_for(len(keys))
+                t0 = _time.perf_counter()
+                aux = self._pool.scatter(keys, tiles, bucket=bucket)
+                dt = _time.perf_counter() - t0
+                if self.perf is not None:
+                    self.perf.note_h2d(
+                        f"cascade/{self.model}", bucket,
+                        tiles.nbytes + aux, dt)
+                for _, r in updated:
+                    r.updated = False
+            # Track TTL: a track the detector stopped matching frees its
+            # slot (IoUTracker coasts max_misses frames first, so the
+            # TTL only fires once the tracker itself gave up).
+            stale = [k for k, r in self._tracks.items()
+                     if tick - r.last_seen > self.ttl_ticks]
+            for key in stale:
+                self._drop_track_locked(key)
+            if (self.head is not None and self._pool is not None
+                    and tick % self.every_n == 0):
+                due = [k for k in self._tracks if self._pool.full(k)]
+                due = due[:BUCKETS[-1]]
+                if due:
+                    bucket = bucket_for(len(due))
+                    slot_idx, time_idx = self._pool.gather_indices(
+                        due, bucket)
+                    pool = self._pool
+        if due:
+            # Head dispatch OUTSIDE the lock: compile on a cache miss
+            # takes seconds and must not stall harvest on the drain
+            # thread. The pool array snapshot is immutable (functional
+            # updates replace, never mutate), so a concurrent scatter
+            # cannot corrupt the gather.
+            try:
+                outputs, head_ms = self.head(pool, slot_idx, time_idx,
+                                             len(due))
+            except Exception:
+                log.exception("cascade head dispatch failed; continuing")
+                outputs = None
+            if outputs is not None:
+                with self._lock:
+                    self.head_dispatches += 1
+                    self.head_ticks.append(tick)
+                    if self.perf is not None:
+                        self.perf.note_cascade_head(len(due))
+                    for i, key in enumerate(due):
+                        rec = self._tracks.get(key)
+                        if rec is None:       # expired mid-dispatch
+                            continue
+                        score = float(outputs["event_score"][i])
+                        rec.last_score = score
+                        rec.observed += 1
+                        head_tracks.append((rec.stream, rec.meta))
+                        kind = self._events.observe(key, score)
+                        if kind is None:
+                            continue
+                        ev = {
+                            "kind": kind,
+                            "stream": rec.stream,
+                            "track_id": rec.track_id,
+                            "score": score,
+                            "tick": tick,
+                            "features": [float(v)
+                                         for v in outputs["features"][i]],
+                            "logits": [float(v)
+                                       for v in outputs["logits"][i]],
+                            "meta": rec.meta,
+                            "history": (list(rec.history)
+                                        if kind == "enter" else []),
+                        }
+                        events.append(ev)
+                        self._event_counts[kind] = (
+                            self._event_counts.get(kind, 0) + 1)
+                        self._events_log.append({
+                            k: v for k, v in ev.items()
+                            if k not in ("meta", "history")
+                        })
+        if self.perf is not None and self._pool is not None:
+            self.perf.note_cascade_slots(
+                self._pool.slots_in_use(), self._pool.high_water)
+        return CascadeTickResult(events, head_tracks, head_ms)
+
+    def _drop_track_locked(self, key: str) -> None:
+        rec = self._tracks.pop(key, None)
+        if rec is not None:
+            keys = self._by_stream.get(rec.stream)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    self._by_stream.pop(rec.stream, None)
+        if self._pool is not None:
+            self._pool.pop(key, None)
+        self._events.pop(key, None)
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able state for /api/v1/cascade and the obs.cascade stats
+        section (r9 convention: quiet numbers, no device sync)."""
+        with self._lock:
+            tracks = {
+                key: {
+                    "stream": rec.stream,
+                    "track_id": rec.track_id,
+                    "last_seen_tick": rec.last_seen,
+                    "last_score": rec.last_score,
+                    "observed": rec.observed,
+                    "active": self._events.active(key),
+                    "clip_full": (self._pool.full(key)
+                                  if self._pool is not None else False),
+                }
+                for key, rec in self._tracks.items()
+            }
+            return {
+                "model": self.model,
+                "every_n": self.every_n,
+                "side": self.side,
+                "clip_len": self.clip_len,
+                "threshold": self._events.threshold,
+                "enter_n": self._events.enter_n,
+                "exit_n": self._events.exit_n,
+                "ticks": self.ticks,
+                "harvested": self.harvested,
+                "head_dispatches": self.head_dispatches,
+                "head_ticks": list(self.head_ticks),
+                "head_cadence": (round(self.ticks / self.head_dispatches, 2)
+                                 if self.head_dispatches else None),
+                "tracks": tracks,
+                "slots_in_use": (self._pool.slots_in_use()
+                                 if self._pool is not None else 0),
+                "slot_high_water": (self._pool.high_water
+                                    if self._pool is not None else 0),
+                "event_counts": dict(self._event_counts),
+                "events": list(self._events_log),
+            }

@@ -1,0 +1,190 @@
+"""Device-resident per-track clip ring for the temporal cascade.
+
+Modeled on the r12 quality thumbnail pool (engine/runner.py
+``_ThumbPool``), re-keyed from stream to track: one static-shape device
+array ``[slots, clip_len, side, side, 3] uint8`` holds every live
+track's last ``clip_len`` crop tiles as a ring. Slot assignment is a
+host-side dict (track key -> row) plus a free list; per-row write
+cursors and fill counts also live on the host, so the ONLY host<->device
+traffic is the new tiles themselves plus two small int32 index vectors
+per scatter (``vep_h2d_*`` aux bytes) — the clip contents NEVER round-
+trip to the host between ticks (ISSUE 14 acceptance: no per-tick D2H of
+the state pool; the head consumes clips via a device-side gather).
+
+Row 0 is permanently zero and is the gather target for padded bucket
+slots, so a padded head batch reads all-zero clips instead of stale
+track state. Capacity grows in ``_GROW``-row increments via ``jnp.pad``
+(device-to-device copy); scatter/gather batch sizes are bucketed by the
+caller, so program shapes stay bounded. Slot reuse needs no device-side
+zeroing: ``gather`` only ever returns rows whose fill count reached
+``clip_len``, by which point the new occupant overwrote every time
+position.
+
+Lazy jax imports (CLAUDE.md): constructing the pool is backend-free;
+the device array materializes on first ``scatter``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class TrackStatePool:
+    """Per-track device clip ring with host-side slot bookkeeping."""
+
+    _GROW = 8
+
+    __slots__ = ("side", "clip_len", "_slots", "_free", "_cursor", "_fill",
+                 "_pool", "_capacity", "_high")
+
+    def __init__(self, side: int, clip_len: int):
+        self.side = int(side)
+        self.clip_len = int(clip_len)
+        self._slots: Dict[str, int] = {}      # track key -> row (>= 1)
+        self._free: List[int] = []
+        self._cursor: Dict[int, int] = {}     # row -> next write position
+        self._fill: Dict[int, int] = {}       # row -> frames written (<= T)
+        self._pool = None                     # [cap, T, side, side, 3] u8
+        self._capacity = 0
+        self._high = 0                        # highest row ever assigned
+
+    # -- dict-protocol surface (mirrors _ThumbPool so GC reads the same) --
+
+    def __bool__(self) -> bool:
+        return bool(self._slots)
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def __iter__(self):
+        return iter(self._slots)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._slots
+
+    def pop(self, key: str, default=None):
+        """Release a track's slot back to the free list."""
+        row = self._slots.pop(key, None)
+        if row is None:
+            return default
+        self._free.append(row)
+        self._cursor.pop(row, None)
+        self._fill.pop(row, None)
+        return row
+
+    # -- occupancy ---------------------------------------------------------
+
+    @property
+    def high_water(self) -> int:
+        """Highest row ever assigned (slot-conservation evidence: stays
+        bounded across track churn because freed rows are reused)."""
+        return self._high
+
+    def slots_in_use(self) -> int:
+        return len(self._slots)
+
+    @property
+    def array(self):
+        """The live device array (None before the first scatter). Exposed
+        for the no-D2H invariant test, never for host fetches."""
+        return self._pool
+
+    def full(self, key: str) -> bool:
+        """True once the track has a complete ``clip_len``-frame clip."""
+        row = self._slots.get(key)
+        return row is not None and self._fill.get(row, 0) >= self.clip_len
+
+    # -- device ring -------------------------------------------------------
+
+    def _ensure(self, rows: int) -> None:
+        import jax.numpy as jnp
+
+        need = rows + 1
+        if self._pool is None:
+            cap = ((max(need, 2) + self._GROW - 1)
+                   // self._GROW) * self._GROW
+            self._pool = jnp.zeros(
+                (cap, self.clip_len, self.side, self.side, 3), jnp.uint8)
+            self._capacity = cap
+        elif need > self._capacity:
+            grow = ((need - self._capacity + self._GROW - 1)
+                    // self._GROW) * self._GROW
+            self._pool = jnp.pad(
+                self._pool, ((0, grow), (0, 0), (0, 0), (0, 0), (0, 0)))
+            self._capacity += grow
+
+    def _row_for(self, key: str) -> int:
+        row = self._slots.get(key)
+        if row is None:
+            row = self._free.pop() if self._free else self._high + 1
+            self._high = max(self._high, row)
+            self._slots[key] = row
+            self._cursor[row] = 0
+            self._fill[row] = 0
+        return row
+
+    def scatter(self, keys: Sequence[str], tiles: np.ndarray,
+                bucket: Optional[int] = None) -> int:
+        """Append one new crop tile per track to its ring.
+
+        ``tiles`` is ``uint8 [n, side, side, 3]`` host frames (one per
+        key, keys unique). With ``bucket`` the index vectors and tile
+        batch are padded to that length by REPEATING the last entry —
+        a duplicate write of identical data to the same cell, harmless
+        and shape-stable (bounded program count). Returns the aux index
+        bytes shipped (the two int32 vectors); the caller adds the tile
+        bytes for ``vep_h2d_*`` accounting.
+        """
+        import jax.numpy as jnp
+
+        rows = [self._row_for(k) for k in keys]
+        self._ensure(max(rows))
+        pos = [self._cursor[r] for r in rows]
+        if bucket is not None and bucket > len(rows):
+            pad = bucket - len(rows)
+            rows_v = rows + [rows[-1]] * pad
+            pos_v = pos + [pos[-1]] * pad
+            tiles = np.concatenate(
+                [tiles, np.repeat(tiles[-1:], pad, axis=0)], axis=0)
+        else:
+            rows_v, pos_v = rows, pos
+        rows_np = np.asarray(rows_v, np.int32)
+        pos_np = np.asarray(pos_v, np.int32)
+        self._pool = self._pool.at[rows_np, pos_np].set(jnp.asarray(tiles))
+        for r in rows:
+            self._cursor[r] = (self._cursor[r] + 1) % self.clip_len
+            self._fill[r] = min(self._fill[r] + 1, self.clip_len)
+        return int(rows_np.nbytes + pos_np.nbytes)
+
+    def gather_indices(self, keys: Sequence[str],
+                       bucket: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-side index plan for a time-ordered device gather.
+
+        Returns ``(slot_idx [bucket], time_idx [bucket, T])`` int32.
+        ``time_idx[i]`` unrolls track i's ring oldest-first (the cursor
+        points at the next overwrite target, which for a full ring is
+        the oldest frame). Padded slots index permanent-zero row 0.
+        """
+        T = self.clip_len
+        slot_idx = np.zeros((bucket,), np.int32)
+        time_idx = np.zeros((bucket, T), np.int32)
+        base = np.arange(T, dtype=np.int32)
+        for i, key in enumerate(keys[:bucket]):
+            row = self._slots.get(key)
+            if row is None:
+                continue
+            slot_idx[i] = row
+            time_idx[i] = (self._cursor.get(row, 0) + base) % T
+        return slot_idx, time_idx
+
+    def gather(self, slot_idx: np.ndarray, time_idx: np.ndarray):
+        """Time-ordered clips ``[bucket, T, side, side, 3] uint8`` as a
+        DEVICE array (eager jnp take/take_along_axis, same pattern as the
+        r12 quality gather): the pool contents never touch the host."""
+        import jax.numpy as jnp
+
+        clips = jnp.take(self._pool, jnp.asarray(slot_idx), axis=0)
+        t = jnp.asarray(time_idx)[:, :, None, None, None]
+        return jnp.take_along_axis(clips, t, axis=1)

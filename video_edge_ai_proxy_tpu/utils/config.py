@@ -294,6 +294,38 @@ class EngineConfig:
     quality_canary_stream: str = "_canary"
     quality_canary_fps: float = 2.0
     quality_canary_golden: int = 0     # committed fold; 0 = record-only
+    # Temporal cascade serving (CASCADE, temporal/): the detect megastep
+    # runs every tick unchanged; tracked detections' crops accumulate in
+    # a device-resident per-track clip ring and the temporal head
+    # (cascade_model + a logistic anomaly scorer over pooled clip
+    # features) runs every cascade_every_n ticks as its own bucketed
+    # program. Requires track=True (state is keyed by track id).
+    # cascade=False (default) is the kill switch: every batch takes
+    # today's stateless path bit-identically (test-pinned, same
+    # convention as roi=False / stem="classic").
+    cascade: bool = False
+    cascade_every_n: int = 4           # temporal-head cadence (ticks)
+    cascade_model: str = "videomae_b"  # registry video model for the head
+    cascade_crop: int = 0              # track tile side; 0 = model input
+    cascade_clip_len: int = 0          # ring depth; 0 = model clip_len
+    # Event hysteresis (temporal/events.py): score >= threshold for
+    # enter_n consecutive head passes fires "enter"; < threshold for
+    # exit_n fires "exit". Counts, not seconds — observations are
+    # cadence-quantized.
+    cascade_threshold: float = 0.5
+    cascade_enter_n: int = 2
+    cascade_exit_n: int = 2
+    # Logistic scorer over pooled clip features [temporal diff energy
+    # (mean |luma diff| between consecutive frames), clip luma variance,
+    # max head softmax prob]: score = sigmoid(w . f + b). Defaults make
+    # a pixel-static clip score sigmoid(b) ~= 0.018 and saturate on
+    # appearance change; the VideoMAE logits ride the event payload.
+    cascade_score_w: tuple = (2000.0, 0.0, 0.0)
+    cascade_score_b: float = -4.0
+    # Ticks without a harvested detection before a track's device slot
+    # frees (IoUTracker coasts max_misses=30 frames first, so this fires
+    # only after the tracker itself dropped the track).
+    cascade_track_ttl_ticks: int = 60
 
 
 @dataclass
