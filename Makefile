@@ -3,7 +3,8 @@
 
 .PHONY: all proto native install test bench graft clean redis-conformance \
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
-	roi-smoke fleet-obs-smoke stem-smoke router-smoke cascade-smoke
+	roi-smoke fleet-obs-smoke stem-smoke router-smoke cascade-smoke \
+	capacity-smoke
 
 all: proto native
 
@@ -206,6 +207,20 @@ router-smoke:
 			% (d['members'], d['streams'], d['burn_migrate_s'], \
 			   d['kill_replace_detect_s'], d['kill_replace_wall_s'], \
 			   d['ledger']['lost'], d['ledger']['duplicated']))"
+
+capacity-smoke:
+	python tools/capacity_smoke.py | tee /tmp/vep_capacity_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_capacity_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); \
+		print('capacity: ledger conserves (drift %.1e), kinds %s, tap %.1fus (%.2f%% of tick budget), tts %.0fs->%.0fs monotone, storm %s (saturating member: %d admissions)' \
+			% (d['ledger']['conservation']['rel_drift'], \
+			   '+'.join(d['ledger']['kinds']), \
+			   d['ledger']['ledger_tap_mean_us'], \
+			   d['ledger']['ledger_tap_pct_of_tick_budget'], \
+			   d['forecast']['tts_first_s'], d['forecast']['tts_last_s'], \
+			   d['admission']['storm_by_member'], \
+			   d['admission']['saturating_member_admissions']))"
 
 cascade-smoke:
 	python tools/cascade_smoke.py | tee /tmp/vep_cascade_smoke.json

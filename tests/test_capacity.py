@@ -1,0 +1,359 @@
+"""Capacity attribution plane tests (obs/capacity.py, r18): the
+per-stream device-time ledger and its conservation invariant, the
+busy-ring/EWMA forecast math, the /api/v1/capacity endpoint convention,
+and the capacity=False bit-identical replay pin.
+
+All tracker tests run sleep-free on an injected clock and a private
+Registry (no process-singleton pollution); the engine tests hand-step
+ticks exactly like tests/test_cascade.py."""
+
+import json
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+from video_edge_ai_proxy_tpu.obs.capacity import (
+    CONSERVATION_REL_TOL, OVERHEAD_STREAM, CapacityTracker, _BusyRing)
+from video_edge_ai_proxy_tpu.obs.metrics import Registry, lint_exposition
+from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = float(now)
+
+    def __call__(self):
+        return self.now
+
+
+def make_tracker(**kw):
+    clock = FakeClock(kw.pop("now", 1000.0))
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("slow_window_s", 100.0)
+    kw.setdefault("eval_interval_s", 0.0)
+    cap = CapacityTracker(clock=clock, registry=Registry(), **kw)
+    return cap, clock
+
+
+# ---------------------------------------------------------------------------
+# busy ring
+
+
+class TestBusyRing:
+    def test_window_total_and_epoch_reuse(self):
+        ring = _BusyRing(span_s=10.0, bin_s=1.0)
+        for t in range(5):
+            ring.record(100.0, now=float(t))
+        assert ring.total(window_s=10.0, now=4.0) == pytest.approx(500.0)
+        assert ring.total(window_s=2.0, now=4.0) == pytest.approx(200.0)
+        # A bin re-claimed by a later epoch resets lazily: the stale
+        # total from one lap ago must not leak into the new window.
+        ring.record(7.0, now=100.0)
+        assert ring.total(window_s=10.0, now=100.0) == pytest.approx(7.0)
+
+    def test_same_bin_accumulates(self):
+        ring = _BusyRing(span_s=4.0, bin_s=1.0)
+        ring.record(1.0, now=3.2)
+        ring.record(2.0, now=3.9)
+        assert ring.total(window_s=4.0, now=3.9) == pytest.approx(3.0)
+
+
+# ---------------------------------------------------------------------------
+# ledger + conservation
+
+
+class TestLedgerConservation:
+    def test_equal_split_across_occupancy_mixes(self):
+        cap, clock = make_tracker()
+        # Bucket-8 batch with 3 occupants: padding's cost is real device
+        # time the occupants caused — split equally among the three.
+        cap.note_batch("det", (64, 64), 8, 24.0, ["a", "b", "c"])
+        clock.now += 0.1
+        cap.note_batch("det", (64, 64), 2, 10.0, ["a", "b"])
+        clock.now += 0.1
+        cap.note_batch("det", (64, 64), 1, 5.0, ["c"])
+        rows = cap.streams()
+        assert rows["a"]["device_ms"] == pytest.approx(8.0 + 5.0)
+        assert rows["b"]["device_ms"] == pytest.approx(8.0 + 5.0)
+        assert rows["c"]["device_ms"] == pytest.approx(8.0 + 5.0)
+        cons = cap.conservation()
+        assert cons["balanced"] is True
+        assert cons["measured_ms"] == pytest.approx(39.0)
+        assert cons["attributed_ms"] == pytest.approx(39.0)
+        assert cons["max_batch_rel_err"] <= CONSERVATION_REL_TOL
+
+    def test_roi_canvas_share_weighting(self):
+        cap, _ = make_tracker()
+        # Canvas-area weights: 300/100 px² → 3:1 cost split, exactly.
+        cap.note_batch("det", (64, 64), 1, 8.0, ["big", "small"],
+                       weights=[300.0, 100.0], kind="roi")
+        rows = cap.streams()
+        assert rows["big"]["device_ms"] == pytest.approx(6.0)
+        assert rows["small"]["device_ms"] == pytest.approx(2.0)
+        assert rows["big"]["by_kind"] == {"roi": pytest.approx(6.0)}
+        assert cap.conservation()["balanced"] is True
+
+    def test_zero_weight_sum_degrades_to_equal_split(self):
+        cap, _ = make_tracker()
+        cap.note_batch("det", (64, 64), 1, 6.0, ["a", "b"],
+                       weights=[0.0, 0.0], kind="roi")
+        rows = cap.streams()
+        assert rows["a"]["device_ms"] == pytest.approx(3.0)
+        assert cap.conservation()["balanced"] is True
+
+    def test_cascade_cadence_amortization(self):
+        cap, _ = make_tracker()
+        # A 1/4-cadence head dispatch: the ledger carries the raw cost
+        # (conservation is against measured time), the steady-state
+        # per-tick figure carries cost/4.
+        cap.note_batch("cascade/head", (32, 32), 2, 12.0, ["a", "b"],
+                       kind="cascade", amortize_n=4)
+        rows = cap.streams()
+        assert rows["a"]["device_ms"] == pytest.approx(6.0)
+        assert rows["a"]["amortized_ms"] == pytest.approx(1.5)
+        assert rows["a"]["by_kind"] == {"cascade": pytest.approx(6.0)}
+        assert cap.conservation()["balanced"] is True
+
+    def test_unattributable_batch_lands_on_overhead(self):
+        cap, _ = make_tracker()
+        cap.note_batch("det", (64, 64), 1, 4.0, [])
+        rows = cap.streams()
+        assert rows[OVERHEAD_STREAM]["device_ms"] == pytest.approx(4.0)
+        assert cap.conservation()["balanced"] is True
+
+    def test_coast_registers_zero_cost_occupants(self):
+        cap, _ = make_tracker()
+        cap.note_coast(["idle1", "idle2"])
+        rows = cap.streams()
+        assert rows["idle1"]["device_ms"] == 0.0
+        assert rows["idle1"]["by_kind"] == {"coast": 0.0}
+        assert cap.conservation()["measured_ms"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# forecast math
+
+
+class TestForecast:
+    def test_utilization_window_share(self):
+        cap, clock = make_tracker()
+        # 200 busy ms in each of 4 seconds; young-tracker clipping means
+        # the window spans only the observed 4 s (+1 bin), never the
+        # full 10 s.
+        t0 = clock.now
+        for i in range(4):
+            clock.now = t0 + i
+            cap.note_batch("det", (64, 64), 1, 200.0, ["a"])
+        state = cap.evaluate(force=True)
+        span_s = (clock.now - t0) + 1.0
+        assert state["utilization"]["fast"] == pytest.approx(
+            800.0 / (span_s * 1000.0))
+        assert state["headroom"] == pytest.approx(
+            1.0 - state["utilization"]["fast"])
+
+    def test_ramp_produces_falling_tts(self):
+        cap, clock = make_tracker(fast_window_s=10.0, slow_window_s=100.0)
+        series = []
+        for t in range(1, 61):
+            clock.now = 1000.0 + t
+            cap.note_batch("det", (64, 64), 1, 10.0 * t, ["a"])
+            state = cap.evaluate(force=True)
+            if t >= 25:               # window full, EMA settled
+                series.append(state["time_to_saturation_s"])
+        assert all(v is not None for v in series)
+        assert all(b < a for a, b in zip(series, series[1:]))
+        assert state["slope_per_s"] > 0.0
+
+    def test_flat_load_has_no_saturation_forecast(self):
+        cap, clock = make_tracker()
+        for t in range(1, 30):
+            clock.now = 1000.0 + t
+            cap.note_batch("det", (64, 64), 1, 100.0, ["a"])
+            state = cap.evaluate(force=True)
+        # Steady utilization → slope EMA ~0 → no forecast (not
+        # trending toward saturation is None, never a huge number).
+        assert state["time_to_saturation_s"] is None
+
+    def test_burning_requires_both_windows(self):
+        cap, clock = make_tracker(
+            fast_window_s=5.0, slow_window_s=50.0, util_objective=0.5)
+        # A 3 s spike above the objective: fast window burns, the slow
+        # window dilutes it — not burning (SRE multi-window recipe).
+        for t in range(3):
+            clock.now = 1000.0 + t
+            cap.note_batch("det", (64, 64), 1, 900.0, ["a"])
+        clock.now = 1000.0 + 40
+        cap.note_batch("det", (64, 64), 1, 0.0, ["a"])
+        state = cap.evaluate(force=True)
+        assert state["burn"]["fast"] < 1.0 or state["burn"]["slow"] < 1.0
+        assert state["burning"] is False
+        # Sustained saturation: both windows exceed the objective.
+        cap2, clock2 = make_tracker(
+            fast_window_s=5.0, slow_window_s=50.0, util_objective=0.5)
+        for t in range(60):
+            clock2.now = 1000.0 + t
+            cap2.note_batch("det", (64, 64), 1, 900.0, ["a"])
+        state2 = cap2.evaluate(force=True)
+        assert state2["burn"]["fast"] > 1.0
+        assert state2["burn"]["slow"] > 1.0
+        assert state2["burning"] is True
+
+    def test_evaluate_throttled_unless_forced(self):
+        cap, clock = make_tracker(eval_interval_s=5.0)
+        cap.note_batch("det", (64, 64), 1, 100.0, ["a"])
+        first = cap.evaluate()
+        cap.note_batch("det", (64, 64), 1, 900.0, ["a"])
+        assert cap.evaluate() is first          # throttled: cached dict
+        assert cap.evaluate(force=True) is not first
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            CapacityTracker(util_objective=0.0, registry=Registry())
+        with pytest.raises(ValueError):
+            CapacityTracker(fast_window_s=60.0, slow_window_s=60.0,
+                            registry=Registry())
+
+    def test_snapshot_shape_and_lint(self):
+        reg = Registry()
+        cap = CapacityTracker(
+            fast_window_s=10.0, slow_window_s=100.0, eval_interval_s=0.0,
+            clock=FakeClock(1000.0), registry=reg)
+        cap.note_batch("det", (64, 64), 4, 20.0, ["a", "b"])
+        cap.note_batch("cascade/h", (32, 32), 1, 4.0, ["a"],
+                       kind="cascade", amortize_n=4)
+        cap.evaluate(force=True)
+        snap = cap.snapshot()
+        assert snap["conservation"]["balanced"] is True
+        assert set(snap["utilization"]) == {"fast", "slow"}
+        assert "det|64x64|4" in snap["cells"]
+        assert "cascade/h|32x32|1" in snap["cells"]
+        assert 0.0 <= snap["headroom"] <= 1.0
+        json.dumps(snap)                         # JSON-able end to end
+        # The vep_capacity_* families render lint-clean.
+        assert lint_exposition(reg.render()) == []
+
+
+# ---------------------------------------------------------------------------
+# engine integration: endpoint convention + replay pin
+
+
+def _meta(ts=None):
+    return FrameMeta(width=64, height=64, channels=3,
+                     timestamp_ms=ts or int(time.time() * 1000),
+                     is_keyframe=True)
+
+
+class _PM:
+    def list(self):
+        return []
+
+
+class TestCapacityEndpointConvention:
+    def test_disabled_capacity_answers_400_envelope(self):
+        import urllib.error
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        bus = MemoryFrameBus()
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5))
+        assert eng.capacity is None              # default off
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/api/v1/capacity")
+            assert ei.value.code == 400
+            body = json.loads(ei.value.read())
+            assert set(body) == {"code", "message"}
+            assert "engine.capacity" in body["message"]
+        finally:
+            srv.stop()
+            bus.close()
+
+    def test_enabled_capacity_serves_snapshot(self):
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        bus = MemoryFrameBus()
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5,
+            capacity=True))
+        assert eng.capacity is not None
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            with urllib.request.urlopen(base + "/api/v1/capacity") as r:
+                body = json.loads(r.read())
+            assert body["conservation"]["balanced"] is True
+            assert {"utilization", "burn", "headroom", "streams",
+                    "cells"} <= set(body)
+            # The one-call dashboard embed carries the same snapshot.
+            with urllib.request.urlopen(base + "/api/v1/stats") as r:
+                stats = json.loads(r.read())
+            assert stats["obs"]["capacity"]["headroom"] == body["headroom"]
+        finally:
+            srv.stop()
+            bus.close()
+
+
+class TestCapacityChecksumPin:
+    def test_capacity_off_default_bit_identical(self):
+        """The capacity plane is a pure observation tap: the device
+        outputs an engine emits must fold the SAME checksum with
+        capacity=True as with the default capacity=False — attribution
+        may account for work, never change it (the roi=False /
+        cascade=False kill-switch pin, applied to capacity)."""
+        from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+        from video_edge_ai_proxy_tpu.replay.checksum import (
+            CHECKSUM_MASK,
+            device_checksum,
+            finalize_checksum,
+        )
+
+        def run(capacity):
+            b = MemoryFrameBus()
+            try:
+                b.create_stream("cam1", 64 * 64 * 3)
+                eng = InferenceEngine(
+                    b, EngineConfig(model="tiny_blob_gauge",
+                                    batch_buckets=(1, 2, 4), tick_ms=5,
+                                    prefetch=False, capacity=capacity),
+                    annotations=AnnotationQueue(handler=lambda batch: True))
+                eng.warmup()
+                eng._drain_q = queue.Queue(maxsize=8)
+                carry = 0
+                for value in (15, 60, 105, 150):
+                    b.publish("cam1", np.full((64, 64, 3), value, np.uint8),
+                              _meta())
+                    groups = eng._collector.collect()
+                    eng._dispatch(groups, time.perf_counter())
+                    inflight = eng._drain_q.get(timeout=10)
+                    part = int(np.asarray(
+                        device_checksum(inflight.outputs)))
+                    carry = (carry + part) & CHECKSUM_MASK
+                    eng._emit(inflight)
+                    eng._collector.release(inflight.group)
+                    eng._drain_q.task_done()
+                if capacity:     # the ledger actually ran on this pass
+                    cons = eng.capacity.conservation()
+                    assert cons["measured_ms"] > 0.0
+                    assert cons["balanced"] is True
+                else:
+                    assert eng.capacity is None
+                return finalize_checksum(carry)
+            finally:
+                b.close()
+
+        assert run(capacity=True) == run(capacity=False)

@@ -236,6 +236,107 @@ class TestMergeRules:
 
 
 # ---------------------------------------------------------------------------
+# Capacity plane in the fleet merge (r18 satellite)
+
+
+def _capacity_member_page(instance: str) -> str:
+    """A member exposition that includes live vep_capacity_* families
+    (registered and driven by a real CapacityTracker, not hand-written
+    text — the lint check covers what the plane actually renders)."""
+    from video_edge_ai_proxy_tpu.obs.capacity import CapacityTracker
+
+    r = Registry()
+    r.set_const_labels(instance=instance)
+    r.counter("vep_frames_total", "frames", ("stream",)).labels(
+        "cam1").inc(2)
+    cap = CapacityTracker(fast_window_s=10.0, slow_window_s=100.0,
+                          eval_interval_s=0.0, clock=lambda: 1000.0,
+                          registry=r)
+    cap.note_batch("det", (64, 64), 4, 20.0, ["cam1", "cam2"])
+    cap.note_batch("det", (64, 64), 1, 5.0, ["cam1"], weights=[1.0],
+                   kind="roi")
+    cap.evaluate(force=True)
+    return r.render()
+
+
+def _capacity_snapshot():
+    return {"headroom": 0.75, "utilization": {"fast": 0.25, "slow": 0.1},
+            "burn": {"fast": 0.3125, "slow": 0.125}, "burning": False,
+            "time_to_saturation_s": 120.0}
+
+
+class TestCapacityFleetMerge:
+    def _agg(self):
+        """m0 reports the capacity plane, m1 does not (pre-r18 member /
+        capacity=False): the mixed-version fleet must merge cleanly."""
+        agg = FleetAggregator(
+            ["m0=http://127.0.0.1:1", "m1=http://127.0.0.1:1"],
+            scrape_interval_s=0.2)
+        _seed_member(agg._members[0], _capacity_member_page("m0"),
+                     streams=2)
+        agg._members[0].capacity = _capacity_snapshot()
+        _seed_member(agg._members[1], _member_page("m1", 5, 0), streams=1)
+        return agg
+
+    def test_mixed_version_health_rows(self):
+        health = {h["instance"]: h for h in self._agg().health()}
+        m0, m1 = health["m0"], health["m1"]
+        assert m0["capacity"] is True
+        assert m0["headroom"] == pytest.approx(0.75)
+        assert m0["capacity_utilization"] == pytest.approx(0.25)
+        assert m0["time_to_saturation_s"] == pytest.approx(120.0)
+        # The capacity-less peer merges with None signals, never a
+        # KeyError or a fake zero that would read as "saturated".
+        assert m1["capacity"] is False
+        assert m1["headroom"] is None
+        assert m1["capacity_utilization"] is None
+        assert m1["time_to_saturation_s"] is None
+
+    def test_merged_exposition_capacity_families_lint_clean(self):
+        text = self._agg().merged_exposition()
+        assert lint_exposition(text) == []
+        # Member-side vep_capacity_* samples survive the merge with
+        # their instance label...
+        assert ('vep_capacity_stream_device_ms_total{instance="m0",'
+                'stream="cam1",kind="full"}') in text
+        assert "vep_capacity_headroom" in text
+        assert "vep_capacity_cell_utilization" in text
+        # ...and the fleet-level member-capacity gauges render with the
+        # -1 unreported sentinel for the capacity-less peer.
+        assert 'vep_fleet_member_headroom{instance="m0"} 0.75' in text
+        assert 'vep_fleet_member_headroom{instance="m1"} -1' in text
+        assert ('vep_fleet_member_time_to_saturation_seconds'
+                '{instance="m1"} -1') in text
+
+    def test_scrape_tolerates_missing_capacity_endpoint(self):
+        """A member whose /api/v1/capacity answers 400 (plane disabled)
+        keeps scraping clean: metrics/stats/slo land, capacity stays
+        empty."""
+        agg = FleetAggregator(["m0=http://127.0.0.1:1"],
+                              scrape_interval_s=0.2)
+        pages = {
+            "/metrics": _member_page("m0", 1, 0).encode(),
+            "/api/v1/stats": json.dumps(
+                {"engine": {"streams": {}}}).encode(),
+            "/api/v1/slo": json.dumps({"burning": False}).encode(),
+        }
+
+        def fetch(url):
+            for suffix, body in pages.items():
+                if url.endswith(suffix):
+                    return body
+            raise OSError("HTTP 400: capacity plane disabled")
+
+        agg._fetch = fetch
+        agg.scrape_once()
+        m0 = agg._members[0]
+        assert m0.alive is True
+        assert m0.capacity == {}
+        row = {h["instance"]: h for h in agg.health()}["m0"]
+        assert row["up"] is True and row["headroom"] is None
+
+
+# ---------------------------------------------------------------------------
 # Feature-disabled notice (satellite 1)
 
 

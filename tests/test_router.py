@@ -474,6 +474,127 @@ class TestAdmit:
 
 
 # ---------------------------------------------------------------------------
+# headroom-aware admission (r18: obs/capacity.py feeds admit)
+
+
+def _cap_row(fleet, name, headroom, tts=None, **over):
+    fleet.rows[name].update(
+        capacity=True, headroom=headroom,
+        capacity_utilization=(1.0 - headroom
+                              if headroom is not None else None),
+        time_to_saturation_s=tts, **over)
+
+
+class TestAdmitHeadroom:
+    def test_storm_lands_on_highest_headroom(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # m1 has the best historical score but the least remaining
+        # capacity: forecast headroom outranks score_ema.
+        _cap_row(fleet, "m0", 0.80, score_ema=0.6)
+        _cap_row(fleet, "m1", 0.10, score_ema=0.99)
+        _cap_row(fleet, "m2", 0.50, score_ema=0.7)
+        for i in range(10):
+            assert router.admit(f"cam{i}", f"rtsp://cam{i}") == "m0"
+        assert len(members["m0"].started) == 10
+
+    def test_saturation_forecast_member_takes_zero_admissions(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # m1 has the most headroom TODAY but is forecast to saturate
+        # inside the horizon — it must take nothing while alternatives
+        # exist.
+        _cap_row(fleet, "m0", 0.55)
+        _cap_row(fleet, "m1", 0.90,
+                 tts=router.admit_saturation_horizon_s / 2)
+        _cap_row(fleet, "m2", 0.40, tts=10_000.0)
+        for i in range(10):
+            assert router.admit(f"cam{i}", f"rtsp://cam{i}") == "m0"
+        assert len(members["m1"].started) == 0
+
+    def test_all_saturated_still_places_least_bad(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # Every reporter forecast-saturated: least-bad (max headroom)
+        # still beats failing closed or blind hashing.
+        _cap_row(fleet, "m0", 0.20, tts=5.0)
+        _cap_row(fleet, "m1", 0.30, tts=5.0)
+        _cap_row(fleet, "m2", 0.10, tts=5.0)
+        assert router.admit("cam0", "rtsp://cam0") == "m1"
+
+    def test_equal_headroom_tie_is_deterministic_lexical(self):
+        placements = []
+        for _ in range(2):                  # two fresh routers agree
+            router, fleet, members, clock = make_router()
+            router.run_pass()
+            _cap_row(fleet, "m0", 0.70, score_ema=0.8)
+            _cap_row(fleet, "m1", 0.70, score_ema=0.8)
+            _cap_row(fleet, "m2", 0.70, score_ema=0.8)
+            placements.append(
+                [router.admit(f"cam{i}", f"rtsp://cam{i}")
+                 for i in range(4)])
+        assert placements[0] == placements[1] == ["m0"] * 4
+        # score_ema breaks the headroom tie before the name does.
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        _cap_row(fleet, "m0", 0.70, score_ema=0.5)
+        _cap_row(fleet, "m1", 0.70, score_ema=0.9)
+        _cap_row(fleet, "m2", 0.70, score_ema=0.7)
+        assert router.admit("cam0", "rtsp://cam0") == "m1"
+
+    def test_mixed_version_fleet_prefers_capacity_reporters(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # Only m2 reports the capacity plane: measured headroom beats
+        # an unmeasured (possibly saturated) high score.
+        fleet.rows["m0"].update(score_ema=0.99)
+        fleet.rows["m1"].update(score_ema=0.95)
+        _cap_row(fleet, "m2", 0.40, score_ema=0.5)
+        assert router.admit("cam0", "rtsp://cam0") == "m2"
+
+    def test_capacity_less_fleet_keeps_score_ema_order(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # No headroom anywhere (pre-r18 rows carry no capacity keys at
+        # all): admission is the r16 max-score_ema policy, now with a
+        # deterministic name tie-break.
+        fleet.rows["m0"].update(score_ema=0.8)
+        fleet.rows["m1"].update(score_ema=0.8)
+        fleet.rows["m2"].update(score_ema=0.3)
+        assert router.admit("cam0", "rtsp://cam0") == "m0"
+
+    def test_unscored_hash_fallback_deterministic_regression(self):
+        """Satellite fix pin: with no headroom and no score_ema the
+        fallback is the consistent hash — identical placements from two
+        fresh routers (and identical to add_stream's ring)."""
+        placed = []
+        for _ in range(2):
+            router, fleet, members, clock = make_router()
+            router.run_pass()
+            for row in fleet.rows.values():
+                row["score_ema"] = None
+            expect = [router.ring.place(f"cam{i}") for i in range(6)]
+            got = [router.admit(f"cam{i}", f"rtsp://cam{i}")
+                   for i in range(6)]
+            assert got == expect
+            placed.append(got)
+        assert placed[0] == placed[1]
+
+    def test_saturated_members_excluded_even_with_zero_headroom(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # headroom 0 means the fast window is already full: never admit
+        # there while an alternative exists, even without a tts value.
+        _cap_row(fleet, "m0", 0.0)
+        _cap_row(fleet, "m1", 0.05)
+        _cap_row(fleet, "m2", 0.0)
+        for i in range(4):
+            assert router.admit(f"cam{i}", f"rtsp://cam{i}") == "m1"
+        assert len(members["m0"].started) == 0
+        assert len(members["m2"].started) == 0
+
+
+# ---------------------------------------------------------------------------
 # ladder hook (resilience/ladder.py shed_to_fleet)
 
 

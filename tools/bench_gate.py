@@ -206,6 +206,41 @@ def cascade_info(baseline_dir: str):
     return None
 
 
+def capacity_info(baseline_dir: str):
+    """Newest committed CAPACITY_r*.json's ledger/forecast row, or None.
+
+    Round 18 informational carry-through: perf-gate logs show the
+    capacity plane's conservation drift, tap overhead, and admission-
+    storm verdict next to the fps verdict. NEVER gated here —
+    capacity_smoke.py hard-gates its own run; this is trend visibility
+    only.
+    """
+    paths = sorted(glob.glob(os.path.join(baseline_dir, "CAPACITY_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(art, dict) or "ledger" not in art:
+            continue
+        ledger = art.get("ledger") or {}
+        forecast = art.get("forecast") or {}
+        admission = art.get("admission") or {}
+        return {
+            "artifact": os.path.basename(path),
+            "conservation_rel_drift": (ledger.get("conservation") or {}
+                                       ).get("rel_drift"),
+            "ledger_tap_pct_of_tick_budget": ledger.get(
+                "ledger_tap_pct_of_tick_budget"),
+            "tts_monotone_decreasing": forecast.get(
+                "tts_monotone_decreasing"),
+            "saturating_member_admissions": admission.get(
+                "saturating_member_admissions"),
+        }
+    return None
+
+
 def stem_stage_info(baseline_dir: str):
     """Newest committed MFU_yolo_*.json's stem-stage row, or None.
 
@@ -266,6 +301,9 @@ def main(argv=None) -> int:
     cascade = cascade_info(args.baseline_dir)
     if cascade is not None:
         report["cascade"] = cascade          # informational, never gated
+    capacity = capacity_info(args.baseline_dir)
+    if capacity is not None:
+        report["capacity"] = capacity        # informational, never gated
     print(json.dumps(report, indent=2))
     return 0 if report["passed"] else 1
 

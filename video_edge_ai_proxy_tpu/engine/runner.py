@@ -940,6 +940,24 @@ class InferenceEngine:
         elif self._cfg.cascade:
             _note_feature_disabled(
                 "cascade", "mesh_serving_single_chip_state_pool")
+        # Capacity attribution plane (obs/capacity.py): the per-stream
+        # device-time ledger + headroom forecast fed from the same
+        # _emit measurements obs/perf.py aggregates, evaluated off the
+        # tick (throttled). cfg.capacity=False leaves it None — no tap
+        # anywhere in the emit path, /api/v1/capacity answers 400, and
+        # serving stays bit-identical (test-pinned kill switch, same
+        # convention as roi/cascade).
+        self.capacity = None
+        if self._cfg.capacity:
+            from ..obs.capacity import CapacityTracker
+
+            self.capacity = CapacityTracker(
+                tick_ms=self._cfg.tick_ms,
+                fast_window_s=self._cfg.capacity_fast_window_s,
+                slow_window_s=self._cfg.capacity_slow_window_s,
+                util_objective=self._cfg.capacity_util_objective,
+                eval_interval_s=self._cfg.capacity_eval_interval_s,
+            )
         # H2D prefetch stage (cfg.prefetch): placement of collected
         # batches moves off the tick thread onto a dedicated transfer
         # thread, double-buffered at depth 2 to match the drain pipeline.
@@ -2473,6 +2491,10 @@ class InferenceEngine:
                     "rung": RUNGS[rung_idx],
                 },
             )
+        if self.capacity is not None:
+            # Throttled internally to capacity_eval_interval_s — per-tick
+            # cost between refreshes is one clock read and a compare.
+            self.capacity.evaluate()
 
     def _slo_tick(self, inferred: Sequence[str]) -> None:
         """Per-tick SLO sampling + throttled evaluation (obs/slo.py).
@@ -2582,6 +2604,20 @@ class InferenceEngine:
                 area_frac=CanvasPacker.area_fraction(
                     group.crops, len(group.device_ids), group.src_hw[0]),
             )
+            if self.capacity is not None:
+                # Ledger attribution by packed canvas share: each
+                # stream's weight is its crops' blitted canvas-pixel
+                # area, so a stream with two big tracks carries more of
+                # the batch's cost than a one-sliver neighbor.
+                areas: Dict[str, int] = {}
+                for p in group.crops:
+                    a = ((p.dst[2] - p.dst[0]) * (p.dst[3] - p.dst[1]))
+                    areas[p.device_id] = areas.get(p.device_id, 0) + a
+                self.capacity.note_batch(
+                    group.model or self._spec.name, group.src_hw,
+                    group.bucket, device_ms, list(areas),
+                    weights=list(areas.values()), kind="roi",
+                )
             self._emit_canvas(inflight, host, spec, device_ms, t_drained)
             return
         # Per-bucket device attribution (obs/perf.py): device-time
@@ -2590,6 +2626,14 @@ class InferenceEngine:
             group.model or self._spec.name, group.src_hw, group.bucket,
             device_ms, len(group.device_ids),
         )
+        if self.capacity is not None:
+            # Ledger attribution by slot occupancy: the bucket's cost
+            # (padding included — padded slots are real device time the
+            # occupants caused) splits equally across the real frames.
+            self.capacity.note_batch(
+                group.model or self._spec.name, group.src_hw,
+                group.bucket, device_ms, group.device_ids,
+            )
         slo_latency = (
             self._slo_latency
             if self.slo is not None and spec.kind == "detect" else None
@@ -2717,6 +2761,11 @@ class InferenceEngine:
             finally:
                 reset_log_context(ctx)
         self.perf.note_roi_emit(len(group.coast))
+        if self.capacity is not None:
+            # Zero-cost occupants: a coasting stream must read as
+            # costing 0 ms in the ledger, not as missing from it.
+            self.capacity.note_coast(
+                [device_id for device_id, _, _ in group.coast])
 
     def _emit_canvas(self, inflight: _Inflight, host: dict, spec,
                      device_ms: float, t_drained: float) -> None:
@@ -2965,6 +3014,20 @@ class InferenceEngine:
         except Exception:
             log.exception("cascade tick failed; continuing")
             return
+        if self.capacity is not None and res.head_ms is not None:
+            # Ledger attribution for the 1/N-cadence temporal head: the
+            # dispatch's measured time splits equally across the due
+            # tracks' streams (raw cost in the ledger; cadence-amortized
+            # per-tick figure via amortize_n — a head pass every N ticks
+            # is 1/N of its cost per tick at steady state).
+            side = self._cascade.side
+            self.capacity.note_batch(
+                f"cascade/{self._cfg.cascade_model}", (side, side),
+                len(res.head_tracks) or 1, res.head_ms,
+                [stream for stream, _ in res.head_tracks],
+                kind="cascade",
+                amortize_n=self._cfg.cascade_every_n,
+            )
         if tracer.enabled and res.head_ms is not None:
             t_now = time.time()
             for stream, meta in res.head_tracks:

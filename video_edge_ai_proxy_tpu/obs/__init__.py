@@ -33,8 +33,16 @@ code alike. Eight modules:
   merges counters (sum) / gauges (last-write + staleness flag) /
   histograms (bucket merge) under an ``instance`` label, and ranks
   member health (``vep_fleet_*``, ``/api/v1/fleet/stats``).
+- :mod:`capacity` — the forward-looking tier (ISSUE r18 tentpole): the
+  per-stream device-time ledger (conservation-gated attribution of every
+  measured batch back to its occupant streams), per-(model, geometry,
+  bucket) utilization rings with an EWMA-slope ``time_to_saturation_s``
+  forecast, and SRE-style fast/slow capacity burn rates
+  (``vep_capacity_*``, ``/api/v1/capacity``) — the signal
+  ``StreamRouter.admit`` consumes for headroom-aware placement.
 """
 
+from .capacity import CapacityTracker
 from .metrics import Registry, registry
 from .perf import PerfTracker, cost_summary, mfu_pct
 from .prof import Profiler
@@ -47,6 +55,7 @@ from .spans import (
 from .watch import Watchdog
 
 __all__ = [
+    "CapacityTracker",
     "Registry",
     "registry",
     "PerfTracker",

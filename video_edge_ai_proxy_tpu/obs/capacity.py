@@ -1,0 +1,454 @@
+"""Capacity attribution plane: per-stream device-time ledger, headroom
+forecasting, and burn-rate accounting that feeds admission.
+
+ROADMAP item 5's measurement prerequisite ("spawn/retire members from the
+health ranking, bounded admission latency during storms"): every
+autoscaling/placement decision presupposes a signal the other obs planes
+never emit — *how much device time each stream actually costs and how
+much headroom each member has left*. MultiStream (arxiv 2207.06078) and
+the Jetson anomaly-pipeline study (arxiv 2307.16834) both show that
+multi-camera edge boxes saturate abruptly unless per-stream cost is
+attributed and forecast BEFORE the SLO burns; the r14/r16 fleet plane
+(obs/fleet.py, serve/router.py) only ranks members AFTER they degrade.
+This module closes that loop forward in time. No reference counterpart:
+the reference proxy ships frames to external CPU clients and never
+accounts device time at all (its stats loop counts frames,
+``server/grpcapi/grpc_api.go:141``).
+
+Three tiers, one object (``CapacityTracker``, engine-owned like
+``SLOEngine``):
+
+- **Per-stream device-time ledger.** Every bucketed megastep's measured
+  device time (the same ``device_ms`` obs/perf.py attributes per cell)
+  is amortized back to its occupant streams: full-frame streams split a
+  bucket's cost equally by slot occupancy, ROI canvas streams by their
+  packed canvas-area share (``CropPlacement.dst`` rects), cascade
+  streams additionally carry their 1/N-cadence temporal-head dispatches
+  (raw cost in the ledger, cadence-amortized per-tick EMA alongside).
+  Conservation is an INVARIANT, not a hope: shares are computed as
+  weight fractions of the measured time, the running attributed and
+  measured totals are both exported, and ``conservation()`` verdicts
+  them within float tolerance (tools/capacity_smoke.py hard-gates it).
+- **Headroom model + forecast.** Busy device-milliseconds accumulate in
+  fixed time-binned rings (the obs/slo.py ``_BinRing`` idiom — zero
+  allocation on the hot path), per (model, geometry, bucket) cell and
+  aggregate. Utilization = busy wall share of the elapsed window;
+  ``evaluate`` (throttled, engine-tick driven) EWMA-smooths the
+  utilization slope and extrapolates ``time_to_saturation_s`` — the
+  forward-looking signal ``StreamRouter.admit`` consumes. Burn rates
+  follow the SRE multi-window recipe (fast 1 m / slow 30 m): burn =
+  window utilization over the sustainable objective, burning only when
+  BOTH windows exceed it (fast reacts, slow suppresses blips).
+- **Surfaces.** ``vep_capacity_*`` metric families (below),
+  ``snapshot()`` for ``/api/v1/capacity`` + the ``/api/v1/stats`` obs
+  embed, and the fleet merge (obs/fleet.py folds member headroom /
+  saturation forecasts into the ranked health view).
+
+Metric families (gauges unless noted):
+
+- ``vep_capacity_stream_device_ms_total{stream,kind}`` (counter) —
+  attributed device time per stream, kind in full|roi|cascade
+- ``vep_capacity_attributed_ms_total`` / ``vep_capacity_measured_ms_total``
+  (counters) — the conservation invariant, dashboard-visible
+- ``vep_capacity_utilization{window}`` — tick-budget utilization per
+  burn window
+- ``vep_capacity_burn_rate{window}`` — utilization over the sustainable
+  objective (>1 = spending capacity faster than sustainable)
+- ``vep_capacity_headroom`` — remaining utilization fraction in [0, 1]
+- ``vep_capacity_time_to_saturation_seconds`` — EWMA-slope forecast
+  (-1 = not trending toward saturation)
+- ``vep_capacity_cell_utilization{model,geometry,bucket}`` — fast-window
+  utilization per serving cell
+
+jax-free by design (CLAUDE.md): importable from control-plane code; the
+engine taps it from the drain thread (one lock + float math per batch).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import metrics
+
+# Streams a batch could not be attributed to (empty occupant list —
+# defensive; the engine always knows its occupants) land here so the
+# conservation invariant still holds.
+OVERHEAD_STREAM = "_overhead"
+
+# Conservation tolerance: attributed and measured totals are the same
+# float sums reordered, so drift is bounded by accumulation rounding.
+CONSERVATION_REL_TOL = 1e-6
+
+
+class _BusyRing:
+    """Busy-milliseconds totals in fixed time bins covering the slow
+    window (the obs/slo.py ``_BinRing`` idiom, single series): each bin
+    is addressed by its absolute epoch and reset lazily when a new epoch
+    claims it, so recording is O(1) index math with no allocation and a
+    window total is an O(n_bins) scan done only at evaluate time."""
+
+    __slots__ = ("_bin_s", "_n", "_busy", "_epochs")
+
+    def __init__(self, span_s: float, bin_s: float):
+        self._bin_s = float(bin_s)
+        self._n = max(int(math.ceil(span_s / bin_s)) + 1, 2)
+        self._busy = [0.0] * self._n
+        self._epochs = [-1] * self._n
+
+    def record(self, busy_ms: float, now: float) -> None:
+        epoch = int(now // self._bin_s)
+        i = epoch % self._n
+        if self._epochs[i] != epoch:
+            self._epochs[i] = epoch
+            self._busy[i] = 0.0
+        self._busy[i] += busy_ms
+
+    def total(self, window_s: float, now: float) -> float:
+        """Busy ms summed over bins younger than ``window_s``."""
+        lo_epoch = int((now - window_s) // self._bin_s)
+        now_epoch = int(now // self._bin_s)
+        busy = 0.0
+        for i in range(self._n):
+            e = self._epochs[i]
+            if lo_epoch < e <= now_epoch:
+                busy += self._busy[i]
+        return busy
+
+
+class _StreamLedger:
+    """Running attribution for one stream (mutated under the tracker
+    lock; snapshot() hands out copies)."""
+
+    __slots__ = ("device_ms", "by_kind", "batches", "frames",
+                 "ema_ms_per_frame", "amortized_ms")
+
+    def __init__(self):
+        self.device_ms = 0.0          # total attributed device time
+        self.by_kind: Dict[str, float] = {}
+        self.batches = 0
+        self.frames = 0
+        self.ema_ms_per_frame: Optional[float] = None
+        # Cadence-amortized running cost: full/roi shares land 1:1;
+        # cascade head shares land divided by their dispatch cadence, so
+        # this reads as the stream's steady-state cost per engine tick.
+        self.amortized_ms = 0.0
+
+
+class _Cell:
+    """One (model, geometry, bucket) serving cell's utilization ring."""
+
+    __slots__ = ("ring", "busy_ms", "batches", "last_util")
+
+    def __init__(self, slow_window_s: float, bin_s: float):
+        self.ring = _BusyRing(slow_window_s, bin_s)
+        self.busy_ms = 0.0
+        self.batches = 0
+        self.last_util = 0.0
+
+
+class CapacityTracker:
+    """Engine-owned capacity plane: ledger + rings + forecast + burn.
+
+    ``note_batch`` is the attribution tap (drain thread, per device
+    batch); ``evaluate`` is the forecast step (tick thread, throttled to
+    ``eval_interval_s``); ``snapshot`` is the read surface. The clock is
+    injectable so ramp/forecast math tests run sleep-free.
+    """
+
+    def __init__(self, *, tick_ms: int = 10,
+                 fast_window_s: float = 60.0,
+                 slow_window_s: float = 1800.0,
+                 bin_s: float = 1.0,
+                 util_objective: float = 0.8,
+                 slope_alpha: float = 0.3,
+                 eval_interval_s: float = 1.0,
+                 clock=time.monotonic,
+                 registry: Optional[metrics.Registry] = None):
+        if not 0.0 < util_objective <= 1.0:
+            raise ValueError(
+                f"util_objective must be in (0, 1], got {util_objective}")
+        if fast_window_s >= slow_window_s:
+            raise ValueError(
+                f"fast window ({fast_window_s}s) must be shorter than the "
+                f"slow window ({slow_window_s}s)")
+        self.tick_ms = int(tick_ms)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.bin_s = float(bin_s)
+        self.util_objective = float(util_objective)
+        self.slope_alpha = float(slope_alpha)
+        self.eval_interval_s = float(eval_interval_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t0: Optional[float] = None       # first attributed batch
+        self._streams: Dict[str, _StreamLedger] = {}
+        self._cells: Dict[Tuple[str, str, int], _Cell] = {}
+        self._agg = _BusyRing(slow_window_s, bin_s)
+        # Conservation invariant state.
+        self.attributed_ms = 0.0
+        self.measured_ms = 0.0
+        self.max_conservation_rel_err = 0.0
+        # Forecast state (updated only in evaluate()).
+        self._next_eval = 0.0
+        self._prev_util: Optional[float] = None
+        self._prev_eval_t: Optional[float] = None
+        self._slope_ema: Optional[float] = None   # utilization / second
+        self._last: dict = {
+            "utilization": {"fast": 0.0, "slow": 0.0},
+            "burn": {"fast": 0.0, "slow": 0.0},
+            "burning": False,
+            "headroom": 1.0,
+            "slope_per_s": None,
+            "time_to_saturation_s": None,
+        }
+        reg = registry if registry is not None else metrics.registry
+        self._m_stream_ms = reg.counter(
+            "vep_capacity_stream_device_ms_total",
+            "Attributed device time per stream (ms)", ("stream", "kind"))
+        self._m_attr = reg.counter(
+            "vep_capacity_attributed_ms_total",
+            "Device time attributed to streams (conservation numerator)"
+        ).labels()
+        self._m_meas = reg.counter(
+            "vep_capacity_measured_ms_total",
+            "Device time measured per batch (conservation denominator)"
+        ).labels()
+        self._m_util = reg.gauge(
+            "vep_capacity_utilization",
+            "Tick-budget utilization per burn window", ("window",))
+        self._m_burn = reg.gauge(
+            "vep_capacity_burn_rate",
+            "Capacity burn multiple per window (utilization over the "
+            "sustainable objective)", ("window",))
+        self._m_headroom = reg.gauge(
+            "vep_capacity_headroom",
+            "Remaining utilization headroom in [0,1]").labels()
+        self._m_tts = reg.gauge(
+            "vep_capacity_time_to_saturation_seconds",
+            "EWMA-slope saturation forecast (-1 = not saturating)"
+        ).labels()
+        self._m_cell_util = reg.gauge(
+            "vep_capacity_cell_utilization",
+            "Fast-window utilization per serving cell",
+            ("model", "geometry", "bucket"))
+
+    # -- attribution tap (drain thread) ---------------------------------
+
+    def note_batch(self, model: str, src_hw: Tuple[int, int], bucket: int,
+                   device_ms: float, streams: Sequence[str], *,
+                   weights: Optional[Sequence[float]] = None,
+                   kind: str = "full", amortize_n: int = 1,
+                   now: Optional[float] = None) -> None:
+        """Attribute one measured device batch back to its occupant
+        streams.
+
+        ``streams``: the occupant stream ids (full-frame: one per real
+        slot; ROI canvas: the distinct source streams). ``weights``:
+        optional per-stream cost weights (ROI canvas-area shares);
+        omitted = equal split. ``amortize_n``: dispatch cadence in ticks
+        (cascade head = cfg.cascade_every_n) — raw cost lands in the
+        ledger, cost/amortize_n in the steady-state per-tick figure.
+        Shares are exact fractions of ``device_ms``, so attributed and
+        measured totals conserve by construction; the residual float
+        error is tracked and gated, never assumed away."""
+        now = self._clock() if now is None else now
+        device_ms = float(device_ms)
+        ids = list(streams) or [OVERHEAD_STREAM]
+        if weights is not None and len(weights) == len(ids):
+            wsum = float(sum(weights))
+            shares = ([device_ms * float(w) / wsum for w in weights]
+                      if wsum > 0.0
+                      else [device_ms / len(ids)] * len(ids))
+        else:
+            shares = [device_ms / len(ids)] * len(ids)
+        attributed = sum(shares)
+        rel_err = (abs(attributed - device_ms)
+                   / max(abs(device_ms), 1e-12)) if device_ms else 0.0
+        amortize = max(1, int(amortize_n))
+        geometry = f"{src_hw[0]}x{src_hw[1]}"
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = now
+            self.attributed_ms += attributed
+            self.measured_ms += device_ms
+            if rel_err > self.max_conservation_rel_err:
+                self.max_conservation_rel_err = rel_err
+            for sid, share in zip(ids, shares):
+                led = self._streams.get(sid)
+                if led is None:
+                    led = self._streams[sid] = _StreamLedger()
+                led.device_ms += share
+                led.by_kind[kind] = led.by_kind.get(kind, 0.0) + share
+                led.batches += 1
+                led.frames += 1
+                led.amortized_ms += share / amortize
+                led.ema_ms_per_frame = (
+                    share if led.ema_ms_per_frame is None
+                    else 0.9 * led.ema_ms_per_frame + 0.1 * share)
+            cell = self._cells.get((model, geometry, int(bucket)))
+            if cell is None:
+                cell = self._cells[(model, geometry, int(bucket))] = _Cell(
+                    self.slow_window_s, self.bin_s)
+            cell.ring.record(device_ms, now)
+            cell.busy_ms += device_ms
+            cell.batches += 1
+            self._agg.record(device_ms, now)
+        for sid, share in zip(ids, shares):
+            self._m_stream_ms.labels(sid, kind).inc(share)
+        self._m_attr.inc(attributed)
+        self._m_meas.inc(device_ms)
+
+    def note_coast(self, streams: Sequence[str]) -> None:
+        """Register zero-cost occupants (MOSAIC gated-idle coast groups:
+        no device work at all) so the ledger's stream coverage matches
+        the serving set — a coasting stream reads as costing 0 ms, not
+        as missing."""
+        with self._lock:
+            for sid in streams:
+                led = self._streams.get(sid)
+                if led is None:
+                    led = self._streams[sid] = _StreamLedger()
+                led.batches += 1
+                led.by_kind.setdefault("coast", 0.0)
+
+    # -- forecast (tick thread, throttled) ------------------------------
+
+    def _utilization(self, window_s: float, now: float) -> float:
+        """Busy share of the elapsed window in [0, ...): busy device ms
+        over window wall ms, windows clipped to the observed span so a
+        young tracker is not diluted by bins it never lived through."""
+        with self._lock:
+            t0 = self._t0
+            busy = self._agg.total(window_s, now)
+        if t0 is None:
+            return 0.0
+        span_s = max(self.bin_s, min(window_s, now - t0 + self.bin_s))
+        return busy / (span_s * 1000.0)
+
+    def evaluate(self, now: Optional[float] = None,
+                 force: bool = False) -> dict:
+        """Update the forecast + burn state from the rings; throttled to
+        ``eval_interval_s`` unless forced. Returns the live state dict
+        (also retained for snapshot())."""
+        now = self._clock() if now is None else now
+        if not force and now < self._next_eval:
+            return self._last
+        self._next_eval = now + self.eval_interval_s
+        u_fast = self._utilization(self.fast_window_s, now)
+        u_slow = self._utilization(self.slow_window_s, now)
+        # EWMA utilization slope (per second): the forecast's trend
+        # input. Evaluated on the fast window so ramps register within
+        # a minute; the EMA keeps single noisy ticks from whipsawing
+        # the saturation estimate.
+        if self._prev_util is not None and self._prev_eval_t is not None \
+                and now > self._prev_eval_t:
+            slope = (u_fast - self._prev_util) / (now - self._prev_eval_t)
+            self._slope_ema = (
+                slope if self._slope_ema is None
+                else self.slope_alpha * slope
+                + (1.0 - self.slope_alpha) * self._slope_ema)
+        self._prev_util = u_fast
+        self._prev_eval_t = now
+        headroom = max(0.0, 1.0 - u_fast)
+        tts: Optional[float] = None
+        if self._slope_ema is not None and self._slope_ema > 1e-9:
+            tts = headroom / self._slope_ema
+        burn_fast = u_fast / self.util_objective
+        burn_slow = u_slow / self.util_objective
+        burning = burn_fast > 1.0 and burn_slow > 1.0
+        self._last = {
+            "utilization": {"fast": u_fast, "slow": u_slow},
+            "burn": {"fast": burn_fast, "slow": burn_slow},
+            "burning": burning,
+            "headroom": headroom,
+            "slope_per_s": self._slope_ema,
+            "time_to_saturation_s": tts,
+        }
+        self._m_util.labels("fast").set(u_fast)
+        self._m_util.labels("slow").set(u_slow)
+        self._m_burn.labels("fast").set(burn_fast)
+        self._m_burn.labels("slow").set(burn_slow)
+        self._m_headroom.set(headroom)
+        self._m_tts.set(tts if tts is not None else -1.0)
+        with self._lock:
+            cells = list(self._cells.items())
+            t0 = self._t0
+        span_s = max(self.bin_s, min(
+            self.fast_window_s,
+            (now - t0 + self.bin_s) if t0 is not None else self.bin_s))
+        for (model, geometry, bucket), cell in cells:
+            busy = cell.ring.total(self.fast_window_s, now)
+            cell.last_util = busy / (span_s * 1000.0)
+            self._m_cell_util.labels(
+                model, geometry, str(bucket)).set(cell.last_util)
+        return self._last
+
+    # -- read surfaces ---------------------------------------------------
+
+    def conservation(self) -> dict:
+        """The ledger invariant's verdict: attributed vs measured device
+        time, worst per-batch relative error, and whether the running
+        totals agree within tolerance."""
+        with self._lock:
+            attributed = self.attributed_ms
+            measured = self.measured_ms
+            max_err = self.max_conservation_rel_err
+        drift = abs(attributed - measured) / max(measured, 1e-9) \
+            if measured else 0.0
+        return {
+            "attributed_ms": attributed,
+            "measured_ms": measured,
+            "rel_drift": drift,
+            "max_batch_rel_err": max_err,
+            "balanced": (drift <= CONSERVATION_REL_TOL
+                         and max_err <= CONSERVATION_REL_TOL),
+        }
+
+    def streams(self) -> Dict[str, dict]:
+        """Per-stream ledger rows (copies)."""
+        with self._lock:
+            return {
+                sid: {
+                    "device_ms": led.device_ms,
+                    "by_kind": dict(led.by_kind),
+                    "batches": led.batches,
+                    "frames": led.frames,
+                    "ema_ms_per_frame": led.ema_ms_per_frame,
+                    "amortized_ms": led.amortized_ms,
+                }
+                for sid, led in self._streams.items()
+            }
+
+    def snapshot(self) -> dict:
+        """JSON-able capacity state for /api/v1/capacity, the
+        /api/v1/stats obs embed, and the fleet scrape. Runs a (throttled)
+        evaluate so a read-only consumer still sees a live forecast."""
+        state = self.evaluate()
+        with self._lock:
+            cells = {
+                f"{model}|{geometry}|{bucket}": {
+                    "busy_ms": round(cell.busy_ms, 3),
+                    "batches": cell.batches,
+                    "util_fast": round(cell.last_util, 6),
+                }
+                for (model, geometry, bucket), cell in self._cells.items()
+            }
+        return {
+            "tick_ms": self.tick_ms,
+            "util_objective": self.util_objective,
+            "windows_s": {"fast": self.fast_window_s,
+                          "slow": self.slow_window_s},
+            "utilization": {k: round(v, 6)
+                            for k, v in state["utilization"].items()},
+            "burn": {k: round(v, 6) for k, v in state["burn"].items()},
+            "burning": state["burning"],
+            "headroom": round(state["headroom"], 6),
+            "slope_per_s": state["slope_per_s"],
+            "time_to_saturation_s": state["time_to_saturation_s"],
+            "conservation": self.conservation(),
+            "streams": self.streams(),
+            "cells": cells,
+        }

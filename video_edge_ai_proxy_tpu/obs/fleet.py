@@ -6,8 +6,10 @@ into one pane). Every other obs module is process-local; this one makes N
 engine processes read as one system:
 
 - ``FleetAggregator`` scrapes each member's ``/metrics`` +
-  ``/api/v1/stats`` + ``/api/v1/slo`` over plain HTTP (stdlib urllib —
-  jax-free, dependency-free, importable from control-plane code).
+  ``/api/v1/stats`` + ``/api/v1/slo`` + ``/api/v1/capacity`` over plain
+  HTTP (stdlib urllib — jax-free, dependency-free, importable from
+  control-plane code). A member without a given plane (400/older
+  version) degrades to an empty dict — mixed-version fleets merge.
 - **Merge rules** (ISSUE r14): counters are SUMMED across members,
   log2 histograms are bucket-merged (identical ``le`` grids by
   construction — metrics.py owns the bounds), gauges are last-write per
@@ -48,6 +50,14 @@ Fleet metric families (all gauges unless noted):
 - ``vep_fleet_member_slo_burning{instance}``
 - ``vep_fleet_member_ladder_rung{instance}``
 - ``vep_fleet_member_streams{instance}``
+- ``vep_fleet_member_headroom{instance}`` — forecast capacity headroom
+  in [0, 1] from the member's r18 capacity plane (-1 when the member
+  does not report capacity — mixed-version fleet)
+- ``vep_fleet_member_capacity_utilization{instance}`` — fast-window
+  device-time utilization (-1 when unreported)
+- ``vep_fleet_member_time_to_saturation_seconds{instance}`` —
+  EWMA-slope saturation forecast (-1 when unreported or not burning
+  toward saturation)
 - ``vep_fleet_scrapes_total{instance}`` /
   ``vep_fleet_scrape_failures_total{instance}`` (counters)
 """
@@ -158,6 +168,7 @@ class MemberState:
         self.families: List[dict] = []
         self.stats: dict = {}
         self.slo: dict = {}
+        self.capacity: dict = {}
         # r16 flap-free health (updated once per scrape pass, never at
         # read time): EMA of the instantaneous score + a hysteresis-banded
         # healthy verdict with entry timestamps.
@@ -184,6 +195,22 @@ class MemberState:
                 for _, _, value in fam["samples"]:
                     return float(value)
         return 0.0
+
+    # r18 capacity signals; all None when the member does not report the
+    # capacity plane (disabled or pre-r18 — mixed-version fleet).
+
+    def headroom(self) -> Optional[float]:
+        v = (self.capacity or {}).get("headroom")
+        return float(v) if v is not None else None
+
+    def capacity_util(self) -> Optional[float]:
+        util = (self.capacity or {}).get("utilization") or {}
+        v = util.get("fast")
+        return float(v) if v is not None else None
+
+    def time_to_saturation_s(self) -> Optional[float]:
+        v = (self.capacity or {}).get("time_to_saturation_s")
+        return float(v) if v is not None else None
 
 
 class FleetAggregator:
@@ -267,10 +294,18 @@ class FleetAggregator:
                     slo = json.loads(self._fetch(m.base_url + "/api/v1/slo"))
                 except Exception:
                     slo = {}   # SLO plane disabled on the member (400)
+                try:
+                    capacity = json.loads(
+                        self._fetch(m.base_url + "/api/v1/capacity"))
+                except Exception:
+                    # Capacity plane disabled (400) or a pre-r18 member
+                    # (404) — merge the rest; health rows carry None.
+                    capacity = {}
                 with self._lock:
                     m.families = parse_exposition(text)
                     m.stats = stats
                     m.slo = slo
+                    m.capacity = capacity
                     m.alive = True
                     m.last_ok = time.monotonic()
                     m.last_err = ""
@@ -343,6 +378,12 @@ class FleetAggregator:
             "slo_burning": burning,
             "ladder_rung": rung,
             "streams": streams,
+            # r18 capacity plane (None-keyed when the member does not
+            # report it — the router treats those as capacity-less).
+            "capacity": bool(m.capacity),
+            "headroom": m.headroom(),
+            "capacity_utilization": m.capacity_util(),
+            "time_to_saturation_s": m.time_to_saturation_s(),
             "score": round(score, 4),
             "score_ema": round(m.score_ema, 4)
             if m.score_ema is not None else None,
@@ -497,6 +538,18 @@ class FleetAggregator:
         fam("vep_fleet_member_streams", "gauge",
             "Member admitted-stream count",
             lambda r: r["streams"])
+        fam("vep_fleet_member_headroom", "gauge",
+            "Forecast capacity headroom in [0,1] (-1 when unreported)",
+            lambda r: r["headroom"] if r["headroom"] is not None else -1.0)
+        fam("vep_fleet_member_capacity_utilization", "gauge",
+            "Fast-window device-time utilization (-1 when unreported)",
+            lambda r: r["capacity_utilization"]
+            if r["capacity_utilization"] is not None else -1.0)
+        fam("vep_fleet_member_time_to_saturation_seconds", "gauge",
+            "EWMA-slope saturation forecast (-1 when unreported or not "
+            "trending toward saturation)",
+            lambda r: r["time_to_saturation_s"]
+            if r["time_to_saturation_s"] is not None else -1.0)
         fam("vep_fleet_scrapes_total", "counter",
             "Successful member scrapes", lambda r: r["scrapes"])
         fam("vep_fleet_scrape_failures_total", "counter",

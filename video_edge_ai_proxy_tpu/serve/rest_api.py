@@ -213,6 +213,11 @@ def build_app(
             # snapshot /api/v1/cascade serves).
             "cascade": engine.cascade.snapshot()
             if engine is not None and engine.cascade is not None else None,
+            # r18 capacity attribution: per-stream device-time ledger,
+            # headroom forecast + burn rates (the same snapshot
+            # /api/v1/capacity serves).
+            "capacity": engine.capacity.snapshot()
+            if engine is not None and engine.capacity is not None else None,
         }
         return web.json_response(out)
 
@@ -253,6 +258,22 @@ def build_app(
         if engine.cascade is None:
             return _error(400, "cascade disabled (engine.cascade config)")
         out = await asyncio.to_thread(engine.cascade.snapshot)
+        return web.json_response(out)
+
+    async def capacity(_request: web.Request) -> web.Response:
+        """Capacity attribution plane (obs/capacity.py): the per-stream
+        device-time ledger with its conservation check, fast/slow-window
+        utilization + burn rates, headroom and the EWMA-slope
+        time_to_saturation_s forecast, and per-(model, geometry, bucket)
+        cell utilization. 400 when the plane is disabled
+        (engine.capacity config, same kill-switch convention as
+        /api/v1/cascade)."""
+        if engine is None:
+            return _error(400, "engine not running")
+        if engine.capacity is None:
+            return _error(
+                400, "capacity plane disabled (engine.capacity config)")
+        out = await asyncio.to_thread(engine.capacity.snapshot)
         return web.json_response(out)
 
     async def trace(request: web.Request) -> web.Response:
@@ -470,6 +491,7 @@ def build_app(
     app.router.add_get("/api/v1/slo", slo)
     app.router.add_get("/api/v1/quality", quality)
     app.router.add_get("/api/v1/cascade", cascade)
+    app.router.add_get("/api/v1/capacity", capacity)
     app.router.add_get("/api/v1/trace", trace)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)

@@ -356,6 +356,7 @@ class StreamRouter:
         min_healthy_age_s: float = 0.0,
         drain_timeout_s: float = 8.0,
         drain_poll_s: float = 0.25,
+        admit_saturation_horizon_s: float = 60.0,
         ema_alpha: float = 0.4,
         healthy_above: float = 0.7,
         unhealthy_below: float = 0.4,
@@ -374,6 +375,10 @@ class StreamRouter:
         self.min_healthy_age_s = float(min_healthy_age_s)
         self.drain_timeout_s = float(drain_timeout_s)
         self.drain_poll_s = float(drain_poll_s)
+        # r18: a member whose capacity forecast says it saturates within
+        # this horizon takes NO new admissions while any alternative
+        # exists (obs/capacity.py time_to_saturation_s).
+        self.admit_saturation_horizon_s = float(admit_saturation_horizon_s)
         self.fleet = fleet or FleetAggregator(
             members, scrape_interval_s=scrape_interval_s,
             ema_alpha=ema_alpha, healthy_above=healthy_above,
@@ -521,22 +526,70 @@ class StreamRouter:
             self._m_streams.set(len(self._streams))
             return member
 
+    def _pick_admission(self, name: str,
+                        candidates: List[dict]) -> Optional[str]:
+        """Admission target among placeable health rows (r18 policy).
+
+        Tiered, deterministic:
+
+        1. **Headroom** — rows reporting the capacity plane rank by
+           (-headroom, -score_ema, instance): forecast remaining
+           capacity first, historical health as tie-break, lexical
+           member name as the final tie-break so equal-headroom ties
+           never depend on dict/scrape order. A member forecast to
+           saturate within ``admit_saturation_horizon_s`` (or already
+           out of headroom) is excluded while ANY unsaturated
+           capacity-reporting member exists; when every reporter is
+           saturated the least-bad one still beats blind hashing.
+        2. **score_ema** — no capacity reporters (pre-r18 fleet): max
+           EMA health score, instance-name tie-break (the satellite
+           determinism fix — the old scan kept first-seen on ties).
+        3. **Hash ring** — nothing scored at all: consistent-hash
+           placement (add_stream's path), itself deterministic in the
+           stream name.
+        """
+        scored = [r for r in candidates if r.get("headroom") is not None]
+        if scored:
+            horizon = self.admit_saturation_horizon_s
+            safe = [
+                r for r in scored
+                if r["headroom"] > 0.0
+                and not (r.get("time_to_saturation_s") is not None
+                         and r["time_to_saturation_s"] <= horizon)
+            ]
+            pool = safe or scored
+            pool.sort(key=lambda r: (
+                -r["headroom"],
+                -(r["score_ema"] if r.get("score_ema") is not None
+                  else -1.0),
+                r["instance"]))
+            return pool[0]["instance"]
+        ema = [r for r in candidates if r.get("score_ema") is not None]
+        if ema:
+            ema.sort(key=lambda r: (-r["score_ema"], r["instance"]))
+            return ema[0]["instance"]
+        return self.ring.place(name)
+
     def admit(self, name: str, rtsp_endpoint: str, *,
               priority: int = 0, inference_model: str = "",
               annotation_policy: str = "") -> str:
-        """Health-aware admission: place a NEW stream on the healthiest
-        ring member at attach time — placement only, existing streams
-        never move (that is run_pass's job). Healthiest = max score_ema
-        among placeable ring members in the latest health view; with no
-        scored candidates this degrades to the consistent-hash placement
-        (add_stream's path), so admission is never worse than hashing.
-        Raises like add_stream when nothing is placeable."""
+        """Headroom-aware admission: place a NEW stream on the member
+        with the most *remaining* capacity at attach time — placement
+        only, existing streams never move (that is run_pass's job).
+        Members reporting the r18 capacity plane rank by forecast
+        headroom (saturation-forecast members take zero admissions while
+        an alternative exists); a capacity-less fleet degrades to max
+        score_ema, and with no scored candidates at all to the
+        consistent-hash placement (add_stream's path), so admission is
+        never worse than hashing. Every tier tie-breaks
+        deterministically (see _pick_admission). Raises like add_stream
+        when nothing is placeable."""
         health = self.fleet.health()
         with self._lock:
             if name in self._streams:
                 raise ValueError(f"stream {name!r} already routed")
             members = set(self.ring.members)
-            best, best_score = None, None
+            candidates = []
             for row in health:
                 member = row.get("instance")
                 if member not in members:
@@ -548,12 +601,8 @@ class StreamRouter:
                 client = self.clients.get(member)
                 if client is not None and client.breaker.state == "open":
                     continue
-                score = row.get("score_ema")
-                if score is None:
-                    continue
-                if best_score is None or score > best_score:
-                    best, best_score = member, score
-            member = best if best is not None else self.ring.place(name)
+                candidates.append(row)
+            member = self._pick_admission(name, candidates)
             if member is None:
                 raise RuntimeError(
                     "no placeable member (ring empty — all members dead, "

@@ -326,6 +326,22 @@ class EngineConfig:
     # frees (IoUTracker coasts max_misses=30 frames first, so this fires
     # only after the tracker itself dropped the track).
     cascade_track_ttl_ticks: int = 60
+    # Capacity attribution plane (obs/capacity.py): per-stream
+    # device-time ledger (every measured batch amortized back to its
+    # occupant streams, conservation-gated), per-(model, geometry,
+    # bucket) utilization rings with an EWMA-slope time_to_saturation_s
+    # forecast, and SRE-style fast/slow capacity burn rates — the
+    # headroom signal obs/fleet.py merges and StreamRouter.admit()
+    # consults. capacity=False (default) is the kill switch: no tap in
+    # the emit path, /api/v1/capacity answers 400, and serving stays
+    # bit-identical (test-pinned, roi=False / cascade=False convention).
+    capacity: bool = False
+    capacity_fast_window_s: float = 60.0     # fast burn window
+    capacity_slow_window_s: float = 1800.0   # slow burn window (30 m)
+    # Sustainable tick-budget utilization: burn rate = utilization over
+    # this; burning when BOTH windows exceed 1.0 (SRE multi-window).
+    capacity_util_objective: float = 0.8
+    capacity_eval_interval_s: float = 1.0    # forecast refresh throttle
 
 
 @dataclass
