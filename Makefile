@@ -4,7 +4,7 @@
 .PHONY: all proto native install test bench graft clean redis-conformance \
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
 	roi-smoke fleet-obs-smoke stem-smoke router-smoke cascade-smoke \
-	capacity-smoke
+	capacity-smoke autoscale-smoke
 
 all: proto native
 
@@ -221,6 +221,17 @@ capacity-smoke:
 			   d['forecast']['tts_first_s'], d['forecast']['tts_last_s'], \
 			   d['admission']['storm_by_member'], \
 			   d['admission']['saturating_member_admissions']))"
+
+autoscale-smoke:
+	python tools/autoscale_smoke.py | tee /tmp/vep_autoscale_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_autoscale_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); \
+		print('autoscale: boots cold %.1fs / warm %.1fs / spawn %.1fs, spawn->first-frame %.2fs, storm p99 %.2fs, ledger lost=%d dup=%d' \
+			% (d['boots']['m0'], d['boots']['m1'], \
+			   d['boots'].get('a0', float('nan')), \
+			   d['spawn_first_frame_s'], d['storm_p99_s'], \
+			   d['ledger']['lost'], d['ledger']['duplicated']))"
 
 cascade-smoke:
 	python tools/cascade_smoke.py | tee /tmp/vep_cascade_smoke.json

@@ -337,6 +337,67 @@ class TestCapacityFleetMerge:
 
 
 # ---------------------------------------------------------------------------
+# Warming member state (r19): scraped-alive but prewarm incomplete
+
+
+class TestWarmingState:
+    def _member(self, *, alive=True, prewarm="unset"):
+        m = MemberState("m0", "http://127.0.0.1:1")
+        m.alive = alive
+        m.last_ok = time.monotonic()
+        engine = {"streams": {}}
+        if prewarm != "unset":
+            engine["prewarm"] = prewarm
+        m.stats = {"engine": engine}
+        return m
+
+    def test_state_table(self):
+        # (alive, prewarm payload) -> warming. A member is warming ONLY
+        # while scraped-alive with a reported-incomplete program set;
+        # engine-less / pre-r19 members (no prewarm dict) never are.
+        table = [
+            (True, {"required": 2, "done": 1, "complete": False}, True),
+            (True, {"required": 2, "done": 2, "complete": True}, False),
+            (True, {"required": 0, "done": 0, "complete": True}, False),
+            (False, {"required": 2, "done": 1, "complete": False}, False),
+            (True, "unset", False),           # pre-r19 member
+            (True, None, False),              # explicit null
+            (True, "not-a-dict", False),      # malformed payload
+            (True, {}, False),                # complete defaults True
+        ]
+        for alive, prewarm, want in table:
+            m = self._member(alive=alive, prewarm=prewarm)
+            assert m.warming() is want, (alive, prewarm)
+
+    def _agg_with_warming(self):
+        agg = FleetAggregator(
+            ["m0=http://127.0.0.1:1", "m1=http://127.0.0.1:1"],
+            scrape_interval_s=0.2)
+        _seed_member(agg._members[0], _member_page("m0", 1, 0), streams=1)
+        _seed_member(agg._members[1], _member_page("m1", 1, 0))
+        agg._members[1].stats["engine"]["prewarm"] = {
+            "required": 3, "done": 1, "complete": False,
+            "aot_cache": True}
+        return agg
+
+    def test_health_rows_carry_warming(self):
+        health = {h["instance"]: h for h in self._agg_with_warming()
+                  .health()}
+        assert health["m0"]["warming"] is False
+        assert health["m1"]["warming"] is True
+        # Warming is not unhealth: the member answers scrapes and must
+        # keep its up/score standing (the supervisor distinguishes
+        # "don't route to it yet" from "it is broken").
+        assert health["m1"]["up"] is True
+
+    def test_warming_gauge_in_merged_exposition(self):
+        text = self._agg_with_warming().merged_exposition()
+        assert lint_exposition(text) == []
+        assert 'vep_fleet_member_warming{instance="m0"} 0' in text
+        assert 'vep_fleet_member_warming{instance="m1"} 1' in text
+
+
+# ---------------------------------------------------------------------------
 # Feature-disabled notice (satellite 1)
 
 

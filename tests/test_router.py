@@ -362,22 +362,25 @@ class TestLedger:
             led.note_delivery("cam0", "m0", p)
         assert led.balance("cam0")["balanced"]
 
-    def test_reset_restarts_conservation_window(self):
+    def test_conservation_pins_from_the_first_frame(self):
+        # r19: members prewarm every program they serve, so the compile
+        # ramp that used to overwrite early frames (latest-frame-wins)
+        # no longer exists — the very first delivered frame anchors the
+        # window and EVERY subsequent gap is a real loss. There is
+        # deliberately no reset() to restart the window with.
+        assert not hasattr(MigrationLedger, "reset")
         led = MigrationLedger()
-        # First frame delivered post-compile anchors packet 0, then the
-        # ~frames the compile overwrote read as a gap...
         led.note_delivery("cam0", "m0", 0)
-        for p in range(20, 40):
-            led.note_delivery("cam0", "m0", p)
-        assert not led.balance("cam0")["balanced"]
-        # ...until the soak resets at steady state: window restarts at
-        # the next delivery, and the cursor follows post-reset maxima.
-        led.reset()
-        assert led.next_cursor("cam0") is None
-        for p in range(40, 60):
+        for p in range(1, 40):
             led.note_delivery("cam0", "m0", p)
         assert led.balance("cam0")["balanced"]
-        assert led.next_cursor("cam0") == 60
+        assert led.next_cursor("cam0") == 40
+        # A gap right after the first frame is a loss, not warmup.
+        led.note_delivery("cam1", "m0", 0)
+        for p in range(20, 30):
+            led.note_delivery("cam1", "m0", p)
+        out = led.balance("cam1")
+        assert not out["balanced"] and out["lost"] == 19
 
 
 # ---------------------------------------------------------------------------
@@ -696,6 +699,43 @@ class TestMemberSurface:
             assert status == 400
             assert body["code"] == 400
             assert body["message"] == "engine not running"
+
+    def test_rest_supervisor_disabled_convention(self):
+        # r19 extends the endpoint audit: /api/v1/supervisor follows the
+        # same r9 kill-switch convention — no supervisor wired in means
+        # the standard 400 JSON envelope naming the config key.
+        run = _rest(engine=None)
+
+        async def go(client):
+            r = await client.get("/api/v1/supervisor")
+            return r.status, await r.json()
+
+        status, body = run(go)
+        assert status == 400
+        assert body["code"] == 400
+        assert body["message"] == "supervisor disabled (supervisor config)"
+
+    def test_rest_supervisor_snapshot_passthrough(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from video_edge_ai_proxy_tpu.serve.rest_api import build_app
+
+        sup = types.SimpleNamespace(
+            snapshot=lambda: {"name": "supervisor0", "passes": 3})
+
+        async def wrapped():
+            app = build_app(_PM(), settings=None, engine=None,
+                            supervisor=sup)
+            async with TestClient(TestServer(app)) as client:
+                r = await client.get("/api/v1/supervisor")
+                return r.status, await r.json()
+
+        status, body = asyncio.new_event_loop().run_until_complete(
+            wrapped())
+        assert status == 200
+        assert body == {"name": "supervisor0", "passes": 3}
 
     def test_rest_ladder_disabled_400(self):
         engine = types.SimpleNamespace(ladder=None)

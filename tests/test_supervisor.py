@@ -1,0 +1,421 @@
+"""FleetSupervisor decision-loop tests (serve/supervisor.py, r19): the
+scale-out forecast trigger, the sustained-surplus scale-in, member
+bounds, warming/cooldown flap containment (including the symmetric
+spawn cooldown after a retire — the drain's migration step-up reads as
+burn slope for a fast-window's worth of seconds), advisory mode, and
+the metrics/snapshot surface. Everything runs against a scripted fake
+router + warped clock — no processes, no jax."""
+
+import pytest
+
+from video_edge_ai_proxy_tpu.obs import registry as obs_registry
+from video_edge_ai_proxy_tpu.obs.metrics import lint_exposition
+from video_edge_ai_proxy_tpu.serve.supervisor import FleetSupervisor
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeFleet:
+    def __init__(self, router):
+        self._router = router
+
+    def health(self):
+        return [dict(r) for r in self._router.rows.values()]
+
+
+class FakeRouter:
+    """Scripted fleet: tests mutate ``rows`` to shape the forecast and
+    inspect ``added``/``removed`` for lifecycle actions."""
+
+    def __init__(self, members=("m0", "m1")):
+        self.rows = {}
+        self.clients = {}
+        self.streams = {}          # member -> stream names
+        self.added = []
+        self.removed = []
+        self.fail_remove = False
+        self.fleet = FakeFleet(self)
+        for m in members:
+            self.clients[m] = object()
+            self.streams[m] = [f"{m}-cam0"]
+            self.rows[m] = {
+                "instance": m, "up": True, "stale": False,
+                "warming": False, "healthy": True,
+                "headroom": 0.7, "time_to_saturation_s": None,
+            }
+
+    # StreamRouter surface the supervisor uses --------------------------
+    def add_member(self, name, url):
+        self.clients[name] = object()
+        self.streams[name] = []
+        self.rows[name] = {
+            "instance": name, "up": True, "stale": False,
+            "warming": True, "healthy": True,
+            "headroom": None, "time_to_saturation_s": None,
+        }
+        self.added.append((name, url))
+
+    def remove_member(self, name):
+        if self.fail_remove:
+            raise RuntimeError("drain failed")
+        moved = list(self.streams.pop(name, []))
+        self.clients.pop(name)
+        self.rows.pop(name)
+        self.removed.append(name)
+        return moved
+
+    def streams_on(self, member):
+        return list(self.streams.get(member, []))
+
+    # test scripting ----------------------------------------------------
+    def set(self, member, **kv):
+        self.rows[member].update(kv)
+
+
+def _sup(router, clock, **kw):
+    kw.setdefault("min_members", 1)
+    kw.setdefault("max_members", 4)
+    kw.setdefault("spawn_horizon_s", 120.0)
+    kw.setdefault("surplus_headroom", 0.6)
+    kw.setdefault("surplus_hold_s", 30.0)
+    kw.setdefault("spawn_cooldown_s", 10.0)
+    kw.setdefault("retire_cooldown_s", 30.0)
+    return FleetSupervisor(router, clock=clock, sleep=lambda s: None, **kw)
+
+
+def _spawner_factory(router):
+    counter = {"n": 0}
+
+    def spawner():
+        name = f"a{counter['n']}"
+        counter["n"] += 1
+        return name, f"http://auto:{8000 + counter['n']}"
+
+    return spawner
+
+
+class TestBounds:
+    @pytest.mark.parametrize("lo,hi", [(0, 2), (3, 2), (-1, -1)])
+    def test_invalid_bounds_raise(self, lo, hi):
+        with pytest.raises(ValueError):
+            _sup(FakeRouter(), FakeClock(),
+                 min_members=lo, max_members=hi)
+
+    def test_min_bound_spawns_before_any_forecast(self):
+        router = FakeRouter(members=("m0",))
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router), min_members=2)
+        decision = sup.run_pass()
+        assert decision["action"] == "spawn"
+        assert decision["reason"] == "min_bound"
+        assert router.added == [("a0", "http://auto:8001")]
+
+    def test_max_bound_blocks_scale_out(self):
+        router = FakeRouter(members=("m0", "m1"))
+        router.set("m0", time_to_saturation_s=5.0)
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router), max_members=2)
+        decision = sup.run_pass()
+        assert decision["action"] == "hold"
+        assert decision["reason"] == "saturation_forecast"
+        assert not router.added
+
+
+class TestScaleOut:
+    def test_spawn_on_forecast_inside_horizon(self):
+        router = FakeRouter()
+        router.set("m0", time_to_saturation_s=90.0)
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router))
+        decision = sup.run_pass()
+        assert decision["action"] == "spawn"
+        assert decision["reason"] == "saturation_forecast"
+        assert router.added and "a0" in router.clients
+        event = sup.events[-1]
+        assert event["action"] == "spawn"
+        assert event["reason"] == "saturation_forecast"
+        # The decision view rides on the event: scale-out-beat-the-burn
+        # is checkable from the record alone.
+        assert event["fleet_tts_s"] == 90.0
+        assert event["min_headroom"] == 0.7
+
+    def test_fleet_tts_is_the_earliest_member_forecast(self):
+        router = FakeRouter()
+        router.set("m0", time_to_saturation_s=500.0)
+        router.set("m1", time_to_saturation_s=80.0)
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router))
+        decision = sup.run_pass()
+        assert decision["fleet_tts_s"] == 80.0
+        assert decision["action"] == "spawn"
+
+    def test_no_spawn_when_forecast_flat_or_beyond_horizon(self):
+        router = FakeRouter()
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router))
+        assert sup.run_pass()["action"] == "hold"      # tts None
+        router.set("m0", time_to_saturation_s=1e6)
+        assert sup.run_pass()["action"] == "hold"      # beyond horizon
+        assert not router.added
+
+    def test_warming_member_blocks_a_second_spawn(self):
+        router = FakeRouter()
+        clock = FakeClock()
+        router.set("m0", time_to_saturation_s=10.0)
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   spawn_cooldown_s=0.0)
+        assert sup.run_pass()["action"] == "spawn"
+        # a0 is warming (FakeRouter marks fresh members warming) and the
+        # pressure signal persists — but the last decision hasn't landed.
+        clock.advance(5.0)
+        assert sup.run_pass()["action"] == "hold"
+        assert len(router.added) == 1
+
+    def test_spawn_cooldown_blocks_back_to_back_spawns(self):
+        router = FakeRouter()
+        clock = FakeClock()
+        router.set("m0", time_to_saturation_s=10.0)
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   spawn_cooldown_s=10.0)
+        assert sup.run_pass()["action"] == "spawn"
+        router.set("a0", warming=False, headroom=0.9)   # landed
+        clock.advance(5.0)
+        assert sup.run_pass()["action"] == "hold"       # inside cooldown
+        clock.advance(6.0)
+        assert sup.run_pass()["action"] == "spawn"      # cooldown expired
+        assert len(router.added) == 2
+
+    def test_spawner_exception_is_contained(self):
+        router = FakeRouter()
+        router.set("m0", time_to_saturation_s=10.0)
+
+        def bad_spawner():
+            raise RuntimeError("boot exploded")
+
+        sup = _sup(router, FakeClock(), spawner=bad_spawner)
+        decision = sup.run_pass()
+        assert decision["action"] == "hold"
+        assert not router.added
+        assert "m0" in router.clients and "m1" in router.clients
+
+
+class TestAdvisory:
+    def test_no_spawner_records_advice_without_acting(self):
+        router = FakeRouter()
+        router.set("m0", time_to_saturation_s=10.0)
+        sup = _sup(router, FakeClock())
+        decision = sup.run_pass()
+        assert decision["action"] == "hold"
+        assert sorted(router.clients) == ["m0", "m1"]
+        advised = [e for e in sup.events
+                   if e["action"] == "spawn_advised"]
+        assert advised and advised[0]["reason"] == "saturation_forecast"
+        assert sup.snapshot()["acting"] is False
+
+
+class TestScaleIn:
+    def _surplus_router(self):
+        router = FakeRouter(members=("m0", "m1", "m2"))
+        for m in router.rows:
+            router.set(m, headroom=0.8)
+        return router
+
+    def test_retire_emptiest_after_sustained_surplus(self):
+        router = self._surplus_router()
+        router.streams["m1"] = []          # emptiest
+        clock = FakeClock()
+        retired = []
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   retirer=retired.append, surplus_hold_s=30.0)
+        assert sup.run_pass()["action"] == "hold"   # timer just started
+        clock.advance(31.0)
+        decision = sup.run_pass()
+        assert decision["action"] == "retire"
+        assert decision["reason"] == "headroom_surplus"
+        assert router.removed == ["m1"] and retired == ["m1"]
+        assert sup.events[-1]["action"] == "retire"
+        assert sup.events[-1]["min_headroom"] == 0.8
+
+    def test_tie_retires_the_lexically_last_member(self):
+        router = self._surplus_router()
+        for m in router.streams:
+            router.streams[m] = []
+        clock = FakeClock()
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   retirer=lambda name: None)
+        sup.run_pass()
+        clock.advance(31.0)
+        assert sup.run_pass()["action"] == "retire"
+        # Later spawns sort last under m<N> naming: contract newest-first.
+        assert router.removed == ["m2"]
+
+    def test_surplus_timer_resets_on_breach(self):
+        router = self._surplus_router()
+        clock = FakeClock()
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   retirer=lambda name: None, surplus_hold_s=30.0)
+        sup.run_pass()
+        clock.advance(20.0)
+        router.set("m2", headroom=0.1)     # one member breaches the bar
+        assert sup.run_pass()["action"] == "hold"
+        router.set("m2", headroom=0.8)
+        clock.advance(5.0)
+        sup.run_pass()                     # timer restarts HERE, not at
+        clock.advance(20.0)                # the pre-breach first pass
+        decision = sup.run_pass()
+        assert decision["action"] == "hold"
+        assert decision["surplus_held_s"] == pytest.approx(20.0)
+        assert not router.removed
+
+    def test_unreported_capacity_holds_scale_in(self):
+        router = self._surplus_router()
+        router.set("m2", headroom=None)    # capacity plane off on one
+        clock = FakeClock()
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   retirer=lambda name: None)
+        sup.run_pass()
+        clock.advance(100.0)
+        decision = sup.run_pass()
+        assert decision["action"] == "hold"
+        assert decision["min_headroom"] is None
+        assert not router.removed
+
+    def test_min_members_blocks_retire(self):
+        router = FakeRouter(members=("m0", "m1"))
+        for m in router.rows:
+            router.set(m, headroom=0.9)
+        clock = FakeClock()
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   retirer=lambda name: None, min_members=2)
+        sup.run_pass()
+        clock.advance(31.0)
+        assert sup.run_pass()["action"] == "hold"
+        assert not router.removed
+
+    def test_retire_cooldown_counts_from_spawn(self):
+        # A spawn resets the surplus timer AND starts the retire
+        # cooldown: the member that just booted must not be judged
+        # surplus before its share of load arrives.
+        router = FakeRouter()
+        clock = FakeClock()
+        router.set("m0", time_to_saturation_s=10.0)
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   retirer=lambda name: None,
+                   surplus_hold_s=5.0, retire_cooldown_s=30.0)
+        assert sup.run_pass()["action"] == "spawn"
+        router.set("m0", time_to_saturation_s=None, headroom=0.9)
+        router.set("a0", warming=False, headroom=0.9)
+        clock.advance(10.0)
+        sup.run_pass()                      # surplus timer starts
+        clock.advance(6.0)
+        assert sup.run_pass()["action"] == "hold"   # cooldown since spawn
+        clock.advance(20.0)
+        assert sup.run_pass()["action"] == "retire"
+
+    def test_drain_failure_keeps_the_member(self):
+        router = self._surplus_router()
+        router.fail_remove = True
+        clock = FakeClock()
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   retirer=lambda name: None)
+        sup.run_pass()
+        clock.advance(31.0)
+        assert sup.run_pass()["action"] == "hold"
+        assert sorted(router.clients) == ["m0", "m1", "m2"]
+
+
+class TestFlapContainment:
+    def test_spawn_cooldown_is_symmetric_over_retires(self):
+        """The retire drain's migrations step up the survivors'
+        utilization; the capacity forecast reads that slope as burn for
+        a fast-window's worth of seconds. A spawn on that echo would
+        ping-pong the member set — the spawn cooldown counts from the
+        retire too."""
+        router = FakeRouter(members=("m0", "m1", "m2"))
+        for m in router.rows:
+            router.set(m, headroom=0.8)
+        router.streams["m2"] = []
+        clock = FakeClock()
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   retirer=lambda name: None,
+                   surplus_hold_s=5.0, retire_cooldown_s=5.0,
+                   spawn_cooldown_s=20.0)
+        sup.run_pass()
+        clock.advance(6.0)
+        assert sup.run_pass()["action"] == "retire"
+        # Drain echo: the survivors' forecast briefly shows saturation.
+        router.set("m0", time_to_saturation_s=30.0)
+        clock.advance(10.0)
+        assert sup.run_pass()["action"] == "hold"   # echo inside cooldown
+        assert not router.added
+        clock.advance(15.0)                          # echo persisted: real
+        assert sup.run_pass()["action"] == "spawn"
+
+    def test_one_action_per_pass(self):
+        # min_bound is two members short: each pass spawns exactly one
+        # member and re-reads the fleet the action just changed.
+        router = FakeRouter(members=("m0",))
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router), min_members=3,
+                   spawn_cooldown_s=0.0)
+        sup.run_pass()
+        assert len(router.added) == 1
+        # The freshly spawned member is warming — even min_bound waits
+        # for it to land before the next spawn.
+        assert sup.run_pass()["action"] == "hold"
+        router.set("a0", warming=False, headroom=0.9)
+        sup.run_pass()
+        assert len(router.added) == 2
+
+
+class TestSurfaces:
+    def test_snapshot_structure(self):
+        router = FakeRouter()
+        router.set("m0", time_to_saturation_s=10.0)
+        sup = _sup(router, FakeClock(), spawner=_spawner_factory(router))
+        sup.run_pass()
+        snap = sup.snapshot()
+        assert snap["name"] == "supervisor0"
+        assert snap["passes"] == 1
+        assert snap["bounds"] == {"min": 1, "max": 4}
+        assert snap["acting"] is True
+        assert snap["last_decision"]["action"] == "spawn"
+        assert set(snap["members"]) == {"m0", "m1", "a0"}
+        assert snap["members"]["a0"]["warming"] is True
+        assert snap["members"]["m0"]["streams"] == 1
+        assert snap["cooldowns"]["since_spawn_s"] is not None
+        assert any(e["action"] == "spawn" for e in snap["events"])
+
+    def test_events_are_bounded(self):
+        router = FakeRouter(members=("m0",))
+        sup = _sup(router, FakeClock())
+        for _ in range(200):
+            sup._record({"action": "noise"})
+        assert len(sup.events) == 64
+
+    def test_metric_families_lint_clean(self):
+        router = FakeRouter()
+        router.set("m0", time_to_saturation_s=10.0)
+        sup = _sup(router, FakeClock(), spawner=_spawner_factory(router))
+        sup.run_pass()
+        text = obs_registry.render()
+        families = {line.split()[2] for line in text.splitlines()
+                    if line.startswith("# TYPE vep_supervisor_")}
+        assert {"vep_supervisor_members",
+                "vep_supervisor_fleet_time_to_saturation_seconds",
+                "vep_supervisor_fleet_min_headroom",
+                "vep_supervisor_surplus_held_seconds",
+                "vep_supervisor_passes_total",
+                "vep_supervisor_spawns_total",
+                "vep_supervisor_retires_total",
+                "vep_supervisor_blocked_total"} <= families
+        assert lint_exposition(text) == []

@@ -241,6 +241,41 @@ def capacity_info(baseline_dir: str):
     return None
 
 
+def autoscale_info(baseline_dir: str):
+    """Newest committed AUTOSCALE_r*.json's lifecycle row, or None.
+
+    Round 19 informational carry-through: perf-gate logs show the
+    autoscale soak's spawn latency (cold vs manifest-warm boot, spawn ->
+    first-served-frame) and flap/ledger verdicts next to the fps
+    verdict. NEVER gated here — autoscale_smoke.py hard-gates its own
+    run; this is trend visibility only.
+    """
+    paths = sorted(glob.glob(os.path.join(baseline_dir,
+                                          "AUTOSCALE_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(art, dict) or "spawn" not in art:
+            continue
+        gates = art.get("gates") or {}
+        spawn = art.get("spawn") or {}
+        boots = art.get("boots") or {}
+        return {
+            "artifact": os.path.basename(path),
+            "cold_boot_s": (boots.get("m0") or {}).get("boot_s"),
+            "warm_boot_s": (boots.get("m1") or {}).get("boot_s"),
+            "spawn_boot_s": spawn.get("boot_s"),
+            "spawn_first_frame_s": spawn.get("first_frame_s"),
+            "storm_p99_s": (art.get("storm") or {}).get("p99_s"),
+            "no_flap": gates.get("no_flap"),
+            "ledger_balanced": gates.get("ledger_balanced"),
+        }
+    return None
+
+
 def stem_stage_info(baseline_dir: str):
     """Newest committed MFU_yolo_*.json's stem-stage row, or None.
 
@@ -304,6 +339,9 @@ def main(argv=None) -> int:
     capacity = capacity_info(args.baseline_dir)
     if capacity is not None:
         report["capacity"] = capacity        # informational, never gated
+    autoscale = autoscale_info(args.baseline_dir)
+    if autoscale is not None:
+        report["autoscale"] = autoscale      # informational, never gated
     print(json.dumps(report, indent=2))
     return 0 if report["passed"] else 1
 

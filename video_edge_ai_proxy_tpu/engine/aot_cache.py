@@ -1,0 +1,213 @@
+"""Persistent AOT prewarm cache (r19): spawn-time cold start killer.
+
+The XLA persistent compile cache (``EngineConfig.compile_cache_dir``,
+wired in ``runner.warmup``) already makes a *restart* cheap — but a
+freshly *spawned* fleet member still has to know WHICH programs to
+compile before taking traffic, and ROUTER_r01 had to reset its
+conservation ledger post-warmup because a member compiling in-tick
+overwrites frames (latest-frame-wins) for tens of seconds. This module
+adds the missing half: a versioned **prewarm manifest** JSON living
+next to the XLA cache payload that records the program set — one entry
+per ``(model, stem, geometry, bucket)`` serving step a member has ever
+compiled — so a spawned member pointed at the shared cache dir replays
+the whole set at boot (every compile a cache hit) and serves its first
+migrated frame within one router scrape interval (ROADMAP item 4).
+
+Fallback contract: a manifest whose ``version`` or ``jaxlib`` stamp
+does not match the running process is *ignored* (clean compile, fresh
+manifest on the next record) — never an exception. The XLA cache keys
+include the jaxlib/XLA fingerprint on their own; the manifest stamp
+exists so we never burn boot time replaying a program list whose cache
+entries are guaranteed misses.
+
+Stdlib-only except for :func:`configure` (which touches jax.config and
+is only called from the engine warmup path); the manifest helpers are
+safe to import from control-plane code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("engine.aot_cache")
+
+MANIFEST_VERSION = 1
+MANIFEST_NAME = "prewarm_manifest.json"
+
+# One process-wide lock: several engines in one test process may share a
+# cache dir; cross-process writers are covered by the atomic rename.
+_manifest_lock = threading.Lock()
+
+
+def _jaxlib_stamp() -> str:
+    """Version stamp binding a manifest to the compiler that filled the
+    XLA cache next to it. jax import lives inside the function per the
+    serving-path convention (manifest readers stay backend-free until
+    someone actually asks for the stamp)."""
+    try:
+        import jaxlib
+
+        return str(jaxlib.version.__version__)
+    except Exception:  # pragma: no cover - jaxlib always ships jax
+        return "unknown"
+
+
+def manifest_path(cache_dir: str) -> str:
+    return os.path.join(cache_dir, MANIFEST_NAME)
+
+
+def configure(cache_dir: str) -> bool:
+    """Point the jax persistent compilation cache at ``cache_dir``.
+
+    Same wiring the plain ``compile_cache_dir`` path uses (lower the
+    persistence threshold only when still at the jax default, reset the
+    cache object so the directory binds even if something compiled
+    first); returns False instead of raising when jax refuses.
+    """
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        if jax.config.jax_persistent_cache_min_compile_time_secs == 1.0:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5
+            )
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+        except Exception:
+            log.warning(
+                "could not reset the XLA compilation cache; programs "
+                "compiled before warmup may persist elsewhere",
+                exc_info=True,
+            )
+        return True
+    except Exception:
+        log.exception("AOT cache configure failed; continuing uncached")
+        return False
+
+
+def _program_key(prog: Dict[str, Any]) -> tuple:
+    return (
+        str(prog.get("model") or ""),
+        str(prog.get("stem") or "classic"),
+        int(prog.get("h", 0)),
+        int(prog.get("w", 0)),
+        int(prog.get("bucket", 0)),
+    )
+
+
+def load_manifest(cache_dir: str) -> Optional[List[Dict[str, Any]]]:
+    """Read the prewarm manifest; None = nothing usable (missing,
+    unparseable, or version/jaxlib mismatch — all of which mean "clean
+    compile", never a crash)."""
+    path = manifest_path(cache_dir)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError):
+        log.warning("unreadable prewarm manifest %s; ignoring", path,
+                    exc_info=True)
+        return None
+    if not isinstance(data, dict):
+        log.warning("prewarm manifest %s is not a mapping; ignoring", path)
+        return None
+    if data.get("version") != MANIFEST_VERSION:
+        log.warning(
+            "prewarm manifest %s version %r != %d; clean compile",
+            path, data.get("version"), MANIFEST_VERSION,
+        )
+        return None
+    stamp = _jaxlib_stamp()
+    if data.get("jaxlib") != stamp:
+        log.warning(
+            "prewarm manifest %s built under jaxlib %r, running %r; "
+            "clean compile", path, data.get("jaxlib"), stamp,
+        )
+        return None
+    programs = data.get("programs")
+    if not isinstance(programs, list):
+        return None
+    out: List[Dict[str, Any]] = []
+    seen = set()
+    for prog in programs:
+        if not isinstance(prog, dict):
+            continue
+        try:
+            key = _program_key(prog)
+        except (TypeError, ValueError):
+            continue
+        if key in seen or key[4] <= 0:
+            continue
+        seen.add(key)
+        out.append({"model": key[0] or None, "stem": key[1],
+                    "h": key[2], "w": key[3], "bucket": key[4]})
+    return out
+
+
+def prewarm_entries(programs: List[Dict[str, Any]]) -> List[list]:
+    """Manifest programs -> ``cfg.prewarm``-shaped 5-element entries
+    (``[h, w, bucket, model, stem]``; model "" = engine default)."""
+    return [
+        [p["h"], p["w"], p["bucket"], p["model"] or "", p["stem"]]
+        for p in programs
+    ]
+
+
+def record_program(
+    cache_dir: str,
+    *,
+    model: Optional[str],
+    stem: str,
+    src_hw: tuple,
+    bucket: int,
+) -> None:
+    """Merge one compiled serving-step program into the manifest
+    (read-modify-write under the process lock, atomic rename so a
+    concurrently spawning member never reads a torn file). A stale or
+    mismatched manifest on disk is replaced, not merged into."""
+    prog = {
+        "model": model or None,
+        "stem": stem or "classic",
+        "h": int(src_hw[0]),
+        "w": int(src_hw[1]),
+        "bucket": int(bucket),
+    }
+    with _manifest_lock:
+        try:
+            existing = load_manifest(cache_dir) or []
+            keys = {_program_key(p) for p in existing}
+            if _program_key(prog) in keys:
+                return
+            existing.append(prog)
+            os.makedirs(cache_dir, exist_ok=True)
+            path = manifest_path(cache_dir)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(
+                    {
+                        "version": MANIFEST_VERSION,
+                        "jaxlib": _jaxlib_stamp(),
+                        "programs": existing,
+                    },
+                    fh,
+                    indent=1,
+                    sort_keys=True,
+                )
+            os.replace(tmp, path)
+        except Exception:
+            # Recording is best-effort: a read-only cache dir costs the
+            # next spawn a compile, never this member its boot.
+            log.warning("could not record prewarm program %r in %s",
+                        prog, cache_dir, exc_info=True)

@@ -724,6 +724,17 @@ class InferenceEngine:
         self._bad_models: Dict[str, dict] = {}
         self._conf_threshold = 0.0   # calibrated at warmup from ckpt meta
         self._step_cache: Dict[tuple, Any] = {}
+        # AOT prewarm cache (r19, engine/aot_cache.py): when enabled the
+        # cache dir carries a prewarm manifest alongside the XLA payload;
+        # _prewarm_required/_done back /api/v1/stats "prewarm" (the
+        # fleet tier's "warming" member state — scraped-alive but not
+        # yet holding its program set; obs/fleet.py).
+        self._aot_dir = (
+            (self._cfg.aot_cache_dir or "")
+            if getattr(self._cfg, "aot_cache", False) else ""
+        )
+        self._prewarm_required = len(self._cfg.prewarm)
+        self._prewarm_done = 0
         self._collector: Optional[Collector] = None
         self._subscribers: List[tuple] = []   # (queue, device_id filter set|None)
         self._sub_lock = threading.Lock()
@@ -1006,7 +1017,14 @@ class InferenceEngine:
 
         from ..models import registry
 
-        if self._cfg.compile_cache_dir:
+        if self._aot_dir:
+            # AOT prewarm cache (r19): the manifest and the XLA payload
+            # share one dir, so the persistent cache binds there instead
+            # of compile_cache_dir — same wiring, plus mkdir.
+            from . import aot_cache
+
+            aot_cache.configure(self._aot_dir)
+        elif self._cfg.compile_cache_dir:
             # Persistent XLA compile cache: a restarted server re-loads
             # compiled programs instead of paying tens of seconds to
             # minutes per (geometry, bucket) again (SURVEY.md §5.4).
@@ -1447,7 +1465,42 @@ class InferenceEngine:
     def start(self) -> None:
         if self._model is None:
             self.warmup()
-        for geom in self._cfg.prewarm:
+        entries = [list(g) for g in self._cfg.prewarm]
+        if self._aot_dir:
+            # AOT prewarm cache (r19): union the manifest's recorded
+            # program set into the configured prewarm list — every
+            # compile below is then a persistent-cache hit on a member
+            # sharing the dir, so a spawned member holds its programs
+            # within one scrape interval. A mismatched/absent manifest
+            # is just an empty union (clean compile).
+            from . import aot_cache
+
+            def _ekey(e):
+                try:
+                    return (int(e[0]), int(e[1]), int(e[2]),
+                            str(e[3]) if len(e) >= 4 and e[3] else "")
+                except (TypeError, ValueError, IndexError):
+                    return None
+
+            seen = {k for k in (_ekey(e) for e in entries) if k}
+            programs = aot_cache.load_manifest(self._aot_dir) or []
+            for entry in aot_cache.prewarm_entries(programs):
+                key = _ekey(entry)
+                if key is not None and key not in seen:
+                    seen.add(key)
+                    entries.append(entry)
+            if programs:
+                log.info(
+                    "AOT prewarm manifest: %d recorded programs, "
+                    "%d total prewarm entries", len(programs), len(entries),
+                )
+        # Prewarm progress backs the fleet tier's "warming" state: a
+        # member is scraped-alive but must not take migrated traffic (or
+        # be retired) until complete. Skipped/failed entries still count
+        # as done — log-and-continue must not wedge a member in warming.
+        self._prewarm_required = len(entries)
+        self._prewarm_done = 0
+        for geom in entries:
             # Log-and-continue like every other per-item path here: a bad
             # prewarm entry must not abort server boot, and buckets must be
             # ones the collector can actually dispatch (post mesh filter).
@@ -1475,6 +1528,8 @@ class InferenceEngine:
                 self.compile_for((h, w), bucket, model, stem=stem)
             except Exception:
                 log.exception("prewarm entry %r failed; continuing", geom)
+            finally:
+                self._prewarm_done += 1
         if self._xfer is not None:
             self._xfer.start()
         self._drain_thread = threading.Thread(
@@ -1688,6 +1743,21 @@ class InferenceEngine:
             for device_id, st in list(self._stats.items())
         }
 
+    def prewarm_status(self) -> Dict[str, Any]:
+        """Prewarm progress for /api/v1/stats (r19): the fleet tier
+        derives the "warming" member state from ``complete`` — a
+        spawned member is scraped-alive the moment REST binds but must
+        not take migrated traffic until its program set compiled. A
+        member with nothing to prewarm is complete from boot."""
+        required = self._prewarm_required
+        done = self._prewarm_done
+        return {
+            "required": required,
+            "done": done,
+            "complete": done >= required,
+            "aot_cache": bool(self._aot_dir),
+        }
+
     def _run_probe(self) -> None:
         """Device round-trip on a dedicated thread; writes the cache when
         (if) the runtime answers."""
@@ -1899,6 +1969,18 @@ class InferenceEngine:
             fn = _TimedStep(jax.jit(raw, donate_argnums=donate),
                             self.perf, model, src_hw, bucket)
             self._step_cache[key] = fn
+            if self._aot_dir:
+                # Every serving step registered here lands in the prewarm
+                # manifest (this is the only miss site, so the recorded
+                # set IS the program set a member must hold) — the next
+                # spawn replays it straight out of the persistent cache.
+                from . import aot_cache
+
+                aot_cache.record_program(
+                    self._aot_dir, model=model,
+                    stem=getattr(self._cfg, "stem", "classic"),
+                    src_hw=src_hw, bucket=bucket,
+                )
         return fn
 
     # -- engine loop --

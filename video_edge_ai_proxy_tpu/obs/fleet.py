@@ -50,6 +50,9 @@ Fleet metric families (all gauges unless noted):
 - ``vep_fleet_member_slo_burning{instance}``
 - ``vep_fleet_member_ladder_rung{instance}``
 - ``vep_fleet_member_streams{instance}``
+- ``vep_fleet_member_warming{instance}`` — 1 while a spawned member is
+  scraped-alive but its prewarm program set is incomplete (r19: held
+  out of the placement ring, never retired by the supervisor)
 - ``vep_fleet_member_headroom{instance}`` — forecast capacity headroom
   in [0, 1] from the member's r18 capacity plane (-1 when the member
   does not report capacity — mixed-version fleet)
@@ -186,6 +189,19 @@ class MemberState:
         eng = (self.stats or {}).get("engine") or {}
         return len(eng.get("streams") or {})
 
+    def warming(self) -> bool:
+        """r19 spawn state: scraped-alive but the engine's prewarm
+        program set is incomplete (a spawning member binds REST before
+        it compiles — see serve/server.py boot order). Distinct from
+        dead/stale: the member answers scrapes and scores normally, but
+        the router holds it out of the placement ring and the
+        supervisor never retires it. Members that do not report prewarm
+        (engine-less, pre-r19) are never warming."""
+        pw = ((self.stats or {}).get("engine") or {}).get("prewarm")
+        if not isinstance(pw, dict):
+            return False
+        return self.alive and not bool(pw.get("complete", True))
+
     def burning(self) -> bool:
         return bool((self.slo or {}).get("burning"))
 
@@ -273,6 +289,30 @@ class FleetAggregator:
         while not self._stop.is_set():
             self.scrape_once()
             self._stop.wait(self.scrape_interval_s)
+
+    # -- membership (r19 supervisor hooks) --
+
+    def add_member(self, spec: str) -> str:
+        """Register one member at runtime (``"name=url"`` or a bare URL,
+        auto-named ``m<len>``); the next scrape pass picks it up.
+        Returns the member name; duplicates raise."""
+        name, sep, url = str(spec).partition("=")
+        with self._lock:
+            if not sep:
+                name, url = f"m{len(self._members)}", str(spec)
+            if any(m.name == name for m in self._members):
+                raise ValueError(f"member {name!r} already registered")
+            self._members.append(MemberState(name, url))
+        return name
+
+    def remove_member(self, name: str) -> None:
+        """Deregister a member; its health rows and merged samples stop
+        at the next read. Unknown names are a no-op (retire after a
+        crash-remove race must not raise). The list is replaced, not
+        mutated, so a concurrently running scrape pass finishes over the
+        snapshot it started with."""
+        with self._lock:
+            self._members = [m for m in self._members if m.name != name]
 
     # -- scraping --
 
@@ -378,6 +418,7 @@ class FleetAggregator:
             "slo_burning": burning,
             "ladder_rung": rung,
             "streams": streams,
+            "warming": m.warming(),
             # r18 capacity plane (None-keyed when the member does not
             # report it — the router treats those as capacity-less).
             "capacity": bool(m.capacity),
@@ -538,6 +579,11 @@ class FleetAggregator:
         fam("vep_fleet_member_streams", "gauge",
             "Member admitted-stream count",
             lambda r: r["streams"])
+        fam("vep_fleet_member_warming", "gauge",
+            "1 while a spawned member is scraped-alive but its prewarm "
+            "program set is incomplete (held out of placement, never "
+            "retired)",
+            lambda r: 1.0 if r.get("warming") else 0.0)
         fam("vep_fleet_member_headroom", "gauge",
             "Forecast capacity headroom in [0,1] (-1 when unreported)",
             lambda r: r["headroom"] if r["headroom"] is not None else -1.0)

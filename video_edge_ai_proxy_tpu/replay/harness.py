@@ -1067,6 +1067,29 @@ def _fleet_member_main(argv=None) -> None:
                     help="override obs.sample_every (the router soak "
                          "traces every frame so short post-migration "
                          "residence still yields a stitchable chain)")
+    ap.add_argument("--prewarm", action="append", default=[],
+                    metavar="HxWxB[:model]",
+                    help="compile this program during boot (repeatable); "
+                         "soak members prewarm every geometry they will "
+                         "serve so no in-soak compile ever overwrites an "
+                         "uncollected frame (latest-frame-wins) and the "
+                         "conservation ledger holds from the FIRST frame")
+    ap.add_argument("--aot-cache", default="",
+                    help="shared persistent AOT cache dir (r19, "
+                         "engine/aot_cache.py): sets engine.aot_cache + "
+                         "aot_cache_dir; a member sharing a populated dir "
+                         "prewarms via persistent-cache hits and the "
+                         "manifest supplies the program set when no "
+                         "--prewarm flags are given (the spawned-member "
+                         "path)")
+    ap.add_argument("--capacity", action="store_true",
+                    help="enable the r18 capacity attribution plane "
+                         "(headroom + saturation forecast) — the "
+                         "autoscale soak's supervisor steers on it")
+    ap.add_argument("--capacity-fast-window", type=float, default=None,
+                    help="override engine.capacity_fast_window_s (soaks "
+                         "run minutes, not hours: the fast burn window "
+                         "must fit inside the soak's ramp)")
     args = ap.parse_args(argv)
     if not args.serve_only and (not args.trace or not args.device):
         ap.error("--trace/--device required without --serve-only")
@@ -1098,6 +1121,20 @@ def _fleet_member_main(argv=None) -> None:
         cfg.engine.batch_buckets = (args.batch_bucket,)
     if args.trace_every is not None:
         cfg.obs.sample_every = args.trace_every
+    if args.prewarm:
+        entries = []
+        for spec in args.prewarm:
+            geom, _, mdl = spec.partition(":")
+            h, w, b = (int(v) for v in geom.split("x"))
+            entries.append([h, w, b, mdl] if mdl else [h, w, b])
+        cfg.engine.prewarm = entries
+    if args.aot_cache:
+        cfg.engine.aot_cache = True
+        cfg.engine.aot_cache_dir = args.aot_cache
+    if args.capacity:
+        cfg.engine.capacity = True
+    if args.capacity_fast_window is not None:
+        cfg.engine.capacity_fast_window_s = args.capacity_fast_window
     srv = Server(cfg, data_dir=args.workdir, grpc_port=0, rest_port=0,
                  enable_engine=True)
     srv.start()
@@ -1448,17 +1485,19 @@ def run_router_soak(
       wall-clock kill→resumed bound is ``scrape_interval + 1s``).
 
     Cross-cutting gates: the frame-conservation ledger balances for
-    EVERY stream (packet ids gap-free from first delivery, zero
-    duplicates — exactly-once across the handoffs, warmup ramp excluded
-    by the first-delivery baseline); every completed migration has a
+    EVERY stream (packet ids gap-free from the very FIRST delivery,
+    zero duplicates — exactly-once across the handoffs; members prewarm
+    their one device program at boot, so there is no compile ramp to
+    excuse and no post-warmup ledger reset); every completed migration has a
     stitched worker→bus→engine→client lineage (span chain
     collect+device+emit for a trace id the destination's gRPC client
     also received — and the source's too on the graceful leg); and the
     router's ``vep_router_*`` exposition is ``lint_exposition``-clean.
 
-    Determinism levers: members pin ONE batch bucket (a migrated stream
-    joining mid-soak must not trigger a compile — latest-frame-wins
-    would drop frames and corrupt the ledger), shed staleness is set
+    Determinism levers: members pin ONE batch bucket and prewarm its
+    program at boot (any in-soak compile — first frame or migrated
+    stream joining — would drop frames via latest-frame-wins and
+    corrupt the ledger), shed staleness is set
     above the soak length (the shed rung itself drops nothing),
     ``ladder_escalate_s`` spaces the rungs so migration has a full
     window between shed_to_fleet and bucket_downshift, ``fps`` sits
@@ -1509,6 +1548,13 @@ def run_router_soak(
                 "--shed-staleness-ms", "60000",
                 "--batch-bucket", str(bucket),
                 "--trace-every", "1",
+                # The member's ONE device program compiles during boot
+                # (before the ready line), not on the first delivered
+                # frame: the compile ramp used to overwrite ~20 frames
+                # per stream (latest-frame-wins) and forced a post-warmup
+                # ledger reset — prewarmed, conservation holds from the
+                # very first frame (r19; see MigrationLedger docstring).
+                "--prewarm", f"{height}x{width}x{bucket}",
             ]
             if native:
                 cmd.append("--native")
@@ -1642,21 +1688,7 @@ def run_router_soak(
             raise SystemExit(
                 "warmup: not every stream delivered results; see "
                 f"{tmp}/m*.stderr")
-        time.sleep(2.0)
-        # Restart the conservation window at steady state: each stream's
-        # first delivery (the compile trigger) predates the ~20 frames
-        # latest-frame-wins overwrote during its member's compile, so
-        # the warmup ramp would read as losses. Post-reset the pipeline
-        # is lossless and every gap is a migration bug. Deliveries (and
-        # with them the migration cursors) repopulate within a frame
-        # interval — long before the burn leg's first migration.
-        router.ledger.reset()
-        deadline = time.monotonic() + 30.0
-        while time.monotonic() < deadline:
-            if all(router.ledger.next_cursor(n) is not None
-                   for n in stream_names):
-                break
-            time.sleep(0.1)
+        time.sleep(2.0)                         # pipeline settles
         router.start()                          # background control loop
 
         # ---- burn leg: m0 burns; ladder must hand off BEFORE downshift.
@@ -1857,6 +1889,570 @@ def run_router_soak(
         for p in procs:
             if p.poll() is None:
                 p.kill()   # by PID via Popen handle — never pkill
+        if workdir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+class LoadShape:
+    """Production-shaped churn schedule for the autoscale soak (r19).
+
+    Four shapes the reference deployments actually see, folded into one
+    deterministic timetable (no RNG — reruns hit identical schedules):
+
+    - **diurnal ramp** — ``ramp_streams`` cameras connect one every
+      ``ramp_interval_s`` on top of the ``base_streams`` steady tenants:
+      the morning build-up whose utilization *slope* the r18 capacity
+      forecast extrapolates into ``time_to_saturation_s`` — the signal
+      the supervisor must act on BEFORE saturation, not after. The ramp
+      deliberately outlasts a spawned member's boot, so the arrivals
+      still connecting when the fresh member comes up land on it (the
+      headroom-tiered admission prefers the emptiest member) — scale-out
+      absorbs the tail of the very build-up that triggered it.
+    - **connect/disconnect storm** — ``storm_streams`` cameras connect
+      within seconds (an NVR rebooting, a site coming back from a
+      network partition) and later disconnect just as fast. The storm
+      lands AFTER the ramp so a forecast-driven scale-out has already
+      added capacity when it hits.
+    - **hot-spot camera** — the first base stream runs ``hot_fps``
+      against everyone else's ``base_fps``: one member always carries
+      visibly more load than its peers, so placement/retire decisions
+      ride on real per-member skew, not uniform load.
+    - **mixed model tenants** — stream specs rotate through ``models``
+      (``""`` = the member default), so members serve multiple device
+      programs and the AOT prewarm manifest has to carry the full
+      program SET, not one geometry.
+
+    ``specs()`` lists every stream (name, fps, model, phase);
+    ``events()`` is the sorted ``{"t", "op", "stream"}`` timetable
+    relative to the soak's post-warmup t=0 (base connects at t<=0 run
+    before the supervisor starts).
+    """
+
+    def __init__(
+        self, *, base_streams: int = 3, ramp_streams: int = 6,
+        ramp_start_s: float = 2.0, ramp_interval_s: float = 4.0,
+        storm_streams: int = 6, storm_start_s: float = 28.0,
+        storm_spacing_s: float = 0.4, storm_hold_s: float = 18.0,
+        drain_interval_s: float = 0.8,
+        base_fps: float = 0.5, hot_fps: float = 1.5,
+        models: tuple = ("", "tiny_mobilenet_v2"),
+    ):
+        if base_streams < 1 or storm_streams < 1:
+            raise ValueError("need at least one base and one storm stream")
+        if storm_start_s <= ramp_start_s + ramp_streams * ramp_interval_s:
+            raise ValueError(
+                "storm must start after the ramp finishes (the shape's "
+                "point is that forecast-driven scale-out lands first)")
+        self.base_streams = int(base_streams)
+        self.ramp_streams = int(ramp_streams)
+        self.ramp_start_s = float(ramp_start_s)
+        self.ramp_interval_s = float(ramp_interval_s)
+        self.storm_streams = int(storm_streams)
+        self.storm_start_s = float(storm_start_s)
+        self.storm_spacing_s = float(storm_spacing_s)
+        self.storm_hold_s = float(storm_hold_s)
+        self.drain_interval_s = float(drain_interval_s)
+        self.base_fps = float(base_fps)
+        self.hot_fps = float(hot_fps)
+        self.models = tuple(models)
+
+    def specs(self) -> list:
+        out = []
+        tenant = 0
+        for phase, count, prefix in (
+                ("base", self.base_streams, "base"),
+                ("ramp", self.ramp_streams, "ramp"),
+                ("storm", self.storm_streams, "storm")):
+            for i in range(count):
+                hot = phase == "base" and i == 0
+                out.append({
+                    "stream": f"{prefix}{i:03d}",
+                    "phase": phase,
+                    "hot": hot,
+                    "fps": self.hot_fps if hot else self.base_fps,
+                    "model": self.models[tenant % len(self.models)],
+                })
+                tenant += 1
+        return out
+
+    def events(self) -> list:
+        ev = []
+        for spec in self.specs():
+            name, phase = spec["stream"], spec["phase"]
+            i = int(name[-3:])
+            if phase == "base":
+                ev.append({"t": 0.0, "op": "connect", "stream": name})
+            elif phase == "ramp":
+                t_on = self.ramp_start_s + i * self.ramp_interval_s
+                ev.append({"t": t_on, "op": "connect", "stream": name})
+                # Ramp sheds after the storm has fully drained: the
+                # surplus the retire leg waits on is sustained, not a
+                # lull between waves.
+                t_off = (self.storm_start_s + self.storm_hold_s
+                         + self.storm_streams * self.drain_interval_s
+                         + 1.0 + i * self.drain_interval_s)
+                ev.append({"t": t_off, "op": "disconnect", "stream": name})
+            else:
+                t_on = self.storm_start_s + i * self.storm_spacing_s
+                ev.append({"t": t_on, "op": "connect", "stream": name})
+                t_off = (self.storm_start_s + self.storm_hold_s
+                         + i * self.drain_interval_s)
+                ev.append({"t": t_off, "op": "disconnect", "stream": name})
+        ev.sort(key=lambda e: (e["t"], e["stream"], e["op"]))
+        return ev
+
+    @property
+    def duration_s(self) -> float:
+        return max(e["t"] for e in self.events())
+
+
+def run_autoscale_soak(
+    *, width: int = 128, height: int = 96, model: str = "tiny_yolov8",
+    scrape_interval_s: float = 1.0,
+    capacity_scrape_interval_s: float = 30.0,
+    decision_interval_s: float = 1.0, spawn_horizon_s: float = 1800.0,
+    surplus_headroom: float = 0.3, surplus_hold_s: float = 8.0,
+    spawn_cooldown_s: float = 12.0, retire_cooldown_s: float = 60.0,
+    capacity_fast_window_s: float = 5.0,
+    storm_admission_bound_s: float = 12.0,
+    shape: Optional[LoadShape] = None,
+    native: bool = False, workdir: Optional[str] = None,
+) -> dict:
+    """r19 autoscale soak: a :class:`~..serve.supervisor.FleetSupervisor`
+    with a REAL subprocess spawner over a :class:`LoadShape` churn
+    schedule — the ``AUTOSCALE_r01.json`` payload.
+
+    Two members boot sequentially against a shared persistent AOT cache
+    dir (m0 cold — it POPULATES the cache and the prewarm manifest; m1's
+    identical prewarm set is already a persistent-cache hit). The
+    supervisor's spawned member boots with NO ``--prewarm`` flags at
+    all: its program set comes purely from the manifest, every compile a
+    cache hit — the spawn path the r19 cache exists for.
+
+    Gates:
+
+    - ``scale_out_on_forecast`` / ``scale_out_beats_burn`` — the one
+      spawn is reason ``saturation_forecast`` (the ramp's utilization
+      slope crossed the horizon) and landed while fleet ``min_headroom``
+      was still positive: capacity arrived BEFORE the burn, not after.
+    - ``spawn_prewarm_from_manifest`` — the spawned member's
+      ``/api/v1/stats`` prewarm block shows the manifest supplied (and
+      it completed) every recorded program with the cache enabled.
+    - ``spawn_first_frame_within_scrape`` — Popen→first-served-frame on
+      the spawned member lands inside ONE capacity-forecast scrape
+      interval (``capacity_scrape_interval_s``, the O(10 s) cadence a
+      production fleet scrapes capacity at — distinct from the router's
+      1 s liveness scrape): the member is serving before the forecast
+      plane would even re-sample.
+    - ``storm_admission_bounded`` — connect→first-frame p99 across the
+      storm stays under ``storm_admission_bound_s``.
+    - ``retire_on_surplus`` / ``no_flap`` — after the storm and ramp
+      drain, sustained surplus retires exactly one member (drained via
+      the r16 lineage-verified ``scale_in`` migration) and the member
+      set neither re-spawns on the drain's utilization echo nor
+      oscillates: one spawn, one retire, back at ``min_members``.
+    - ``ledger_balanced`` — zero frames lost, zero duplicated across
+      admission, storm churn, scale-out and the retire drain. Members
+      prewarm every program they serve, so conservation holds from the
+      very first frame of every stream with NO warmup exclusion.
+    - ``supervisor_metrics_lint_clean`` — ``vep_supervisor_*`` is
+      ``lint_exposition``-clean.
+
+    Determinism levers carry over from :func:`run_router_soak` (pinned
+    single bucket, prewarmed programs, ``--slo-off --ladder-slo-only``,
+    shed staleness above the soak length, fps under the CPU tick rate);
+    new here: ``capacity_fast_window_s`` shrinks the burn window to fit
+    the soak's ramp, the supervisor's symmetric spawn cooldown outlasts
+    it so the retire drain's slope echo cannot re-spawn, and
+    ``retire_cooldown_s`` outlasts the whole churn schedule — the CPU
+    twin's utilization never dents headroom, so the surplus BAR is held
+    throughout and the cooldown is what makes "sustained surplus" mean
+    "after the storm and ramp drained" instead of "the first quiet
+    10 s" (on the real chip the bar itself does this work).
+    """
+    import json as _json
+    import itertools
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    import grpc
+
+    from ..obs import registry as obs_registry
+    from ..obs.metrics import lint_exposition
+    from ..proto import pb, pb_grpc
+    from ..serve.router import StreamRouter
+    from ..serve.supervisor import FleetSupervisor
+
+    shape = shape or LoadShape()
+    tmp = workdir or tempfile.mkdtemp(prefix="vep_autoscale_")
+    aot_dir = os.path.join(tmp, "aot_cache")
+    bucket = 8
+    specs = {s["stream"]: s for s in shape.specs()}
+    tenant_models = sorted({s["model"] for s in shape.specs()
+                            if s["model"]})
+
+    stop = threading.Event()
+    rx_lock = threading.Lock()
+    first_rx: dict = {}          # stream -> monotonic of first delivery
+    member_first_rx: dict = {}   # member -> monotonic of first frame served
+    t_admit: dict = {}           # stream -> monotonic at admit()
+    procs_by_name: dict = {}
+    boots: dict = {}             # member -> {"boot_s", rest/grpc ports}
+    spawn_info: dict = {}
+    retire_info: dict = {}
+    failures: list = []
+    threads: list = []
+    router: Optional[StreamRouter] = None
+    sup: Optional[FleetSupervisor] = None
+
+    def read_msg(proc, key, timeout_s=300.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise SystemExit(
+                    f"autoscale member died (rc={proc.poll()}); "
+                    f"see {tmp}/*.stderr")
+            try:
+                msg = _json.loads(line)
+            except ValueError:
+                continue
+            if key in msg:
+                return msg
+        raise SystemExit(f"autoscale member: no {key!r} within {timeout_s}s")
+
+    def _boot_member(mname: str, *, prewarm: bool):
+        """Popen → ready line; returns (base_url, grpc_port). With
+        ``prewarm=False`` the member gets NO --prewarm flags: its
+        program set must come from the shared AOT cache's manifest."""
+        member_dir = os.path.join(tmp, mname)
+        os.makedirs(member_dir, exist_ok=True)
+        cmd = [
+            sys.executable, "-m",
+            "video_edge_ai_proxy_tpu.replay.harness",
+            "--instance", mname, "--workdir", member_dir,
+            "--model", model,
+            "--spans-out", os.path.join(tmp, f"{mname}_spans.json"),
+            "--serve-only", "--slo-off", "--ladder-slo-only",
+            "--shed-staleness-ms", "600000",
+            "--batch-bucket", str(bucket),
+            "--capacity",
+            "--capacity-fast-window", str(capacity_fast_window_s),
+            "--aot-cache", aot_dir,
+        ]
+        if prewarm:
+            cmd += ["--prewarm", f"{height}x{width}x{bucket}"]
+            for mdl in tenant_models:
+                cmd += ["--prewarm", f"{height}x{width}x{bucket}:{mdl}"]
+        if native:
+            cmd.append("--native")
+        env = dict(os.environ)
+        if not native:
+            env["JAX_PLATFORMS"] = "cpu"
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=open(os.path.join(tmp, f"{mname}.stderr"), "w"),
+            env=env, text=True)
+        procs_by_name[mname] = proc
+        msg = read_msg(proc, "ready")
+        boots[mname] = {
+            "boot_s": round(time.monotonic() - t0, 3),
+            "rest_port": msg["rest_port"], "grpc_port": msg["grpc_port"],
+            "prewarm_flags": prewarm,
+        }
+        return f"http://127.0.0.1:{msg['rest_port']}", msg["grpc_port"]
+
+    def _start_client(mname: str, grpc_port: int) -> None:
+        def _client():
+            channel = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+            stub = pb_grpc.ImageStub(channel)
+            while not stop.is_set():
+                try:
+                    for res in stub.Inference(pb.InferenceRequest()):
+                        if stop.is_set():
+                            break
+                        if not res.device_id:
+                            continue
+                        now = time.monotonic()
+                        router.ledger.note_delivery(
+                            res.device_id, mname, res.frame_packet,
+                            res.trace_id)
+                        with rx_lock:
+                            first_rx.setdefault(res.device_id, now)
+                            member_first_rx.setdefault(mname, now)
+                except grpc.RpcError:
+                    if not stop.is_set():
+                        time.sleep(0.25)
+            channel.close()
+        t = threading.Thread(target=_client, daemon=True,
+                             name=f"autoscale-client-{mname}")
+        threads.append(t)
+        t.start()
+
+    def _send_exit(mname: str) -> None:
+        proc = procs_by_name.get(mname)
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            proc.stdin.write("exit\n")
+            proc.stdin.flush()
+            proc.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()   # by PID via Popen handle — never pkill
+
+    try:
+        for spec in shape.specs():
+            record_synthetic_trace(
+                os.path.join(tmp, f"{spec['stream']}.vtrace"),
+                [spec["stream"]], width=width, height=height,
+                fps=spec["fps"], gop=30, frames=int(spec["fps"] * 240))
+
+        # m0 boots COLD (populates the persistent cache + manifest), m1
+        # boots against the populated dir — sequentially, so m1's boot
+        # time already shows the cache-hit delta.
+        urls = {}
+        for mname in ("m0", "m1"):
+            urls[mname], _ = _boot_member(mname, prewarm=True)
+
+        router = StreamRouter(
+            [f"{m}={urls[m]}" for m in ("m0", "m1")],
+            scrape_interval_s=scrape_interval_s,
+            max_moves_per_pass=16,
+            drain_timeout_s=5.0, drain_poll_s=0.5)
+        router.run_pass()
+        attach_errors = {k: v for k, v in router.attach().items() if v}
+        for mname in ("m0", "m1"):
+            _start_client(mname, boots[mname]["grpc_port"])
+        router.start()
+
+        admit_seq = itertools.count()
+
+        def _admit(name: str) -> None:
+            url = (f"replay://{tmp}/{name}.vtrace?device={name}"
+                   "&pace=1&loop=0")
+            t_admit[name] = time.monotonic()
+            try:
+                router.admit(name, url, priority=next(admit_seq),
+                             inference_model=specs[name]["model"])
+            except Exception as exc:  # noqa: BLE001 — gate, don't abort
+                failures.append(f"admit {name}: {type(exc).__name__}: "
+                                f"{exc}")
+
+        events = shape.events()
+        for ev in [e for e in events if e["t"] <= 0.0]:
+            _admit(ev["stream"])
+        base_names = [s["stream"] for s in shape.specs()
+                      if s["phase"] == "base"]
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            with rx_lock:
+                if all(n in first_rx for n in base_names):
+                    break
+            time.sleep(0.25)
+        else:
+            raise SystemExit("warmup: base streams never all delivered; "
+                             f"see {tmp}/*.stderr")
+        # Let the connect transient leave the fast burn window: the
+        # supervisor must see the RAMP's slope, not the base warmup's.
+        time.sleep(2.0 * capacity_fast_window_s)
+
+        spawn_seq = itertools.count()
+
+        def spawner():
+            mname = f"a{next(spawn_seq)}"
+            t0 = time.monotonic()
+            url, grpc_port = _boot_member(mname, prewarm=False)
+            _start_client(mname, grpc_port)
+            # The manifest-driven prewarm block, captured at ready: the
+            # spawned member must hold every recorded program with the
+            # cache on — nothing left to compile on first dispatch.
+            prewarm = None
+            try:
+                with urllib.request.urlopen(
+                        f"{url}/api/v1/stats", timeout=5) as r:
+                    prewarm = _json.loads(r.read())["engine"]["prewarm"]
+            except Exception:  # noqa: BLE001 — gate reads None
+                pass
+            spawn_info[mname] = {
+                "t_spawn": t0,
+                "boot_s": round(time.monotonic() - t0, 3),
+                "prewarm": prewarm,
+            }
+            return mname, url
+
+        def retirer(mname: str) -> None:
+            retire_info[mname] = {"t_retire": time.monotonic()}
+            _send_exit(mname)
+
+        sup = FleetSupervisor(
+            router, spawner=spawner, retirer=retirer,
+            min_members=2, max_members=3,
+            decision_interval_s=decision_interval_s,
+            spawn_horizon_s=spawn_horizon_s,
+            surplus_headroom=surplus_headroom,
+            surplus_hold_s=surplus_hold_s,
+            spawn_cooldown_s=spawn_cooldown_s,
+            retire_cooldown_s=retire_cooldown_s)
+        sup.start()
+
+        t0 = time.monotonic()
+        for ev in [e for e in events if e["t"] > 0.0]:
+            wait = t0 + ev["t"] - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            if ev["op"] == "connect":
+                _admit(ev["stream"])
+            else:
+                router.remove_stream(ev["stream"])
+
+        # The retire leg: sustained surplus after the drain.
+        deadline = time.monotonic() + surplus_hold_s \
+            + retire_cooldown_s + 60.0
+        while time.monotonic() < deadline:
+            if any(e["action"] == "retire" for e in list(sup.events)):
+                break
+            time.sleep(0.25)
+        # Post-retire observation: long enough for a flap to show.
+        time.sleep(max(4.0, 3.0 * decision_interval_s))
+        sup.stop()
+        sup_snapshot = sup.snapshot()
+        router.stop()
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        balance = router.ledger.balance()
+
+        spawns = [e for e in sup.events if e["action"] == "spawn"]
+        retires = [e for e in sup.events if e["action"] == "retire"]
+        spawned = spawns[0]["member"] if spawns else None
+        spawn_first_frame_s = None
+        if spawned and spawned in spawn_info:
+            with rx_lock:
+                served = member_first_rx.get(spawned)
+            if served is not None:
+                spawn_first_frame_s = round(
+                    served - spawn_info[spawned]["t_spawn"], 3)
+        spawn_prewarm = (spawn_info.get(spawned, {}).get("prewarm")
+                        if spawned else None)
+
+        storm_names = [s["stream"] for s in shape.specs()
+                       if s["phase"] == "storm"]
+        with rx_lock:
+            storm_lat = sorted(
+                round(first_rx[n] - t_admit[n], 3) for n in storm_names
+                if n in first_rx and n in t_admit)
+        storm_p99 = (storm_lat[max(0, min(len(storm_lat) - 1,
+                     int(round(0.99 * (len(storm_lat) - 1)))))]
+                     if storm_lat else None)
+
+        exposition = obs_registry.render()
+        lint_errors = lint_exposition(exposition)
+        sup_families = sorted({
+            line.split()[2] for line in exposition.splitlines()
+            if line.startswith("# TYPE vep_supervisor_")})
+
+        gates = {
+            "attach_clean": not attach_errors,
+            "scale_out_on_forecast": bool(spawns) and
+                spawns[0]["reason"] == "saturation_forecast",
+            "scale_out_beats_burn": bool(spawns) and
+                (spawns[0].get("min_headroom") or 0.0) > 0.0,
+            "spawn_prewarm_from_manifest": bool(
+                spawn_prewarm and spawn_prewarm.get("aot_cache")
+                and spawn_prewarm.get("complete")
+                and spawn_prewarm.get("required", 0) >= 1
+                + len(tenant_models)),
+            "spawn_first_frame_within_scrape": (
+                spawn_first_frame_s is not None
+                and spawn_first_frame_s <= capacity_scrape_interval_s),
+            "storm_admission_bounded": (
+                len(storm_lat) == len(storm_names)
+                and storm_p99 <= storm_admission_bound_s),
+            "retire_on_surplus": bool(retires),
+            "no_flap": (len(spawns) == 1 and len(retires) == 1
+                        and len(router.clients) == 2),
+            "ledger_balanced": balance["balanced"],
+            "no_admission_errors": not failures,
+            "supervisor_metrics_lint_clean": (
+                not lint_errors and len(sup_families) >= 6),
+        }
+        return {
+            "metric": f"autoscale_{shape.base_streams}b{shape.ramp_streams}"
+                      f"r{shape.storm_streams}s_{model}",
+            "pipeline": (
+                "2 cold/warm members + FleetSupervisor (subprocess "
+                "spawner, shared AOT prewarm cache) <- LoadShape "
+                "ramp/storm/hot-spot/mixed-tenant churn <- per-member "
+                "gRPC clients -> conservation ledger"),
+            "model": model,
+            "shape": {
+                "base": shape.base_streams, "ramp": shape.ramp_streams,
+                "storm": shape.storm_streams,
+                "base_fps": shape.base_fps, "hot_fps": shape.hot_fps,
+                "models": list(shape.models),
+                "duration_s": shape.duration_s,
+            },
+            "config": {
+                "scrape_interval_s": scrape_interval_s,
+                "capacity_scrape_interval_s": capacity_scrape_interval_s,
+                "decision_interval_s": decision_interval_s,
+                "spawn_horizon_s": spawn_horizon_s,
+                "surplus_headroom": surplus_headroom,
+                "surplus_hold_s": surplus_hold_s,
+                "capacity_fast_window_s": capacity_fast_window_s,
+                "storm_admission_bound_s": storm_admission_bound_s,
+                "bucket": bucket,
+            },
+            "gates": gates,
+            "boots": boots,
+            "spawn": {
+                "member": spawned,
+                "event": spawns[0] if spawns else None,
+                "boot_s": spawn_info.get(spawned, {}).get("boot_s")
+                if spawned else None,
+                "first_frame_s": spawn_first_frame_s,
+                "prewarm": spawn_prewarm,
+            },
+            "storm": {
+                "streams": len(storm_names),
+                "admitted_first_frame_s": storm_lat,
+                "p99_s": storm_p99,
+            },
+            "retire": {
+                "member": retires[0]["member"] if retires else None,
+                "event": retires[0] if retires else None,
+            },
+            "ledger": {
+                "balanced": balance["balanced"],
+                "lost": balance["lost"],
+                "duplicated": balance["duplicated"],
+                "streams": balance["streams"],
+            },
+            "failures": failures,
+            "lint_errors": lint_errors[:10],
+            "supervisor_families": sup_families,
+            "supervisor_snapshot": sup_snapshot,
+        }
+    finally:
+        stop.set()
+        if sup is not None:
+            sup.stop()
+        if router is not None:
+            router.stop()
+        for mname in list(procs_by_name):
+            _send_exit(mname)
+        for proc in procs_by_name.values():
+            if proc.poll() is None:
+                proc.kill()   # by PID via Popen handle — never pkill
         if workdir is None:
             shutil.rmtree(tmp, ignore_errors=True)
 

@@ -75,6 +75,7 @@ def build_app(
     annotations=None,                 # Optional[AnnotationQueue]
     portal_dir: Optional[str] = None,
     fleet=None,                       # Optional[obs.FleetAggregator]
+    supervisor=None,                  # Optional[serve.FleetSupervisor]
 ) -> web.Application:
     app = web.Application(middlewares=[_cors], client_max_size=8 << 20)
 
@@ -172,6 +173,13 @@ def build_app(
                     did: dataclasses.asdict(st)
                     for did, st in engine.stats().items()
                 },
+                # r19: prewarm progress. REST binds before the engine
+                # compiles (serve/server.py boot order), so a fleet
+                # scrape during the ramp reads complete=False — the
+                # aggregator's "warming" member state.
+                "prewarm": (engine.prewarm_status()
+                            if hasattr(engine, "prewarm_status")
+                            else None),
             }
         if annotations is not None:
             out["annotation_queue"] = {
@@ -590,6 +598,19 @@ def build_app(
     app.router.add_post("/api/v1/router/detach", router_detach)
     app.router.add_get("/api/v1/router", router_state)
 
+    async def supervisor_state(_request: web.Request) -> web.Response:
+        """Autoscaling supervisor snapshot (r19, serve/supervisor.py):
+        member set + bounds, the merged scale signals, the last
+        decision and the lifecycle event history. 400 when no
+        supervisor runs in this process (supervisor config, same
+        kill-switch convention as /api/v1/capacity)."""
+        if supervisor is None:
+            return _error(400, "supervisor disabled (supervisor config)")
+        return web.json_response(
+            await asyncio.to_thread(supervisor.snapshot))
+
+    app.router.add_get("/api/v1/supervisor", supervisor_state)
+
     async def options(_request: web.Request) -> web.Response:
         return web.Response(status=204)
 
@@ -613,9 +634,11 @@ class RestServer:
 
     def __init__(self, pm: ProcessManager, settings: SettingsManager,
                  host: str = "0.0.0.0", port: int = 8080,
-                 engine=None, annotations=None, fleet=None):
+                 engine=None, annotations=None, fleet=None,
+                 supervisor=None):
         self._app = build_app(pm, settings, engine=engine,
-                              annotations=annotations, fleet=fleet)
+                              annotations=annotations, fleet=fleet,
+                              supervisor=supervisor)
         self.engine = engine
         self.pm = pm
         self._host = host

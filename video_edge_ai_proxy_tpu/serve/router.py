@@ -56,7 +56,8 @@ Metric families (obs registry, lint-clean under ``lint_exposition``):
 - ``vep_router_streams`` — streams under management
 - ``vep_router_placements_total{member}`` — stream starts per member
 - ``vep_router_migrations_total{reason}`` — reason in
-  ``member_dead | shed_to_fleet | slo_burn | unhealthy | admin``
+  ``member_dead | shed_to_fleet | slo_burn | unhealthy | scale_in |
+  admin`` (``scale_in`` = supervisor retire drain, r19)
 - ``vep_router_migration_failures_total{reason}``
 - ``vep_router_replace_seconds`` — detection→resumed latency histogram
   (the kill-one-member acceptance number)
@@ -250,7 +251,11 @@ class MigrationLedger:
     gap-free run from the FIRST delivered packet (warmup ramp before
     first delivery is placement, not migration, and is excluded by
     construction) with no packet delivered twice — across however many
-    members served the stream.
+    members served the stream. There is deliberately no way to restart
+    the window: r16 soaks carried a post-warmup ``reset()`` because a
+    member compiling in-tick overwrote frames (latest-frame-wins), and
+    the r19 AOT prewarm cache removed that ramp — conservation holds
+    from the very first frame.
     """
 
     def __init__(self):
@@ -277,17 +282,6 @@ class MigrationLedger:
     def record_migration(self, entry: dict) -> None:
         with self._lock:
             self.migrations.append(dict(entry))
-
-    def reset(self) -> None:
-        """Drop recorded deliveries; the conservation window restarts at
-        the next delivery per stream. Soaks call this after warmup: a
-        stream's FIRST frame is only delivered after the compile it
-        triggers, so it anchors the baseline while the frames that
-        arrived DURING the compile were overwritten (latest-frame-wins)
-        and would read as losses. Post-reset steady state is lossless,
-        leaving any later gap attributable to a handoff."""
-        with self._lock:
-            self._seen.clear()
 
     def next_cursor(self, stream: str) -> Optional[int]:
         """Next undelivered packet index (max delivered + 1) — the
@@ -383,10 +377,10 @@ class StreamRouter:
             members, scrape_interval_s=scrape_interval_s,
             ema_alpha=ema_alpha, healthy_above=healthy_above,
             unhealthy_below=unhealthy_below)
-        factory = client_factory or (
+        self._client_factory = client_factory or (
             lambda n, url: MemberClient(n, url, clock=clock))
         self.clients: Dict[str, MemberClient] = {
-            m.name: factory(m.name, m.base_url)
+            m.name: self._client_factory(m.name, m.base_url)
             for m in self.fleet._members}
         self.ring = HashRing(base_vnodes=base_vnodes)
         self.ledger = MigrationLedger()
@@ -448,6 +442,60 @@ class StreamRouter:
             except Exception:  # noqa: BLE001
                 pass
 
+    # -- membership (r19 supervisor hooks) ---------------------------------
+
+    def add_member(self, name: str, base_url: str) -> None:
+        """Register a freshly spawned member. It enters the placement
+        ring on a later pass, once its scrape reads healthy AND its
+        prewarm program set completed (the fleet's ``warming`` state
+        holds it out until then). shed_to_fleet is armed immediately —
+        like attach(), a 400 from an engine-less member is not fatal."""
+        with self._lock:
+            if name in self.clients:
+                raise ValueError(f"member {name!r} already registered")
+            self.fleet.add_member(f"{name}={base_url}")
+            self.clients[name] = self._client_factory(name, base_url)
+            self._m_members.set(len(self.clients))
+        try:
+            self.clients[name].attach_router(self.name, "")
+        except Exception:  # noqa: BLE001 — member may lack a ladder
+            pass
+
+    def remove_member(self, name: str) -> List[str]:
+        """Drain and deregister a member (the supervisor's scale-in
+        path). Every stream it still owns is migrated off gracefully
+        (``reason="scale_in"`` — the r16 drain→cutover→resume protocol,
+        so the conservation ledger stays balanced); only then does the
+        member leave the ring/fleet/client set. Returns the streams that
+        were moved. A migration failure leaves the stream on the member
+        and aborts the removal (the next supervisor pass retries) rather
+        than orphaning a stream record whose client is gone."""
+        with self._lock:
+            if name not in self.clients:
+                return []
+            # Out of the ring first: no NEW placements land on a member
+            # being drained (migrations exclude the source on their own).
+            if name in self.ring.members:
+                self.ring.remove(name)
+                self._m_ring.set(len(self.ring.members))
+        moved: List[str] = []
+        for stream in self.streams_on(name):
+            if self.migrate(stream, reason="scale_in", graceful=True) is None:
+                raise RuntimeError(
+                    f"scale_in drain of {stream!r} off {name!r} failed; "
+                    "member left registered for retry")
+            moved.append(stream)
+        try:
+            self.clients[name].detach_router()
+        except Exception:  # noqa: BLE001 — member may already be gone
+            pass
+        with self._lock:
+            self.fleet.remove_member(name)
+            self.clients.pop(name, None)
+            self._evacuated.pop(name, None)
+            self._m_members.set(len(self.clients))
+        return moved
+
     def start(self) -> None:
         if self._thread is not None:
             return
@@ -481,9 +529,16 @@ class StreamRouter:
             current = set(self.ring.members)
             for row in health:
                 member = row["instance"]
+                client = self.clients.get(member)
+                if client is None:
+                    continue   # add/remove_member race; next pass settles
                 ok = (row["up"] and not row["stale"]
+                      # r19: a warming member (spawned, prewarm program
+                      # set incomplete) is alive and scoring but takes
+                      # no placements until its compiles land.
+                      and not row.get("warming")
                       and row.get("healthy", True) is not False
-                      and self.clients[member].breaker.state != "open")
+                      and client.breaker.state != "open")
                 if ok and self.min_healthy_age_s > 0.0:
                     age = row.get("healthy_since_s")
                     if age is not None and age < self.min_healthy_age_s \

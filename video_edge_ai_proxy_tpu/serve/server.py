@@ -264,6 +264,17 @@ class Server:
                     engine_cfg,
                     compile_cache_dir=os.path.join(data_dir, "compile_cache"),
                 )
+            if engine_cfg.aot_cache and engine_cfg.aot_cache_dir in ("",
+                                                                    "auto"):
+                # Like compile_cache_dir "auto": the AOT prewarm cache
+                # (manifest + XLA payload) persists under the data dir —
+                # members sharing the volume share the program set.
+                import dataclasses
+
+                engine_cfg = dataclasses.replace(
+                    engine_cfg,
+                    aot_cache_dir=os.path.join(data_dir, "aot_cache"),
+                )
             if engine_cfg.prof and not engine_cfg.prof_dir:
                 # Capture bundles persist under the data dir (like the
                 # registry and spool) instead of the runner's tempdir
@@ -318,15 +329,20 @@ class Server:
         self.annotations.start()
         if self._cascade_archiver is not None:
             self._cascade_archiver.start()
-        if self.engine is not None:
-            self.engine.start()
-
+        # REST binds BEFORE the engine prewarms (r19): a spawning member
+        # is scrape-able during its compile ramp, so the fleet tier
+        # reads it as "warming" (prewarm incomplete in /api/v1/stats)
+        # instead of dead, and the router holds placements until the
+        # program set landed. Handlers tolerate the not-yet-started
+        # engine (stats empty, prewarm incomplete).
         self._rest = RestServer(
             self.process_manager, self.settings, port=self._rest_port,
             engine=self.engine, annotations=self.annotations,
             fleet=self.fleet,
         )
         self._rest.start()
+        if self.engine is not None:
+            self.engine.start()
         if self.fleet is not None:
             self.fleet.start()
             log.info(

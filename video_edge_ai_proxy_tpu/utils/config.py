@@ -342,6 +342,22 @@ class EngineConfig:
     # this; burning when BOTH windows exceed 1.0 (SRE multi-window).
     capacity_util_objective: float = 0.8
     capacity_eval_interval_s: float = 1.0    # forecast refresh throttle
+    # Persistent AOT prewarm cache (r19, engine/aot_cache.py).
+    # compile_cache_dir above makes a RESTART cheap; this makes a fresh
+    # SPAWN cheap: the cache dir carries a versioned prewarm manifest
+    # recording every (model, stem, geometry, bucket) serving step this
+    # member (or any sibling sharing the dir) ever compiled, and a
+    # booting engine replays the whole set before taking traffic — each
+    # a persistent-cache hit, so spawn→first-served-frame fits inside
+    # one router scrape interval (ROADMAP item 4). aot_cache=False
+    # (default) is the kill switch: no manifest read/write, no extra
+    # compile-cache wiring, serving bit-identical (test-pinned).
+    aot_cache: bool = False
+    # "" with aot_cache=True -> the server resolves <data_dir>/aot_cache
+    # (shared across members via a common data volume); also becomes the
+    # XLA persistent cache dir for this member (overrides
+    # compile_cache_dir so manifest and payload travel together).
+    aot_cache_dir: str = ""
 
 
 @dataclass
@@ -391,6 +407,36 @@ class RouterConfig:
 
 
 @dataclass
+class SupervisorConfig:
+    """Autoscaling supervisor (r19, serve/supervisor.py): closes the
+    loop from the r18 capacity forecast to member lifecycle. Watches the
+    router's merged fleet health, spawns a member when the fleet-wide
+    ``time_to_saturation_s`` forecast crosses the horizon, retires the
+    emptiest member (drained through the r16 lineage-verified migration)
+    after a sustained headroom surplus, and holds min/max bounds with
+    cooldown hysteresis so a connect/disconnect storm cannot flap the
+    fleet. enabled=False (default) is the kill switch: no decision
+    thread, /api/v1/supervisor answers 400 (r9 convention)."""
+
+    enabled: bool = False
+    min_members: int = 1
+    max_members: int = 4
+    decision_interval_s: float = 2.0  # forecast poll + decision cadence
+    # Scale out when the merged fleet forecast says saturation lands
+    # within this many seconds (the rung ABOVE shed_to_fleet: shedding
+    # moves load across members, this adds a member).
+    spawn_horizon_s: float = 120.0
+    # Scale in only after min(headroom) across members has stayed above
+    # surplus_headroom for surplus_hold_s straight (sustained surplus,
+    # not a lull between storm waves).
+    surplus_headroom: float = 0.6
+    surplus_hold_s: float = 30.0
+    spawn_cooldown_s: float = 10.0    # min gap between spawns
+    retire_cooldown_s: float = 30.0   # min gap between retires (and
+                                      # after any spawn — no flap)
+
+
+@dataclass
 class RunnerConfig:
     """Worker isolation runner (SURVEY.md §7.5 "subprocess first, Docker
     optional"). "subprocess": RLIMIT_AS + niceness containment (default).
@@ -430,6 +476,7 @@ class Config:
     engine: EngineConfig = field(default_factory=EngineConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
+    supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
 
 
 def _merge(dc: Any, data: dict[str, Any]) -> Any:
