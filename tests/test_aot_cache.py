@@ -148,6 +148,76 @@ class TestEnginePrewarm:
         finally:
             bus.close()
 
+    def test_aot_boot_reports_warming_until_start_computes_the_set(
+            self, tmp_path):
+        # REST binds before engine.start(): with the AOT cache on, the
+        # program set is unknown until start() unions the manifest in —
+        # a scrape during the (potentially long) warmup must read the
+        # member as warming even with cfg.prewarm empty (the harness's
+        # spawn path boots with no --prewarm flags), or the router
+        # places/migrates onto a mid-compile-ramp member.
+        from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+
+        d = str(tmp_path / "aot")
+        saved = _restore_jax_cache_config()
+        bus = MemoryFrameBus()
+        try:
+            eng = InferenceEngine(bus, EngineConfig(
+                model="tiny_mobilenet_v2", batch_buckets=(1,), tick_ms=5,
+                prefetch=False, aot_cache=True, aot_cache_dir=d))
+            status = eng.prewarm_status()
+            assert status["complete"] is False and status["aot_cache"]
+            eng.start()
+            try:
+                assert eng.prewarm_status()["complete"] is True
+            finally:
+                eng.stop()
+        finally:
+            bus.close()
+            _apply_jax_cache_config(saved)
+
+    def test_failing_program_never_recorded_in_manifest(self, tmp_path):
+        # The manifest records a program only after its first call
+        # compiled AND executed successfully — a (geometry, bucket,
+        # model) whose compile reliably fails must not be replayed (and
+        # re-fail) on every future spawn's boot.
+        from video_edge_ai_proxy_tpu.engine.runner import _TimedStep
+        from video_edge_ai_proxy_tpu.obs.perf import PerfTracker
+
+        d = str(tmp_path / "aot")
+
+        def record():
+            aot_cache.record_program(d, model="broken", stem="classic",
+                                     src_hw=(32, 32), bucket=1)
+
+        class BoomJit:
+            def lower(self, *a):
+                raise RuntimeError("no AOT lowering")
+
+            def __call__(self, *a):
+                raise RuntimeError("compile failed")
+
+        step = _TimedStep(BoomJit(), PerfTracker(), "broken", (32, 32), 1,
+                          on_first_success=record)
+        for _ in range(3):   # reliably failing: every retry re-raises
+            with pytest.raises(RuntimeError):
+                step(None)
+        assert aot_cache.load_manifest(d) is None
+
+        class OkJit:
+            def lower(self, *a):
+                raise RuntimeError("jit path")   # fall back to plain jit
+
+            def __call__(self, *a):
+                return 42
+
+        fired = []
+        ok = _TimedStep(OkJit(), PerfTracker(), "ok", (32, 32), 1,
+                        on_first_success=lambda: fired.append(1))
+        assert ok(None) == 42
+        assert ok(None) == 42
+        assert fired == [1]   # once, on the first success only
+
     def test_start_prewarms_manifest_programs(self, tmp_path):
         from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
 

@@ -398,6 +398,31 @@ class TestWarmingState:
 
 
 # ---------------------------------------------------------------------------
+# Runtime membership (r19 supervisor hooks)
+
+
+class TestRuntimeMembership:
+    def test_auto_names_are_monotonic_never_reused(self):
+        # add(m0,m1), remove(m0), add(bare) must yield a FRESH name —
+        # naming by list length would collide with m1 and raise.
+        agg = FleetAggregator(["http://a:1", "http://b:1"])
+        assert [m.name for m in agg._members] == ["m0", "m1"]
+        agg.remove_member("m0")
+        assert agg.add_member("http://c:1") == "m2"
+        assert agg.add_member("http://d:1") == "m3"
+
+    def test_auto_names_skip_operator_claimed_slots(self):
+        agg = FleetAggregator(["m1=http://a:1"])
+        assert agg.add_member("http://b:1") == "m2"
+        assert agg.add_member("http://c:1") == "m3"
+
+    def test_named_duplicates_still_raise(self):
+        agg = FleetAggregator(["m0=http://a:1"])
+        with pytest.raises(ValueError):
+            agg.add_member("m0=http://b:1")
+
+
+# ---------------------------------------------------------------------------
 # Feature-disabled notice (satellite 1)
 
 

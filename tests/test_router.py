@@ -116,6 +116,16 @@ class FakeFleet:
     def health(self):
         return [dict(self.rows[m.name]) for m in self._members]
 
+    def add_member(self, spec):
+        name, _, url = spec.partition("=")
+        self._members.append(types.SimpleNamespace(name=name, base_url=url))
+        self.rows[name] = _row(name)
+        return name
+
+    def remove_member(self, name):
+        self._members = [m for m in self._members if m.name != name]
+        self.rows.pop(name, None)
+
 
 def make_router(names=("m0", "m1", "m2"), **kw):
     clock = FakeClock()
@@ -814,6 +824,90 @@ class TestMemberSurface:
             assert out["fleet_attached"] and out["fleet"]["router"] == "r0"
         finally:
             server.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# scale-in drain (remove_member): the "no NEW placements on a draining
+# member" invariant must hold against the concurrent scrape loop
+
+
+def _stream_owned_by(router, member):
+    return next(f"cam{i}" for i in range(500)
+                if router.ring.place(f"cam{i}") == member)
+
+
+class TestScaleInDrain:
+    def test_refresh_ring_never_readds_a_draining_member(self):
+        # The drain runs over HTTP for seconds while the victim still
+        # scrapes healthy: a concurrent _refresh_ring must not re-add it
+        # (add_stream would then place NEW streams the one-shot drain
+        # snapshot misses, and clients.pop would orphan their records).
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        assert "m1" in router.ring.members
+        router._draining.add("m1")
+        router._refresh_ring(fleet.health())
+        assert "m1" not in router.ring.members
+        router._draining.discard("m1")
+        router._refresh_ring(fleet.health())
+        assert "m1" in router.ring.members
+
+    def test_remove_member_drains_through_a_concurrent_scrape(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        name = _stream_owned_by(router, "m1")
+        router.add_stream(name, "rtsp://cam/live")
+        members["m1"].drain_script(name, [3, 3])
+        real_migrate = router.migrate
+        ring_saw_victim = []
+
+        def migrate_with_scrape(stream, **kw):
+            # The scrape loop fires mid-drain: the victim is still in
+            # fleet/clients and reads healthy, but must stay ringless.
+            router._refresh_ring(fleet.health())
+            ring_saw_victim.append("m1" in router.ring.members)
+            return real_migrate(stream, **kw)
+
+        router.migrate = migrate_with_scrape
+        moved = router.remove_member("m1")
+        assert moved == [name]
+        assert ring_saw_victim and not any(ring_saw_victim)
+        assert "m1" not in router.clients
+        assert "m1" not in router._draining
+        assert router._streams[name]["member"] != "m1"
+
+    def test_drain_abort_clears_flag_and_member_serves_again(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        name = _stream_owned_by(router, "m1")
+        router.add_stream(name, "rtsp://cam/live")
+        members["m1"].drain_script(name, [3, 3])
+        # Every migration destination refuses: the drain must abort,
+        # leave the stream registered on the member, and clear the
+        # draining flag so the member is not ring-banned forever
+        # (the supervisor's retire_failed retry path).
+        members["m0"].fail = True
+        members["m2"].fail = True
+        with pytest.raises(RuntimeError):
+            router.remove_member("m1")
+        assert "m1" in router.clients
+        assert "m1" not in router._draining
+        assert router._streams[name]["member"] == "m1"
+        router._refresh_ring(fleet.health())
+        assert "m1" in router.ring.members
+
+    def test_migrate_never_targets_a_draining_member(self):
+        router, fleet, members, clock = make_router(names=("m0", "m1"))
+        router.run_pass()
+        name = _stream_owned_by(router, "m0")
+        router.add_stream(name, "rtsp://cam/live")
+        members["m0"].drain_script(name, [3, 3])
+        # m1 is mid-drain but (ring-refresh lag) still in the ring:
+        # migrating onto it must fail closed, not land a stream on a
+        # member about to leave the fleet.
+        router._draining.add("m1")
+        assert router.migrate(name, reason="admin") is None
+        assert router._streams[name]["member"] == "m0"
 
 
 # ---------------------------------------------------------------------------

@@ -760,6 +760,48 @@ class TestEndToEnd:
         channel.close()
 
 
+def test_supervisor_config_wires_decision_loop_and_endpoint(
+        tmp_path, shm_dir):
+    """supervisor.enabled=true in a config file must actually run the
+    decision loop (advisory — no spawner is configurable from YAML) and
+    answer /api/v1/supervisor, not silently do nothing (r19 review)."""
+    import urllib.request
+
+    srv = _boot_server(
+        tmp_path, shm_dir,
+        supervisor__enabled=True,
+        # Port 1 refuses instantly: a dead member is fine — the router
+        # scrapes it down; the supervisor holds at min_members.
+        router__members=("m0=http://127.0.0.1:1",),
+    )
+    try:
+        assert srv.supervisor is not None and srv.router is not None
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv._rest.bound_port}/api/v1/supervisor",
+            timeout=5).read())
+        assert body["acting"] is False
+        assert body["bounds"] == {"min": 1, "max": 4}
+        assert "m0" in body["members"]
+    finally:
+        srv.stop()
+
+
+def test_supervisor_enabled_without_members_stays_off(tmp_path, shm_dir):
+    srv = _boot_server(tmp_path, shm_dir, supervisor__enabled=True)
+    try:
+        assert srv.supervisor is None
+        import urllib.error
+        import urllib.request
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv._rest.bound_port}"
+                "/api/v1/supervisor", timeout=5)
+        assert err.value.code == 400
+    finally:
+        srv.stop()
+
+
 @pytest.fixture()
 def engine_server(tmp_path, shm_dir):
     """Full stack WITH the TPU engine: the flagship serving path."""

@@ -339,18 +339,31 @@ class _TimedStep:
     keep working: ``_step`` still returns a plain callable.
     """
 
-    __slots__ = ("_jit", "_aot", "_perf", "_model", "_src_hw", "_bucket")
+    __slots__ = ("_jit", "_aot", "_perf", "_model", "_src_hw", "_bucket",
+                 "_on_success")
 
     def __init__(self, jit_fn, perf: PerfTracker, model: str,
-                 src_hw: tuple, bucket: int):
+                 src_hw: tuple, bucket: int, on_first_success=None):
         self._jit = jit_fn
         self._aot = None          # None = not compiled; False = jit path
         self._perf = perf
         self._model = model
         self._src_hw = src_hw
         self._bucket = bucket
+        # Fired once, after the first call that compiled AND executed
+        # without raising — the AOT manifest record hook. Keyed on
+        # success so a program whose compile reliably fails is never
+        # recorded (and re-failed) on every future spawn's boot.
+        self._on_success = on_first_success
 
     def __call__(self, variables, *args):
+        out = self._invoke(variables, *args)
+        if self._on_success is not None:
+            cb, self._on_success = self._on_success, None
+            cb()
+        return out
+
+    def _invoke(self, variables, *args):
         if self._aot is None:
             t0 = time.perf_counter()
             try:
@@ -735,6 +748,12 @@ class InferenceEngine:
         )
         self._prewarm_required = len(self._cfg.prewarm)
         self._prewarm_done = 0
+        # With the AOT cache on, the true program set is unknown until
+        # start() unions the manifest in — and REST binds before start(),
+        # so a scrape during warmup must read "warming" even when
+        # cfg.prewarm is empty (the harness's spawn path boots with no
+        # --prewarm flags). Without the cache the config list IS the set.
+        self._prewarm_started = not self._aot_dir
         self._collector: Optional[Collector] = None
         self._subscribers: List[tuple] = []   # (queue, device_id filter set|None)
         self._sub_lock = threading.Lock()
@@ -1500,6 +1519,7 @@ class InferenceEngine:
         # as done — log-and-continue must not wedge a member in warming.
         self._prewarm_required = len(entries)
         self._prewarm_done = 0
+        self._prewarm_started = True   # the entry list is now final
         for geom in entries:
             # Log-and-continue like every other per-item path here: a bad
             # prewarm entry must not abort server boot, and buckets must be
@@ -1748,13 +1768,17 @@ class InferenceEngine:
         derives the "warming" member state from ``complete`` — a
         spawned member is scraped-alive the moment REST binds but must
         not take migrated traffic until its program set compiled. A
-        member with nothing to prewarm is complete from boot."""
+        member with nothing to prewarm is complete from boot — UNLESS
+        the AOT cache is on: then the program set is the manifest union
+        computed inside start(), after the (potentially long) warmup, so
+        "complete" holds False until that list exists (or 0>=0 during
+        warmup would let the router place onto a mid-ramp member)."""
         required = self._prewarm_required
         done = self._prewarm_done
         return {
             "required": required,
             "done": done,
-            "complete": done >= required,
+            "complete": self._prewarm_started and done >= required,
             "aot_cache": bool(self._aot_dir),
         }
 
@@ -1966,21 +1990,28 @@ class InferenceEngine:
             # on first call, recording wall time + XLA cost analysis per
             # (model, geometry, bucket) — this is the only cache-miss
             # site, so every compile in the process is accounted.
-            fn = _TimedStep(jax.jit(raw, donate_argnums=donate),
-                            self.perf, model, src_hw, bucket)
-            self._step_cache[key] = fn
+            record = None
             if self._aot_dir:
-                # Every serving step registered here lands in the prewarm
-                # manifest (this is the only miss site, so the recorded
-                # set IS the program set a member must hold) — the next
-                # spawn replays it straight out of the persistent cache.
+                # Every serving step lands in the prewarm manifest (this
+                # is the only miss site, so the recorded set IS the
+                # program set a member must hold) — but only once its
+                # FIRST call compiles and executes successfully, or a
+                # reliably-failing (geometry, bucket, model) would be
+                # replayed (and re-fail) on every future spawn's boot.
+                # record_program is internally best-effort (never raises).
                 from . import aot_cache
 
-                aot_cache.record_program(
-                    self._aot_dir, model=model,
-                    stem=getattr(self._cfg, "stem", "classic"),
-                    src_hw=src_hw, bucket=bucket,
-                )
+                def record(_dir=self._aot_dir, _model=model,
+                           _stem=getattr(self._cfg, "stem", "classic"),
+                           _hw=src_hw, _bucket=bucket):
+                    aot_cache.record_program(
+                        _dir, model=_model, stem=_stem,
+                        src_hw=_hw, bucket=_bucket)
+
+            fn = _TimedStep(jax.jit(raw, donate_argnums=donate),
+                            self.perf, model, src_hw, bucket,
+                            on_first_success=record)
+            self._step_cache[key] = fn
         return fn
 
     # -- engine loop --

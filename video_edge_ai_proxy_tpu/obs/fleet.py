@@ -248,6 +248,10 @@ class FleetAggregator:
             if not sep:
                 name, url = f"m{i}", str(spec)
             self._members.append(MemberState(name, url))
+        # Auto-name sequence for bare-URL add_member specs. Monotonic —
+        # a removal never frees its name for reuse, so add(m0,m1),
+        # remove(m0), add(bare) yields m2, not a duplicate-m1 ValueError.
+        self._auto_seq = len(self._members)
         self.scrape_interval_s = float(scrape_interval_s)
         self.stale_after_s = (float(stale_after_s) if stale_after_s
                               else self.scrape_interval_s)
@@ -294,12 +298,19 @@ class FleetAggregator:
 
     def add_member(self, spec: str) -> str:
         """Register one member at runtime (``"name=url"`` or a bare URL,
-        auto-named ``m<len>``); the next scrape pass picks it up.
+        auto-named from a monotonic ``m<N>`` sequence — never reusing a
+        removed member's name); the next scrape pass picks it up.
         Returns the member name; duplicates raise."""
         name, sep, url = str(spec).partition("=")
         with self._lock:
             if not sep:
-                name, url = f"m{len(self._members)}", str(spec)
+                url = str(spec)
+                # Skip operator-claimed m<N> names too, not just our own.
+                while any(m.name == f"m{self._auto_seq}"
+                          for m in self._members):
+                    self._auto_seq += 1
+                name = f"m{self._auto_seq}"
+                self._auto_seq += 1
             if any(m.name == name for m in self._members):
                 raise ValueError(f"member {name!r} already registered")
             self._members.append(MemberState(name, url))

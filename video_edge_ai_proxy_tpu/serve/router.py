@@ -390,6 +390,13 @@ class StreamRouter:
         #            migrations}
         self._streams: Dict[str, dict] = {}
         self._evacuated: Dict[str, float] = {}   # member -> detect time
+        # Members mid-drain (remove_member): excluded from the ring, from
+        # _refresh_ring re-adds, and from migration targets until the
+        # drain completes (member gone) or aborts (flag cleared, member
+        # serves again). The drain itself runs outside the lock — HTTP
+        # migrations take seconds — so the flag is what holds the "no NEW
+        # placements on a draining member" invariant across passes.
+        self._draining: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.passes = 0
@@ -473,18 +480,38 @@ class StreamRouter:
         with self._lock:
             if name not in self.clients:
                 return []
-            # Out of the ring first: no NEW placements land on a member
-            # being drained (migrations exclude the source on their own).
+            # Drain flag BEFORE the ring removal: the member stays in
+            # fleet/clients (and scrapes ok) for the seconds the HTTP
+            # migrations below take, so without the flag a concurrent
+            # _refresh_ring would re-add it and add_stream could place
+            # NEW streams on it — placements the one-shot snapshot
+            # below would miss and clients.pop would orphan.
+            self._draining.add(name)
             if name in self.ring.members:
                 self.ring.remove(name)
                 self._m_ring.set(len(self.ring.members))
         moved: List[str] = []
-        for stream in self.streams_on(name):
-            if self.migrate(stream, reason="scale_in", graceful=True) is None:
-                raise RuntimeError(
-                    f"scale_in drain of {stream!r} off {name!r} failed; "
-                    "member left registered for retry")
-            moved.append(stream)
+        try:
+            # Re-snapshot until empty: a migration already in flight when
+            # the flag went up may still land a stream on the victim.
+            while True:
+                pending = [s for s in self.streams_on(name)
+                           if s not in moved]
+                if not pending:
+                    break
+                for stream in pending:
+                    if self.migrate(stream, reason="scale_in",
+                                    graceful=True) is None:
+                        raise RuntimeError(
+                            f"scale_in drain of {stream!r} off {name!r} "
+                            "failed; member left registered for retry")
+                    moved.append(stream)
+        except BaseException:
+            # Abort: the member keeps serving (retire_failed retry path)
+            # — clear the flag or it would be ring-banned forever.
+            with self._lock:
+                self._draining.discard(name)
+            raise
         try:
             self.clients[name].detach_router()
         except Exception:  # noqa: BLE001 — member may already be gone
@@ -492,6 +519,7 @@ class StreamRouter:
         with self._lock:
             self.fleet.remove_member(name)
             self.clients.pop(name, None)
+            self._draining.discard(name)
             self._evacuated.pop(name, None)
             self._m_members.set(len(self.clients))
         return moved
@@ -535,8 +563,11 @@ class StreamRouter:
                 ok = (row["up"] and not row["stale"]
                       # r19: a warming member (spawned, prewarm program
                       # set incomplete) is alive and scoring but takes
-                      # no placements until its compiles land.
+                      # no placements until its compiles land. A member
+                      # mid-drain (remove_member) scrapes ok too and
+                      # must equally stay out.
                       and not row.get("warming")
+                      and member not in self._draining
                       and row.get("healthy", True) is not False
                       and client.breaker.state != "open")
                 if ok and self.min_healthy_age_s > 0.0:
@@ -736,6 +767,9 @@ class StreamRouter:
             src = rec["member"]
             if dst is None:
                 dst = self.ring.place(stream, exclude=(src,))
+            if dst is not None and dst in self._draining:
+                # Ring refresh lag: never migrate ONTO a draining member.
+                dst = None
         if dst is None or dst == src:
             self._m_mig_fail.labels(reason).inc()
             log.warning("no migration target for %s (src=%s)", stream, src)

@@ -156,6 +156,49 @@ class Server:
                 scrape_interval_s=self.cfg.obs.fleet_scrape_s,
                 stale_after_s=self.cfg.obs.fleet_stale_s or None,
             )
+        # Autoscaling supervisor (r19): supervisor.enabled=true in a
+        # config file runs the decision loop IN this process, advisory —
+        # no spawner is injectable from YAML, so decisions surface in
+        # /api/v1/supervisor + vep_supervisor_* for the deployment
+        # system to act on (acting mode lives in the autoscale harness,
+        # which owns the member processes). Needs router.members: the
+        # supervisor only ever acts through a StreamRouter.
+        self.router = None
+        self.supervisor = None
+        if self.cfg.supervisor.enabled:
+            if not self.cfg.router.members:
+                log.warning(
+                    "supervisor.enabled with no router.members — nothing "
+                    "to supervise; supervisor stays off"
+                )
+            else:
+                from .router import StreamRouter
+                from .supervisor import FleetSupervisor
+
+                rc = self.cfg.router
+                sup = self.cfg.supervisor
+                self.router = StreamRouter(
+                    rc.members,
+                    scrape_interval_s=rc.scrape_interval_s,
+                    base_vnodes=rc.vnodes,
+                    max_moves_per_pass=rc.max_moves_per_pass,
+                    min_healthy_age_s=rc.min_healthy_age_s,
+                    drain_timeout_s=rc.drain_timeout_s,
+                    ema_alpha=rc.ema_alpha,
+                    healthy_above=rc.healthy_above,
+                    unhealthy_below=rc.unhealthy_below,
+                )
+                self.supervisor = FleetSupervisor(
+                    self.router,
+                    min_members=sup.min_members,
+                    max_members=sup.max_members,
+                    decision_interval_s=sup.decision_interval_s,
+                    spawn_horizon_s=sup.spawn_horizon_s,
+                    surplus_headroom=sup.surplus_headroom,
+                    surplus_hold_s=sup.surplus_hold_s,
+                    spawn_cooldown_s=sup.spawn_cooldown_s,
+                    retire_cooldown_s=sup.retire_cooldown_s,
+                )
         self.storage = Storage(os.path.join(data_dir, "registry.db"))
         self.bus = open_bus(
             bus_backend or self.cfg.bus.backend, self.cfg.bus.shm_dir,
@@ -338,7 +381,7 @@ class Server:
         self._rest = RestServer(
             self.process_manager, self.settings, port=self._rest_port,
             engine=self.engine, annotations=self.annotations,
-            fleet=self.fleet,
+            fleet=self.fleet, supervisor=self.supervisor,
         )
         self._rest.start()
         if self.engine is not None:
@@ -349,6 +392,20 @@ class Server:
                 "fleet aggregator scraping %d members every %gs "
                 "(/api/v1/fleet/stats, /api/v1/fleet/metrics)",
                 len(self.cfg.obs.fleet_members), self.fleet.scrape_interval_s,
+            )
+        if self.router is not None:
+            # Arm shed_to_fleet on reachable members (per-member errors
+            # recorded, not fatal) and start the placement/decision loops.
+            self.router.attach()
+            self.router.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
+            log.info(
+                "fleet supervisor (advisory) over %d members: bounds "
+                "[%d, %d], decision every %gs (/api/v1/supervisor)",
+                len(self.router.clients), self.supervisor.min_members,
+                self.supervisor.max_members,
+                self.supervisor.decision_interval_s,
             )
 
         servicer = ImageServicer(
@@ -393,6 +450,11 @@ class Server:
 
     def stop(self) -> None:
         log.info("shutting down")
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        if self.router is not None:
+            self.router.stop()
+            self.router.detach()
         if self.fleet is not None:
             self.fleet.stop()
         if self._grpc_server is not None:

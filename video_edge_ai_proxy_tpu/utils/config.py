@@ -415,8 +415,13 @@ class SupervisorConfig:
     emptiest member (drained through the r16 lineage-verified migration)
     after a sustained headroom surplus, and holds min/max bounds with
     cooldown hysteresis so a connect/disconnect storm cannot flap the
-    fleet. enabled=False (default) is the kill switch: no decision
-    thread, /api/v1/supervisor answers 400 (r9 convention)."""
+    fleet. enabled=True in server mode (serve/server.py) runs the loop
+    in-process over ``router.members`` — advisory (no spawner is
+    configurable from YAML; decisions surface in /api/v1/supervisor and
+    the vep_supervisor_* families for the deployment system to act on;
+    the acting mode lives in the autoscale harness). enabled=False
+    (default) is the kill switch: no decision thread,
+    /api/v1/supervisor answers 400 (r9 convention)."""
 
     enabled: bool = False
     min_members: int = 1
