@@ -4,7 +4,7 @@
 .PHONY: all proto native install test bench graft clean redis-conformance \
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
 	roi-smoke fleet-obs-smoke stem-smoke router-smoke cascade-smoke \
-	capacity-smoke autoscale-smoke
+	capacity-smoke autoscale-smoke multichip-serve-smoke
 
 all: proto native
 
@@ -242,6 +242,28 @@ cascade-smoke:
 			% (d['cascade_every_n'], d['cascade_event_latency_ticks'], \
 			   d['gates']['max_event_latency_ticks'], d['uplink_enter_requests'], \
 			   d['uplink_exit_requests'], d['slot_high_water']))"
+
+# Mesh-native serving acceptance (round 17): lockstep replay fleet on
+# dp=1/2/4 CPU meshes (8 virtual devices). Gates (in
+# tools/multichip_serve_smoke.py, exit non-zero on breach): dp=1 mesh
+# replay checksum bit-identical to the single-chip path (plus a
+# subprocess anchor of the committed 1-device golden — the
+# host-device-count flag changes XLA CPU codegen numerics, see the tool
+# docstring), ZERO misrouted and ZERO unrouted ROI scatter-backs on
+# every leg, per-shard capacity conservation drift exactly 0.0, cascade
+# live on-mesh, vep_*_shard exposition lint-clean, and aggregate fps at
+# dp=4 >= 3.2x dp=1. The committed MULTICHIP_SERVE_r01.json artifact is
+# a pinned run of this tool. ~2 min.
+multichip-serve-smoke:
+	python tools/multichip_serve_smoke.py | tee /tmp/vep_multichip_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_multichip_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); s=d['serve']; \
+		print('multichip serving: dp1 %.0f / dp2 %.0f / dp4 %.0f fps (scale %.2fx), lockstep bit_identical=%s, misrouted=%d unrouted=%d' \
+			% (s['dp1']['fps'], s['dp2']['fps'], s['dp4']['fps'], \
+			   d['fps_scale_dp4_over_dp1'], d['lockstep']['bit_identical'], \
+			   sum(l['misrouted'] for l in s.values()), \
+			   sum(l['unrouted'] for l in s.values())))"
 
 roi-smoke:
 	python tools/roi_smoke.py | tee /tmp/vep_roi_smoke.json

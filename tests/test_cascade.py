@@ -336,15 +336,43 @@ class TestCascadeEngine:
         finally:
             bus.close()
 
-    def test_mesh_serving_disables_cascade(self):
-        from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+    def test_mesh_cascade_runs_sharded_state_and_head(self):
+        """r17: engine.mesh no longer disables the cascade — warmup
+        wires configure_mesh(), the scheduler resolves a
+        ShardedTrackStatePool (cam0 -> shard 0, cam4 -> shard 1 under
+        crc32 stream pinning), and the temporal head dispatches on the
+        dp mesh with clip state resident per shard."""
+        from video_edge_ai_proxy_tpu.temporal.state_pool import (
+            ShardedTrackStatePool,
+        )
 
         bus = MemoryFrameBus()
         try:
-            eng = InferenceEngine(
-                bus, EngineConfig(model="tiny_blob_gauge", cascade=True,
-                                  mesh="dp=8"))
-            assert eng._cascade is None
+            for did in ("cam0", "cam4"):
+                bus.create_stream(did, 64 * 64 * 3)
+            eng = _cascade_engine(bus, mesh={"dp": 2})
+            sched = eng._cascade
+            assert sched is not None       # the r16 auto-disable is gone
+            sub = _subscribe(eng)
+            for f in range(12):
+                delta = 15 if f % 2 == 0 else -15
+                bus.publish("cam0", _blob_frame(delta, key=1), _meta())
+                bus.publish("cam4", _blob_frame(delta, key=2), _meta())
+                _tick(eng, sub)
+
+            pool = sched._pool
+            assert isinstance(pool, ShardedTrackStatePool)
+            assert pool.shards == 2
+            # Stream pinning: every cam0 track key lives in sub-pool 0,
+            # every cam4 key in sub-pool 1 — clips never migrate.
+            keys0, keys1 = list(pool.pools[0]), list(pool.pools[1])
+            assert keys0 and all(k.startswith("cam0#") for k in keys0)
+            assert keys1 and all(k.startswith("cam4#") for k in keys1)
+
+            snap = sched.snapshot()
+            assert snap["head_dispatches"] > 0
+            assert snap["slots_in_use"] >= 2   # one live track per stream
+            assert 0 < snap["slot_high_water"] <= 8
         finally:
             bus.close()
 

@@ -562,14 +562,105 @@ class TestRoiEngine:
         assert eng._roi is None
         assert eng._packer is None
 
-    def test_mesh_serving_disables_roi(self, bus):
-        """roi + mesh serving is explicitly unsupported: the sharded
-        dispatch path has no canvas plane; the engine must fall back to
-        classic serving instead of half-engaging the gate."""
-        cfg = EngineConfig(model="tiny_blob_gauge", roi=True,
-                           mesh="dp=8")
-        eng = InferenceEngine(bus, cfg)
-        assert eng._roi is None
+    def test_mesh_serving_roi_box_parity_vs_single_chip(self, bus):
+        """r17 tentpole leg 3: ROI stays ON under a dp=2 mesh (the old
+        auto-disable is gone) and the packed path emits the SAME exact
+        boxes the single-chip packed path produces — canvases pack per
+        mesh slice, so scatter-back routing is shard-local. cam0 lives
+        on shard 0 and cam4 on shard 1 (engine.collector.stream_shard
+        crc32 routing)."""
+        blobs = {"cam0": self.BLOB_A + (1,), "cam4": self.BLOB_B + (2,)}
+
+        def run(mesh):
+            b = MemoryFrameBus()
+            try:
+                for did in blobs:
+                    b.create_stream(did, 64 * 64 * 3)
+                eng = _roi_engine(b, **({"mesh": mesh} if mesh else {}))
+                if mesh is not None:
+                    assert eng._roi is not None     # no auto-disable
+                    assert eng._collector._shards == 2
+                sub = _subscribe(eng)
+                # Tick 1: full (primes trackers + cadence stamps).
+                for did, blob in blobs.items():
+                    self._publish_scene(b, did, [blob])
+                r1 = _tick(eng, sub)
+                assert sorted(r.device_id for r in r1) == ["cam0", "cam4"]
+                # Tick 2: both under motion -> crops pack per slice.
+                for did, blob in blobs.items():
+                    eng._roi.state(did)["diff"] = 1.0
+                    self._publish_scene(b, did, [blob])
+                r2 = {r.device_id: r for r in _tick(eng, sub)}
+                assert sorted(r2) == ["cam0", "cam4"]
+                snap = eng.perf.snapshot()
+                assert snap["roi"]["unrouted"] == 0
+                assert snap["roi"]["crops"] == 2
+                eng._drain_q.join()
+                return {
+                    did: [(_box_tuple(d), d.class_id)
+                          for d in r2[did].detections]
+                    for did in r2
+                }
+            finally:
+                b.close()
+
+        mesh = run({"dp": 2})
+        assert mesh["cam0"] == [(self.BLOB_A, 1)]
+        assert mesh["cam4"] == [(self.BLOB_B, 2)]
+        assert mesh == run(None)                    # single-chip parity
+
+    def test_mesh_roi_crop_blit_reads_global_rows(self, bus):
+        """Regression (r17): under the shard-segmented layout with
+        UNEQUAL shard occupancy, slot index != batch row — the crop
+        blit must read ``group.frames[group.rows[i]]``, not
+        ``frames[i]``. cam0 -> shard 0; cam4, cam5 -> shard 1, so the
+        batch is [cam0, pad, cam4, cam5] and cam4's slot (1) points at
+        shard 0's ZERO PAD row: blitting by slot cuts black pixels and
+        the exact-box assert below fails."""
+        scenes = {"cam0": self.BLOB_A + (1,), "cam4": self.BLOB_B + (2,),
+                  "cam5": (36, 12, 52, 28, 3)}
+        for did in scenes:
+            bus.create_stream(did, 64 * 64 * 3)
+        eng = _roi_engine(bus, mesh={"dp": 2})
+        sub = _subscribe(eng)
+        for did, blob in scenes.items():
+            self._publish_scene(bus, did, [blob])
+        r1 = _tick(eng, sub)
+        assert sorted(r.device_id for r in r1) == sorted(scenes)
+        for did, blob in scenes.items():
+            eng._roi.state(did)["diff"] = 1.0
+            self._publish_scene(bus, did, [blob])
+        # The collected group really is unequally occupied: bucket 4,
+        # rows [0, 2, 3] (shard 0 pads its second row).
+        groups = eng._collector.collect()
+        assert len(groups) == 1 and groups[0].bucket == 4
+        assert list(groups[0].rows) == [0, 2, 3]
+        groups = eng._roi_transform(groups)
+        eng._dispatch(groups, time.perf_counter())
+        while True:
+            try:
+                inflight = eng._drain_q.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                eng._emit(inflight)
+            finally:
+                eng._collector.release(inflight.group)
+                eng._drain_q.task_done()
+        r2 = {}
+        while True:
+            try:
+                r = sub.get_nowait()
+            except queue.Empty:
+                break
+            r2[r.device_id] = r
+        assert sorted(r2) == sorted(scenes)
+        for did, blob in scenes.items():
+            (det,) = r2[did].detections
+            assert _box_tuple(det) == blob[:4], did
+            assert det.class_id == blob[4], did
+        assert eng.perf.snapshot()["roi"]["unrouted"] == 0
+        eng._drain_q.join()
 
     def test_roi_on_full_path_bit_identical_checksum(self):
         """Detect-less scenes never gate (no tracks -> every verdict is

@@ -304,6 +304,43 @@ def stem_stage_info(baseline_dir: str):
     return None
 
 
+def multichip_serve_info(baseline_dir: str):
+    """Newest committed MULTICHIP_SERVE_r*.json's scaling row, or None.
+
+    Round 17 informational carry-through: perf-gate logs show the mesh
+    serving smoke's dp1/dp2/dp4 fps, the dp4/dp1 scale factor, and the
+    lockstep bit-identical verdict next to the fps verdict. NEVER gated
+    here — multichip_serve_smoke.py hard-gates its own run (min scale,
+    zero misroutes, conservation drift); this is trend visibility only.
+    """
+    paths = sorted(glob.glob(os.path.join(baseline_dir,
+                                          "MULTICHIP_SERVE_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(art, dict) or "serve" not in art:
+            continue
+        serve = art.get("serve") or {}
+        legs = {leg: (serve.get(leg) or {}).get("fps")
+                for leg in ("dp1", "dp2", "dp4")}
+        dp4 = serve.get("dp4") or {}
+        return {
+            "artifact": os.path.basename(path),
+            "fps": legs,
+            "scale_dp4_over_dp1": art.get("fps_scale_dp4_over_dp1"),
+            "bit_identical": (art.get("lockstep") or {}).get(
+                "bit_identical"),
+            "dp4_misrouted": dp4.get("misrouted"),
+            "dp4_unrouted": dp4.get("unrouted"),
+            "dp4_conservation_rel_drift": (dp4.get("conservation")
+                                           or {}).get("rel_drift"),
+        }
+    return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("input", nargs="?", default="-",
@@ -342,6 +379,9 @@ def main(argv=None) -> int:
     autoscale = autoscale_info(args.baseline_dir)
     if autoscale is not None:
         report["autoscale"] = autoscale      # informational, never gated
+    multichip = multichip_serve_info(args.baseline_dir)
+    if multichip is not None:
+        report["multichip_serve"] = multichip  # informational, never gated
     print(json.dumps(report, indent=2))
     return 0 if report["passed"] else 1
 

@@ -96,6 +96,26 @@ def configure(cache_dir: str) -> bool:
         return False
 
 
+def mesh_spec(mesh) -> List[list]:
+    """Canonical manifest form of a device mesh: sorted ``[axis, size]``
+    pairs for axes of size > 1; empty = single-chip. Accepts None, a
+    ``jax.sharding.Mesh`` (its ``.shape`` mapping), or an already-built
+    pair list — stdlib-only either way, so manifest readers stay
+    backend-free."""
+    if mesh is None:
+        return []
+    shape = getattr(mesh, "shape", None)
+    if shape is not None and hasattr(shape, "items"):
+        pairs = shape.items()
+    else:
+        pairs = mesh
+    return sorted([str(a), int(n)] for a, n in pairs if int(n) > 1)
+
+
+def _mesh_key(prog: Dict[str, Any]) -> tuple:
+    return tuple((a, n) for a, n in mesh_spec(prog.get("mesh")))
+
+
 def _program_key(prog: Dict[str, Any]) -> tuple:
     return (
         str(prog.get("model") or ""),
@@ -103,6 +123,10 @@ def _program_key(prog: Dict[str, Any]) -> tuple:
         int(prog.get("h", 0)),
         int(prog.get("w", 0)),
         int(prog.get("bucket", 0)),
+        # r17 mesh-native serving: sharded and single-chip compiles of
+        # the same geometry are distinct programs. Pre-r17 manifests
+        # simply lack the key (= single-chip), so they stay readable.
+        _mesh_key(prog),
     )
 
 
@@ -151,17 +175,29 @@ def load_manifest(cache_dir: str) -> Optional[List[Dict[str, Any]]]:
         if key in seen or key[4] <= 0:
             continue
         seen.add(key)
-        out.append({"model": key[0] or None, "stem": key[1],
-                    "h": key[2], "w": key[3], "bucket": key[4]})
+        entry = {"model": key[0] or None, "stem": key[1],
+                 "h": key[2], "w": key[3], "bucket": key[4]}
+        if key[5]:
+            entry["mesh"] = [[a, n] for a, n in key[5]]
+        out.append(entry)
     return out
 
 
-def prewarm_entries(programs: List[Dict[str, Any]]) -> List[list]:
+def prewarm_entries(programs: List[Dict[str, Any]],
+                    mesh=None) -> List[list]:
     """Manifest programs -> ``cfg.prewarm``-shaped 5-element entries
-    (``[h, w, bucket, model, stem]``; model "" = engine default)."""
+    (``[h, w, bucket, model, stem]``; model "" = engine default).
+
+    ``mesh`` filters to the programs recorded under that mesh spec (a
+    ``jax.sharding.Mesh``, a pair list, or None = single-chip): a
+    spawned mesh member replays sharded programs, a single-chip member
+    replays single-chip ones, and a stale manifest from the other world
+    yields no entries — clean compile, never a wrong-sharding replay."""
+    want = tuple((a, n) for a, n in mesh_spec(mesh))
     return [
         [p["h"], p["w"], p["bucket"], p["model"] or "", p["stem"]]
         for p in programs
+        if _mesh_key(p) == want
     ]
 
 
@@ -172,11 +208,15 @@ def record_program(
     stem: str,
     src_hw: tuple,
     bucket: int,
+    mesh=None,
 ) -> None:
     """Merge one compiled serving-step program into the manifest
     (read-modify-write under the process lock, atomic rename so a
     concurrently spawning member never reads a torn file). A stale or
-    mismatched manifest on disk is replaced, not merged into."""
+    mismatched manifest on disk is replaced, not merged into.
+    ``mesh`` (Mesh / pair list / None) stamps sharded programs; the
+    key is omitted entirely for single-chip so pre-r17 manifests and
+    new single-chip ones stay byte-compatible."""
     prog = {
         "model": model or None,
         "stem": stem or "classic",
@@ -184,6 +224,9 @@ def record_program(
         "w": int(src_hw[1]),
         "bucket": int(bucket),
     }
+    spec = mesh_spec(mesh)
+    if spec:
+        prog["mesh"] = spec
     with _manifest_lock:
         try:
             existing = load_manifest(cache_dir) or []

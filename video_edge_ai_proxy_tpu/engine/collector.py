@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
@@ -26,6 +27,19 @@ import numpy as np
 from ..bus.interface import Frame, FrameBus, FrameMeta
 from ..obs import registry as obs_registry, tracer
 from ..obs.spans import trace_id_of
+
+
+def stream_shard(device_id: str, shards: int) -> int:
+    """Stable stream -> mesh-shard assignment (dp-sharded serving).
+
+    crc32 is platform- and run-stable, so a stream always lands on the
+    same chip: its ROI tracker state, thumbnail slot and cascade clips
+    live in that shard's pools and never migrate mid-flight. The engine
+    and the collector must agree on this mapping — it is THE routing
+    function for mesh-native serving."""
+    if shards <= 1:
+        return 0
+    return zlib.crc32(device_id.encode("utf-8")) % shards
 
 
 @dataclass
@@ -51,6 +65,14 @@ class BatchGroup:
     # path — which is exactly what keeps roi=False bit-identical.
     crops: Optional[list] = None
     coast: Optional[list] = None
+    # Mesh-sharded layout (Collector(shards=S)): ``rows[j]`` is the frame
+    # row of ``device_ids[j]`` in the shard-segmented batch — shard s owns
+    # rows [s*bucket/S, (s+1)*bucket/S), each segment zero-padded
+    # independently so one ``dp``-sharded device_put gives every chip
+    # exactly its own streams' frames. None = dense identity layout (row
+    # j == device_ids[j]), the single-chip path, bit-identical to pre-
+    # shard behavior.
+    rows: Optional[List[int]] = None
 
     @property
     def padded_slots(self) -> int:
@@ -242,9 +264,29 @@ class Collector:
         default_model: str = "",
         interest_of: Optional[callable] = None,  # device_id -> bool
         strict_lease: bool = False,
+        shards: int = 1,
     ):
         self._bus = bus
         self._buckets = tuple(sorted(buckets))
+        # Mesh-sharded batch layout (engine.mesh, dp axis): every batch is
+        # segmented into ``shards`` equal row ranges, streams are routed to
+        # their stream_shard() segment, and each segment pads
+        # independently — the frames a dp-sharded device_put lands on chip
+        # s are exactly shard s's streams. Buckets must split evenly;
+        # non-divisible ones are dropped here (the engine pre-filters to
+        # the same set). shards=1 keeps every path bit-identical.
+        self._shards = max(1, int(shards))
+        if self._shards > 1:
+            sharded = tuple(b for b in self._buckets if b % self._shards == 0)
+            if not sharded:
+                import logging
+
+                logging.getLogger("vep.engine.collector").warning(
+                    "no bucket in %s divides into %d shards; serving "
+                    "unsharded", self._buckets, self._shards)
+                self._shards = 1
+            else:
+                self._buckets = sharded
         # Degradation-ladder bucket cap (resilience/ladder.py rung 2):
         # None = full bucket list; an int hides buckets above it so new
         # batches compile/run at the next-smaller device program.
@@ -546,6 +588,73 @@ class Collector:
         if dirty > n:
             buf[n:dirty] = 0
 
+    def _zero_pad_rows_sharded(self, buf: np.ndarray, shape: tuple, idx,
+                               real: set, bucket: int, touched: int) -> None:
+        """Shard-layout twin of _zero_pad_rows: padding is interleaved
+        (each shard's segment pads independently), so instead of one
+        contiguous tail the dirty rows are "every row in the dirty extent
+        not carrying a real frame". Restores the pool invariant rows >=
+        ``bucket`` are zero (fill[idx] == bucket) plus the sharded one:
+        interior pad rows inside the view are zero."""
+        touched = min(max(touched, bucket), buf.shape[0])
+        dirty = touched
+        if idx is not None:
+            with self._pool_lock:
+                slot = self._pool.get(shape)
+                if slot is None:             # defensive: shape evicted
+                    dirty = buf.shape[0]
+                else:
+                    fill = slot["fill"]
+                    dirty = max(fill.get(idx, 0), touched)
+                    fill[idx] = bucket
+        for r in range(dirty):
+            if r not in real:
+                buf[r] = 0
+
+    def _finish_sharded(self, buf: np.ndarray, shape: tuple, idx,
+                        per: List[list], seg_src: int, bucket: int,
+                        touched: int, *, src_hw: tuple,
+                        model: str) -> BatchGroup:
+        """Compact per-shard rows from allocation spacing (``seg_src``
+        rows per shard) down to the final bucket's spacing, zero the
+        dirty pad rows, and build the shard-segmented BatchGroup.
+        ``per[s]`` is shard s's (device_id, meta) list in read order.
+        Compaction is overlap-safe: with seg <= seg_src the destination
+        row s*seg+i never exceeds the source row s*seg_src+i, and
+        ascending (s, i) order means every source is read before any
+        later destination could land on it."""
+        seg = bucket // self._shards
+        ids: List[str] = []
+        metas: List[FrameMeta] = []
+        rows: List[int] = []
+        real: set = set()
+        for s, entries in enumerate(per):
+            for i, (device_id, meta) in enumerate(entries):
+                old = s * seg_src + i
+                new = s * seg + i
+                if new != old:
+                    buf[new] = buf[old]
+                ids.append(device_id)
+                metas.append(meta)
+                rows.append(new)
+                real.add(new)
+        self._zero_pad_rows_sharded(buf, shape, idx, real, bucket, touched)
+        group = BatchGroup(
+            src_hw=src_hw, device_ids=ids, frames=buf[:bucket],
+            metas=metas, bucket=bucket, model=model, rows=rows,
+        )
+        self._lease(group, shape, idx)
+        return group
+
+    def _by_shard(self, devs: Sequence) -> List[list]:
+        """Partition a stream list (or (device_id, ...) tuple list) into
+        per-shard lists, preserving order within each shard."""
+        out: List[list] = [[] for _ in range(self._shards)]
+        for item in devs:
+            did = item if isinstance(item, str) else item[0]
+            out[stream_shard(did, self._shards)].append(item)
+        return out
+
     # -- incremental batch assembly (between ticks) --
 
     def assemble_until(
@@ -608,7 +717,37 @@ class Collector:
                 fast_plan.setdefault((model, geom), []).append(device_id)
         groups: Dict[tuple, dict] = {}
         of: Dict[str, tuple] = {}
+        shard_of: Dict[str, int] = {}
         for (model, geom), devs in sorted(fast_plan.items()):
+            if self._shards > 1:
+                # Shard-segmented window groups: chunk capacity is per
+                # shard (a chunk fills when its fullest SHARD fills), and
+                # a stream's slot is pinned inside its shard's segment.
+                cap = max_bucket // self._shards
+                by_shard = self._by_shard(devs)
+                n_chunks = max(((len(l) + cap - 1) // cap
+                                for l in by_shard if l), default=0)
+                for ci in range(n_chunks):
+                    chunk = [l[ci * cap:(ci + 1) * cap] for l in by_shard]
+                    need = max(len(l) for l in chunk)
+                    alloc = next(b for b in buckets
+                                 if b // self._shards >= need)
+                    shape = (alloc,) + geom
+                    buf, bidx = self._pooled(shape)
+                    key = (model, geom, ci)
+                    groups[key] = {
+                        "model": model, "geom": geom, "shape": shape,
+                        "buf": buf, "idx": bidx,
+                        "per": [[] for _ in range(self._shards)],
+                        "entry": {}, "slot": {},
+                        "seg": alloc // self._shards,
+                        "hw": 0,   # attempt high-water
+                    }
+                    for s, shard_devs in enumerate(chunk):
+                        for device_id in shard_devs:
+                            of[device_id] = key
+                            shard_of[device_id] = s
+                continue
             for ci, start in enumerate(range(0, len(devs), max_bucket)):
                 chunk = devs[start:start + max_bucket]
                 alloc = next(b for b in buckets if b >= len(chunk))
@@ -623,7 +762,8 @@ class Collector:
                 }
                 for device_id in chunk:
                     of[device_id] = key
-        self._window = {"groups": groups, "of": of, "spill": []}
+        self._window = {"groups": groups, "of": of, "spill": [],
+                        "shard": shard_of}
 
     def assemble_step(self) -> int:
         """One pass over the planned streams: copy any newly published
@@ -646,7 +786,13 @@ class Collector:
                 continue   # idle ring: one cheap load, no read setup
             g = win["groups"][key]
             slot = g["slot"].get(device_id)
-            t = slot if slot is not None else len(g["ids"])
+            sharded = "per" in g
+            if sharded:
+                s = win["shard"][device_id]
+                t = slot if slot is not None \
+                    else g["seg"] * s + len(g["per"][s])
+            else:
+                t = slot if slot is not None else len(g["ids"])
             g["hw"] = max(g["hw"], t + 1)   # slot t may get partial bytes
             res = self._bus.read_latest_into(
                 device_id, g["buf"][t], min_seq=cursor,
@@ -662,7 +808,15 @@ class Collector:
                 continue
             seq, meta = res
             self._note_read(device_id, seq, meta)
-            if slot is None:
+            if sharded:
+                if slot is None:
+                    g["slot"][device_id] = t
+                    g["entry"][device_id] = (s, len(g["per"][s]))
+                    g["per"][s].append((device_id, meta))
+                else:
+                    es, ei = g["entry"][device_id]
+                    g["per"][es][ei] = (device_id, meta)
+            elif slot is None:
                 g["slot"][device_id] = len(g["ids"])
                 g["ids"].append(device_id)
                 g["metas"].append(meta)
@@ -707,6 +861,17 @@ class Collector:
             win_planned = set(win["of"])
             spill.extend(win["spill"])
             for key, g in sorted(win["groups"].items()):
+                if "per" in g:   # shard-segmented window group
+                    counts = [len(p) for p in g["per"]]
+                    if not any(counts):
+                        continue   # idle; buffer ages out via epochs
+                    bucket = next(b for b in self._buckets
+                                  if b // self._shards >= max(counts))
+                    groups.append(self._finish_sharded(
+                        g["buf"], g["shape"], g["idx"], g["per"],
+                        g["seg"], bucket, g["hw"],
+                        src_hw=g["geom"][:2], model=g["model"]))
+                    continue
                 n = len(g["ids"])
                 if n == 0:
                     continue   # idle group; its buffer ages out via epochs
@@ -738,6 +903,10 @@ class Collector:
                 fast_plan.setdefault((model, geom), []).append(device_id)
 
         for (model, geom), devs in sorted(fast_plan.items()):
+            if self._shards > 1:
+                self._collect_fast_sharded(
+                    model, geom, devs, buckets, groups, spill)
+                continue
             for start in range(0, len(devs), max_bucket):
                 chunk = devs[start:start + max_bucket]
                 alloc = next(b for b in buckets if b >= len(chunk))
@@ -823,6 +992,10 @@ class Collector:
             )
 
         for (model, hw), items in sorted(by_key.items()):
+            if self._shards > 1:
+                self._collect_generic_sharded(model, hw, items, buckets,
+                                              groups)
+                continue
             for start in range(0, len(items), max_bucket):
                 chunk = items[start:start + max_bucket]
                 n = len(chunk)
@@ -844,6 +1017,95 @@ class Collector:
                     model=model,
                 ))
         return groups
+
+    def _collect_fast_sharded(self, model: str, geom: tuple,
+                              devs: Sequence[str], buckets: tuple,
+                              groups: List[BatchGroup],
+                              spill: List[tuple]) -> None:
+        """Shard-segmented fast path: one (model, geometry) stream set ->
+        pooled, bucket-padded, shard-segmented batches. Streams read
+        directly into their shard's segment at allocation spacing; the
+        final bucket is the smallest whose PER-SHARD segment covers the
+        fullest shard, then _finish_sharded compacts the segments down."""
+        S = self._shards
+        max_bucket = buckets[-1]
+        cap = max_bucket // S        # per-shard chunk capacity
+        by_shard = self._by_shard(devs)
+        n_chunks = max(((len(l) + cap - 1) // cap for l in by_shard if l),
+                       default=0)
+        for c in range(n_chunks):
+            chunk = [l[c * cap:(c + 1) * cap] for l in by_shard]
+            need = max(len(l) for l in chunk)
+            alloc = next(b for b in buckets if b // S >= need)
+            shape = (alloc,) + geom
+            batch, bidx = self._pooled(shape)
+            seg_a = alloc // S
+            per: List[list] = [[] for _ in range(S)]
+            touched = 0   # attempt high-water (one past highest row hit)
+            for s, shard_devs in enumerate(chunk):
+                for device_id in shard_devs:
+                    t = s * seg_a + len(per[s])
+                    touched = max(touched, t + 1)
+                    res = self._bus.read_latest_into(
+                        device_id, batch[t],
+                        min_seq=self._cursors.get(device_id, 0),
+                    )
+                    if res is None and self._rebase_if_restarted(device_id):
+                        res = self._bus.read_latest_into(
+                            device_id, batch[t], min_seq=0,
+                        )
+                    if res is None:
+                        continue
+                    if isinstance(res, Frame):   # geometry drifted
+                        self._note_read(device_id, res.seq, res.meta)
+                        if res.data.ndim == 3:
+                            self._geom[device_id] = res.data.shape
+                        spill.append((device_id, model, res))
+                        continue
+                    seq, meta = res
+                    self._note_read(device_id, seq, meta)
+                    per[s].append((device_id, meta))
+            counts = [len(p) for p in per]
+            if not any(counts):
+                if bidx is not None:
+                    self._unrotate(shape)
+                continue
+            bucket = next(b for b in buckets if b // S >= max(counts))
+            groups.append(self._finish_sharded(
+                batch, shape, bidx, per, seg_a, bucket, touched,
+                src_hw=geom[:2], model=model))
+
+    def _collect_generic_sharded(self, model: str, hw: tuple,
+                                 items: Sequence[tuple], buckets: tuple,
+                                 groups: List[BatchGroup]) -> None:
+        """Shard-segmented generic path (first sight, clips, drift):
+        fresh zeroed buffer, samples written straight at final-bucket
+        spacing — no compaction needed, interior pads already zero."""
+        S = self._shards
+        cap = buckets[-1] // S
+        by_shard = self._by_shard(items)
+        n_chunks = max(((len(l) + cap - 1) // cap for l in by_shard if l),
+                       default=0)
+        for c in range(n_chunks):
+            chunk = [l[c * cap:(c + 1) * cap] for l in by_shard]
+            need = max(len(l) for l in chunk)
+            bucket = next(b for b in buckets if b // S >= need)
+            seg = bucket // S
+            first = next(l[0] for l in chunk if l)
+            batch = np.zeros((bucket,) + first[1].shape, first[1].dtype)
+            ids: List[str] = []
+            metas: List[FrameMeta] = []
+            rows: List[int] = []
+            for s, shard_items in enumerate(chunk):
+                for i, (device_id, arr, meta) in enumerate(shard_items):
+                    batch[s * seg + i] = arr
+                    ids.append(device_id)
+                    metas.append(meta)
+                    rows.append(s * seg + i)
+            groups.append(BatchGroup(
+                src_hw=hw, device_ids=ids, frames=batch, metas=metas,
+                bucket=bucket, model=model, rows=rows,
+            ))
 
     def drop_stream(self, device_id: str) -> None:
         self._cursors.pop(device_id, None)

@@ -212,7 +212,7 @@ def admitted_streams(
 
 
 def shed_stale(group: BatchGroup, now_ms: float, max_staleness_ms: float,
-               buckets: Sequence[int]):
+               buckets: Sequence[int], shards: int = 1):
     """Degradation-ladder rung 1: drop frames older than the staleness
     bound from a collected group BEFORE dispatch (oldest-first by
     construction — only stale rows leave). Fresh rows compact in place
@@ -240,6 +240,8 @@ def shed_stale(group: BatchGroup, now_ms: float, max_staleness_ms: float,
                         m, group.device_ids[i]))
     if not keep:
         return None, shed
+    if group.rows is not None and shards > 1:
+        return _compact_sharded(group, keep, buckets, shards), shed
     for new_i, old_i in enumerate(keep):
         if new_i != old_i:
             group.frames[new_i] = group.frames[old_i]
@@ -253,6 +255,51 @@ def shed_stale(group: BatchGroup, now_ms: float, max_staleness_ms: float,
     group.frames = view
     group.bucket = bucket
     return group, shed
+
+
+def _compact_sharded(group: BatchGroup, keep: List[int],
+                     buckets: Sequence[int], shards: int) -> BatchGroup:
+    """Keep-list compaction for shard-segmented groups (r17), shared by
+    rung-1 stale shedding and the ROI full-row path: surviving rows
+    compact WITHIN their shard's segment (a row must never migrate to
+    another chip's slice), and the group re-slices to the smallest
+    bucket whose per-shard segment covers the fullest shard. Compaction
+    runs low-to-high global row, so every move reads an untouched
+    source (same in-place discipline as the identity-layout path)."""
+    seg_src = group.bucket // shards
+    per: Dict[int, List[int]] = {}
+    for i in keep:
+        per.setdefault(group.rows[i] // seg_src, []).append(i)
+    k_max = max(len(v) for v in per.values())
+    bucket = next(
+        b for b in sorted(buckets)
+        if b % shards == 0 and b // shards >= k_max
+    )
+    seg = bucket // shards
+    moves = []       # (dst_row, slot i) sorted by source row below
+    for s, slots in per.items():
+        for j, i in enumerate(slots):
+            moves.append((s * seg + j, i))
+    # seg <= seg_src, so dst <= src slotwise within a shard and shards
+    # only move down: processing in ascending source-row order never
+    # overwrites a pending source.
+    moves.sort(key=lambda m: group.rows[m[1]])
+    occupied = set()
+    for dst, i in moves:
+        src = group.rows[i]
+        if dst != src:
+            group.frames[dst] = group.frames[src]
+        occupied.add(dst)
+    group.device_ids = [group.device_ids[i] for _, i in moves]
+    group.metas = [group.metas[i] for _, i in moves]
+    group.rows = [dst for dst, _ in moves]
+    view = group.frames[:bucket]
+    for r in range(bucket):
+        if r not in occupied:
+            view[r] = 0
+    group.frames = view
+    group.bucket = bucket
+    return group
 
 
 @dataclass
@@ -445,12 +492,17 @@ class _ThumbPool:
     old per-stream dict had).
     """
 
-    __slots__ = ("side", "_slots", "_free", "_pool", "_capacity", "_high")
+    __slots__ = ("side", "device", "_slots", "_free", "_pool", "_capacity",
+                 "_high")
 
     _GROW = 64    # rows added per capacity growth (keeps re-pads rare)
 
-    def __init__(self, side: int):
+    def __init__(self, side: int, device=None):
         self.side = int(side)
+        # r17: a sharded parent pins each sub-pool to its mesh slice's
+        # lead device, so gathers/scatters stay chip-local. None keeps
+        # the legacy default-device placement bit-identical.
+        self.device = device
         self._slots: Dict[str, int] = {}   # device_id -> pool row (>= 1)
         self._free: List[int] = []
         self._pool = None                  # lazy: jax import stays off the
@@ -480,21 +532,31 @@ class _ThumbPool:
 
         if self._pool is None:
             cap = max(self._GROW, rows)
-            self._pool = jnp.zeros((cap, self.side, self.side), jnp.float32)
+            pool = jnp.zeros((cap, self.side, self.side), jnp.float32)
+            if self.device is not None:
+                import jax
+
+                pool = jax.device_put(pool, self.device)
+            self._pool = pool
             self._capacity = cap
         elif rows > self._capacity:
             grow = -(-(rows - self._capacity) // self._GROW) * self._GROW
+            # Padding a committed array computes on (and stays on) its
+            # device, so the shard pinning survives growth.
             self._pool = jnp.pad(self._pool, ((0, grow), (0, 0), (0, 0)))
             self._capacity += grow
 
-    def gather_indices(self, device_ids, bucket: int) -> np.ndarray:
+    def gather_indices(self, device_ids, bucket: int, rows=None) -> np.ndarray:
         """[bucket] int32 gather rows for a batch, slot order: each
         known stream's row, row 0 (zeros) for first-seen streams and
-        padded slots. This vector is the only host->device bytes the
-        quality path still ships per batch."""
+        padded slots. ``rows`` (shard-segmented layouts) maps slot i to
+        its batch row; None keeps the legacy identity order. This
+        vector is the only host->device bytes the quality path still
+        ships per batch."""
         idx = np.zeros(bucket, np.int32)
         for i, did in enumerate(device_ids):
-            idx[i] = self._slots.get(did, 0)
+            r = i if rows is None else rows[i]
+            idx[r] = self._slots.get(did, 0)
         return idx
 
     def gather(self, idx: np.ndarray):
@@ -504,24 +566,130 @@ class _ThumbPool:
         self._ensure(1)
         return jnp.take(self._pool, jnp.asarray(idx), axis=0)
 
-    def scatter(self, device_ids, thumbs) -> None:
+    def scatter(self, device_ids, thumbs, rows=None) -> None:
         """Store this tick's [>=n, th, tw] device rows (the step output,
-        still async) for next tick's diff; assigns rows on first sight."""
+        still async) for next tick's diff; assigns pool rows on first
+        sight. ``rows`` names each stream's source row inside ``thumbs``
+        (shard-segmented layouts); None = slot order, legacy path."""
         import jax.numpy as jnp
 
-        rows = []
+        pool_rows = []
         for did in device_ids:
             row = self._slots.get(did)
             if row is None:
                 row = self._free.pop() if self._free else self._high + 1
                 self._high = max(self._high, row)
                 self._slots[did] = row
-            rows.append(row)
-        if not rows:
+            pool_rows.append(row)
+        if not pool_rows:
             return
-        self._ensure(max(rows) + 1)
-        idx = jnp.asarray(np.asarray(rows, np.int32))
-        self._pool = self._pool.at[idx].set(thumbs[:len(rows)])
+        self._ensure(max(pool_rows) + 1)
+        idx = jnp.asarray(np.asarray(pool_rows, np.int32))
+        if rows is None:
+            src = thumbs[:len(pool_rows)]
+        else:
+            src = jnp.take(
+                thumbs, jnp.asarray(np.asarray(rows, np.int32)), axis=0)
+        self._pool = self._pool.at[idx].set(src)
+
+
+class _ShardedThumbPool:
+    """Per-mesh-slice thumbnail state for mesh serving (r17 tentpole
+    leg 3): one ``_ThumbPool`` per dp shard, each pinned to its slice's
+    lead device, speaking the collector's shard-segmented row layout
+    (``group.rows``). ``gather`` assembles the per-shard device takes
+    into one dp-sharded [bucket, th, tw] array (the same sharding the
+    frames carry, so the compiled step sees one stable signature);
+    ``scatter`` splits the step's sharded thumbnail output back per
+    slice via its addressable shards — a stream's t-1 thumbnail lives
+    on the chip that serves its frames, and no thumbnail bytes ever
+    cross the host or a chip boundary. Dict-like surface mirrors
+    ``_ThumbPool`` for the tick loop's per-stream GC."""
+
+    __slots__ = ("side", "shards", "_mesh", "_shard_of", "_subs")
+
+    def __init__(self, side: int, *, mesh, shards: int, shard_of):
+        from ..temporal.state_pool import shard_devices
+
+        self.side = int(side)
+        self.shards = int(shards)
+        self._mesh = mesh
+        self._shard_of = shard_of
+        self._subs = [
+            _ThumbPool(side, device=d)
+            for d in shard_devices(mesh, self.shards)
+        ]
+
+    def __bool__(self) -> bool:
+        return any(bool(sub) for sub in self._subs)
+
+    def __iter__(self):
+        ids: List[str] = []
+        for sub in self._subs:
+            ids.extend(sub)
+        return iter(ids)
+
+    def __len__(self) -> int:
+        return sum(len(sub) for sub in self._subs)
+
+    def pop(self, device_id: str, default=None):
+        self._subs[self._shard_of(device_id) % self.shards].pop(device_id)
+        return default
+
+    def gather_indices(self, device_ids, bucket: int, rows=None):
+        """Per-shard [seg] int32 local gather rows (list, one array per
+        shard). Row r of the batch lives in shard r // seg at local row
+        r % seg — the collector's segmented layout."""
+        seg = max(1, bucket // self.shards)
+        per = [np.zeros(seg, np.int32) for _ in range(self.shards)]
+        for i, did in enumerate(device_ids):
+            r = i if rows is None else rows[i]
+            per[r // seg][r % seg] = self._subs[r // seg]._slots.get(did, 0)
+        return per
+
+    def gather(self, idx):
+        """Previous-tick [bucket, th, tw] thumbnails as one dp-sharded
+        array: a chip-local take per shard, assembled without any
+        cross-chip movement."""
+        import jax.numpy as jnp
+
+        from ..parallel import assemble_sharded, batch_sharding
+
+        pieces = []
+        for s, sub in enumerate(self._subs):
+            sub._ensure(1)
+            pieces.append(jnp.take(sub._pool, jnp.asarray(idx[s]), axis=0))
+        bucket = sum(int(p.shape[0]) for p in pieces)
+        return assemble_sharded(
+            pieces, (bucket, self.side, self.side),
+            batch_sharding(self._mesh, 3),
+        )
+
+    def scatter(self, device_ids, thumbs, rows=None) -> None:
+        """Route this tick's sharded [bucket, th, tw] step output into
+        the per-shard pools: each shard scatters from its own
+        addressable slice (chip-local), with a sliced-view fallback
+        when the compiled output's layout hides a shard."""
+        bucket = int(thumbs.shape[0])
+        seg = max(1, bucket // self.shards)
+        by_shard: Dict[int, List[tuple]] = {}
+        for i, did in enumerate(device_ids):
+            r = i if rows is None else rows[i]
+            by_shard.setdefault(r // seg, []).append((r % seg, did))
+        pieces: Dict[int, Any] = {}
+        for sh in getattr(thumbs, "addressable_shards", ()):
+            if int(sh.data.shape[0]) != seg:
+                continue   # unexpected output layout: fallback below
+            start = sh.index[0].start or 0
+            pieces.setdefault(start // seg, sh.data)
+        for s, pairs in sorted(by_shard.items()):
+            piece = pieces.get(s)
+            if piece is None:
+                piece = thumbs[s * seg:(s + 1) * seg]
+            self._subs[s].scatter(
+                [did for _, did in pairs], piece,
+                rows=[r for r, _ in pairs],
+            )
 
 
 class _Prefetched:
@@ -560,9 +728,14 @@ class _PrefetchStage:
 
     DEPTH = 2
 
-    def __init__(self, place_fn, busy_fn):
+    def __init__(self, place_fn, busy_fn, shards: int = 1):
         self._place = place_fn       # host frames -> device array
         self._busy = busy_fn         # True when >=1 dispatched batch in flight
+        # r17: under mesh serving each placement fans out one async
+        # device_put per dp slice; slot parity tracks per (shard, model,
+        # geometry, bucket) so attribution stays per-chip even though
+        # the shard-segmented group advances all slices together.
+        self.shards = int(shards)
         self._q: "queue.Queue[Optional[_Prefetched]]" = queue.Queue(
             maxsize=self.DEPTH)
         self._thread: Optional[threading.Thread] = None
@@ -590,9 +763,12 @@ class _PrefetchStage:
         slots are occupied — same bounded-pipeline stance as the drain
         queue. Returns None on shutdown (caller returns the lease)."""
         pre = _Prefetched(group)
-        key = (group.model, group.src_hw, group.bucket)
-        pre.slot = self._slots.get(key, 0)
-        self._slots[key] = pre.slot ^ 1
+        n_keys = self.shards if group.rows is not None else 1
+        keys = [(s, group.model, group.src_hw, group.bucket)
+                for s in range(n_keys)]
+        pre.slot = self._slots.get(keys[0], 0)
+        for key in keys:
+            self._slots[key] = self._slots.get(key, 0) ^ 1
         while not stop_event.is_set():
             try:
                 self._q.put(pre, timeout=0.1)
@@ -918,41 +1094,44 @@ class InferenceEngine:
         # Output-quality observability (obs/quality.py): host verdict
         # state machines + drift scores fed from _emit; the device side
         # (frame statistics folded into the serving step) additionally
-        # needs per-stream thumbnail state, which the mesh path does not
-        # shard — single-chip serving only, detections-only verdicts
-        # otherwise. cfg.quality=False disables the whole plane (the
-        # REST endpoint answers 400, same kill-switch convention as
-        # slo/prof).
+        # needs per-stream thumbnail state — per mesh shard under
+        # engine.mesh (r17), one pool on the single chip otherwise.
+        # cfg.quality=False disables the whole plane (the REST endpoint
+        # answers 400, same kill-switch convention as slo/prof).
         self.quality = None
         self.canary = None
         self._canary_thread: Optional[threading.Thread] = None
         # Device-resident thumbnail pool (dict-like: stream -> pool row).
+        # Under a mesh, warmup swaps in the sharded twin once the mesh
+        # exists (_ShardedThumbPool: one _ThumbPool per dp slice).
         self._thumbs = _ThumbPool(self._cfg.quality_thumb)
         self._quality_device = False
+        # Data-parallel serving state (r17 tentpole leg 1): shard count
+        # and the stream->shard map, set by warmup once the mesh shape
+        # is known. 1/None = single-chip layout everywhere.
+        self._shards = 1
+        self._shard_of = None
         # Spatially-multiplexed ROI serving (MOSAIC, ROADMAP item 1):
         # motion gate state + shelf packer, built at warmup (the packer
         # needs the effective bucket list). cfg.roi=False leaves both
         # None — every batch then takes the classic full-frame path
-        # bit-identically (test-pinned kill switch). Mesh serving keeps
-        # full frames too: the canvas scatter-back assumes single-chip
-        # host outputs, same restriction as the thumbnail pool.
+        # bit-identically (test-pinned kill switch). Under engine.mesh
+        # (r17) canvases pack per mesh slice, so the scatter-back
+        # routing table stays shard-local and ROI serving runs on-mesh.
         self._roi: Optional[_RoiGate] = None
         self._packer: Optional[CanvasPacker] = None
-        if self._cfg.roi and not self._cfg.mesh:
+        if self._cfg.roi:
             self._roi = _RoiGate(
                 self._cfg.roi_idle_diff, self._cfg.roi_full_interval_ms)
-        elif self._cfg.roi:
-            _note_feature_disabled(
-                "roi", "mesh_serving_single_chip_scatter_back")
         # Temporal cascade serving (CASCADE, ROADMAP item 2): tracker-
         # keyed device clip rings + cadence-1/N temporal head
         # (temporal/scheduler.py). cascade=False leaves it None — every
         # batch takes today's stateless path bit-identically (test-
-        # pinned kill switch, roi=False convention). Mesh serving stays
-        # stateless: the track state pool is not sharded, same
-        # restriction as the thumbnail pool.
+        # pinned kill switch, roi=False convention). Under engine.mesh
+        # the scheduler swaps its pool for the sharded twin
+        # (configure_mesh in warmup) so clip state lives per chip.
         self._cascade = None
-        if self._cfg.cascade and not self._cfg.mesh:
+        if self._cfg.cascade:
             from ..temporal import CascadeScheduler
 
             self._cascade = CascadeScheduler(
@@ -967,9 +1146,6 @@ class InferenceEngine:
                 perf=self.perf,
             )
             self._cascade.head = self._cascade_head
-        elif self._cfg.cascade:
-            _note_feature_disabled(
-                "cascade", "mesh_serving_single_chip_state_pool")
         # Capacity attribution plane (obs/capacity.py): the per-stream
         # device-time ledger + headroom forecast fed from the same
         # _emit measurements obs/perf.py aggregates, evaluated off the
@@ -1015,11 +1191,9 @@ class InferenceEngine:
                 drift_threshold=self._cfg.quality_drift_threshold,
                 on_transition=self._on_quality_transition,
             )
-            self._quality_device = (
-                self._cfg.quality_thumb > 0 and not self._cfg.mesh)
-            if self._cfg.mesh:
-                _note_feature_disabled(
-                    "quality_device_stats", "mesh_thumbnail_not_sharded")
+            # r17: device frame statistics run under the mesh too — the
+            # thumbnail pool shards per dp slice (warmup).
+            self._quality_device = self._cfg.quality_thumb > 0
 
     @property
     def cascade(self):
@@ -1156,6 +1330,27 @@ class InferenceEngine:
             buckets = tuple(b for b in buckets if b % dp == 0) or (dp,)
             self._variables = self._place_variables(self._variables)
             self._model = self._maybe_seq_parallel(self._model)
+            # r17 mesh-native serving: everything downstream of the
+            # collector addresses batches in the shard-segmented row
+            # layout (shard s owns rows [s*seg, (s+1)*seg)). The stream
+            # -> shard map is the collector's stable crc32 hash so a
+            # stream's ROI/cascade/thumbnail state lives where its
+            # frames land, tick after tick.
+            from .collector import stream_shard
+
+            self._shards = dp
+            self._shard_of = lambda did: stream_shard(did, dp)
+            if self._xfer is not None:
+                self._xfer.shards = dp
+            if self._quality_device:
+                self._thumbs = _ShardedThumbPool(
+                    self._cfg.quality_thumb, mesh=self._mesh, shards=dp,
+                    shard_of=self._shard_of,
+                )
+            if self._cascade is not None:
+                self._cascade.configure_mesh(
+                    mesh=self._mesh, shards=dp, shard_of=self._shard_of,
+                )
             log.info(
                 "engine mesh: %s (buckets -> %s)",
                 dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
@@ -1190,6 +1385,10 @@ class InferenceEngine:
             # queue); pooled buffers must stay valid until the drain
             # thread releases them.
             strict_lease=True,
+            # r17: per-shard batch slices — the collector emits groups in
+            # the shard-segmented row layout (group.rows set) so each dp
+            # slice receives exactly its streams' frames.
+            shards=self._shards,
         )
         log.info(
             "engine ready: model=%s kind=%s input=%d backend=%s",
@@ -1503,7 +1702,11 @@ class InferenceEngine:
 
             seen = {k for k in (_ekey(e) for e in entries) if k}
             programs = aot_cache.load_manifest(self._aot_dir) or []
-            for entry in aot_cache.prewarm_entries(programs):
+            # r17: replay only programs recorded under THIS mesh spec —
+            # a stale single-chip manifest on a mesh boot (or vice
+            # versa) contributes nothing and degrades to clean compile.
+            for entry in aot_cache.prewarm_entries(programs,
+                                                   mesh=self._mesh):
                 key = _ekey(entry)
                 if key is not None and key not in seen:
                     seen.add(key)
@@ -1915,7 +2118,12 @@ class InferenceEngine:
         args = [self._place(np.zeros(shape, np.uint8))]
         if self._quality_device and not spec.clip_len:
             side = self._cfg.quality_thumb
-            args.append(np.zeros((bucket, side, side), np.float32))
+            thumbs = np.zeros((bucket, side, side), np.float32)
+            # Under a mesh the serving thumbnails arrive dp-sharded (the
+            # sharded pool's gather); prewarm with the same sharding or
+            # the first real batch would compile a second program.
+            args.append(self._place(thumbs) if self._mesh is not None
+                        else thumbs)
         self._step(src_hw, bucket, model)(variables, *args)
 
     def _place(self, frames: np.ndarray):
@@ -1925,24 +2133,25 @@ class InferenceEngine:
         `_place_device` instead, which always performs the real copy."""
         if self._mesh is None:
             return frames
-        import jax
+        from ..parallel import batch_sharding, shard_put
 
-        from ..parallel import batch_sharding
-
-        return jax.device_put(frames, batch_sharding(self._mesh, frames.ndim))
+        return shard_put(frames, batch_sharding(self._mesh, frames.ndim))
 
     def _place_device(self, frames: np.ndarray):
         """Real async H2D placement for the prefetch stage: single-chip
         batches device_put explicitly (the legacy passthrough deferred
         the copy into the step call, serializing it on the tick thread),
-        mesh batches shard over dp as before."""
-        import jax
-
+        mesh batches shard over dp via ``shard_put`` — one async
+        ``device_put`` per mesh slice, issued back-to-back so the S
+        copies overlap instead of staging through a single host->chip0
+        transfer (r17 tentpole leg 2)."""
         if self._mesh is None:
-            return jax.device_put(frames)
-        from ..parallel import batch_sharding
+            import jax
 
-        return jax.device_put(frames, batch_sharding(self._mesh, frames.ndim))
+            return jax.device_put(frames)
+        from ..parallel import batch_sharding, shard_put
+
+        return shard_put(frames, batch_sharding(self._mesh, frames.ndim))
 
     def _step(self, src_hw: tuple, bucket: int, model: Optional[str] = None):
         model = model or self._spec.name
@@ -2003,10 +2212,11 @@ class InferenceEngine:
 
                 def record(_dir=self._aot_dir, _model=model,
                            _stem=getattr(self._cfg, "stem", "classic"),
-                           _hw=src_hw, _bucket=bucket):
+                           _hw=src_hw, _bucket=bucket,
+                           _mesh=self._mesh):
                     aot_cache.record_program(
                         _dir, model=_model, stem=_stem,
-                        src_hw=_hw, bucket=_bucket)
+                        src_hw=_hw, bucket=_bucket, mesh=_mesh)
 
             fn = _TimedStep(jax.jit(raw, donate_argnums=donate),
                             self.perf, model, src_hw, bucket,
@@ -2267,8 +2477,11 @@ class InferenceEngine:
                 if self._quality_device and group.frames.ndim == 4 \
                         and group.crops is None:
                     idx = self._thumbs.gather_indices(
-                        group.device_ids, group.bucket)
-                    aux_nbytes = int(idx.nbytes)
+                        group.device_ids, group.bucket, rows=group.rows)
+                    aux_nbytes = (
+                        sum(int(a.nbytes) for a in idx)
+                        if isinstance(idx, list) else int(idx.nbytes)
+                    )
                 self.perf.note_h2d(
                     group.model or self._spec.name, group.bucket,
                     group.nbytes + aux_nbytes, h2d_s, hidden_s=hidden_s,
@@ -2283,7 +2496,8 @@ class InferenceEngine:
                         variables, placed, self._thumbs.gather(idx),
                     ))
                     self._thumbs.scatter(
-                        group.device_ids, outputs.pop("quality_thumbs"))
+                        group.device_ids, outputs.pop("quality_thumbs"),
+                        rows=group.rows)
                 else:
                     outputs = step(variables, placed)
                     if group.crops is not None and isinstance(outputs, dict):
@@ -2342,7 +2556,8 @@ class InferenceEngine:
         out: List[BatchGroup] = []
         for group in groups:
             kept, shed = shed_stale(
-                group, now_ms, self._cfg.shed_staleness_ms, self._buckets
+                group, now_ms, self._cfg.shed_staleness_ms, self._buckets,
+                shards=self._shards,
             )
             if shed:
                 self.shed_frames += shed
@@ -2405,9 +2620,15 @@ class InferenceEngine:
                     rects = (self._track_rois(tracker)
                              if verdict == "roi" else [])
                     if rects:
+                        # Frames live at the GLOBAL row under the
+                        # shard-segmented layout, not the slot index —
+                        # blitting by slot cuts another stream's pixels
+                        # whenever per-shard occupancy is unequal.
+                        fr = (group.rows[i] if group.rows is not None
+                              else i)
                         for rect in rects:
                             reqs.append((device_id, group.metas[i],
-                                         group.frames[i], rect))
+                                         group.frames[fr], rect))
                             req_row.append(i)
                     else:
                         full_rows.append(i)
@@ -2420,10 +2641,16 @@ class InferenceEngine:
                 out.append(group)
                 continue
             placements: list = []
-            n_canvases = 0
-            if reqs:
+            cgroup: Optional[BatchGroup] = None
+            n_used = 0
+            if reqs and self._shards > 1 and group.rows is not None:
+                # r17 mesh serving: canvases pack per mesh slice so the
+                # scatter-back routing table stays shard-local.
+                cgroup, placements, full_rows, n_used = (
+                    self._pack_canvases_sharded(group, reqs, req_row,
+                                                full_rows))
+            elif reqs:
                 canvases, placements, overflow = self._packer.pack(reqs)
-                n_canvases = canvases.shape[0]
                 if overflow:
                     # Crops that did not fit fall back to the full-frame
                     # path. ALL of a spilled stream's placements leave
@@ -2440,7 +2667,7 @@ class InferenceEngine:
             self.perf.note_roi_gate(
                 len(coast), len({p.device_id for p in placements}),
                 len(full_rows))
-            if placements:
+            if placements and cgroup is None:
                 side = self._packer.side
                 n_used = 1 + max(p.canvas for p in placements)
                 metas = []
@@ -2454,18 +2681,20 @@ class InferenceEngine:
                         width=side, height=side, channels=3,
                         timestamp_ms=min(pts) if pts else 0,
                     ))
-                cgroup = BatchGroup(
+                cgroup = pad_to_bucket(BatchGroup(
                     src_hw=(side, side),
                     device_ids=[f"_canvas{ci}" for ci in range(n_used)],
                     frames=canvases[:n_used],
                     metas=metas,
                     model=group.model,
                     crops=placements,
-                )
-                out.append(pad_to_bucket(cgroup, self._buckets))
+                ), self._buckets)
+            if cgroup is not None:
+                out.append(cgroup)
                 self.perf.note_roi_pack(
                     len(placements), n_used,
-                    CanvasPacker.area_fraction(placements, n_used, side))
+                    CanvasPacker.area_fraction(placements, n_used,
+                                               self._packer.side))
             if coast:
                 out.append(BatchGroup(
                     src_hw=group.src_hw,
@@ -2477,7 +2706,10 @@ class InferenceEngine:
                     model=group.model,
                     coast=coast,
                 ))
-            if full_rows:
+            if full_rows and group.rows is not None and self._shards > 1:
+                out.append(_compact_sharded(
+                    group, full_rows, self._buckets, self._shards))
+            elif full_rows:
                 for new_i, old_i in enumerate(full_rows):
                     if new_i != old_i:
                         group.frames[new_i] = group.frames[old_i]
@@ -2496,6 +2728,91 @@ class InferenceEngine:
                 # (canvases and coast groups hold copies, not views).
                 self._collector.release(group)
         return out
+
+    def _pack_canvases_sharded(self, group: BatchGroup, reqs, req_row,
+                               full_rows):
+        """MOSAIC packing under mesh serving (r17 tentpole leg 3): each
+        dp shard's crops shelf-pack onto that shard's OWN canvases, and
+        the canvas batch is emitted in the shard-segmented row layout —
+        a canvas only ever carries crops of streams its chip serves, so
+        the scatter-back routing table is shard-local by construction
+        (the single-chip assumption the old auto-disable guarded).
+
+        Returns ``(canvas group or None, kept placements, updated
+        full_rows, used canvas rows)``. Spilled streams fall back to the
+        full-frame path exactly like the single-chip branch; if no
+        bucket segment can hold a shard's canvas count, the whole
+        request set falls back (rare — counted as full rows)."""
+        import dataclasses
+
+        S = self._shards
+        by_shard: Dict[int, List[int]] = {}
+        for ri, req in enumerate(reqs):
+            by_shard.setdefault(self._shard_of(req[0]) % S, []).append(ri)
+        packed: Dict[int, tuple] = {}
+        spill: set = set()
+        for s, ris in sorted(by_shard.items()):
+            canvases, placements, overflow = self._packer.pack(
+                [reqs[ri] for ri in ris])
+            if overflow:
+                spill |= {reqs[ris[oi]][0] for oi in overflow}
+            packed[s] = (canvases, placements)
+        if spill:
+            spill_rows = {req_row[ri] for ri in range(len(reqs))
+                          if reqs[ri][0] in spill}
+            full_rows = sorted(set(full_rows) | spill_rows)
+        n_by_shard: Dict[int, int] = {}
+        for s, (canvases, placements) in packed.items():
+            kept = [p for p in placements if p.device_id not in spill]
+            packed[s] = (canvases, kept)
+            n_by_shard[s] = (1 + max(p.canvas for p in kept)) if kept else 0
+        k_max = max(n_by_shard.values(), default=0)
+        if k_max == 0:
+            return None, [], full_rows, 0
+        bucket = next(
+            (b for b in sorted(self._buckets)
+             if b % S == 0 and b // S >= k_max), None)
+        if bucket is None:
+            rows_all = {req_row[ri] for ri in range(len(reqs))}
+            return None, [], sorted(set(full_rows) | rows_all), 0
+        seg = bucket // S
+        side = self._packer.side
+        frames = np.zeros((bucket, side, side, 3), np.uint8)
+        rows: List[int] = []
+        device_ids: List[str] = []
+        metas: List[FrameMeta] = []
+        out_placements: list = []
+        for s, (canvases, kept) in sorted(packed.items()):
+            if not kept:
+                continue
+            n_used = n_by_shard[s]
+            frames[s * seg:s * seg + n_used] = canvases[:n_used]
+            for ci in range(n_used):
+                r = s * seg + ci
+                pts = [p.meta.timestamp_ms or 0
+                       for p in kept if p.canvas == ci]
+                rows.append(r)
+                device_ids.append(f"_canvas{r}")
+                metas.append(FrameMeta(
+                    width=side, height=side, channels=3,
+                    timestamp_ms=min(pts) if pts else 0,
+                ))
+            # Placement canvas indices become GLOBAL batch rows so the
+            # scatter-back router addresses host outputs directly.
+            out_placements.extend(
+                dataclasses.replace(p, canvas=s * seg + p.canvas)
+                for p in kept)
+        cgroup = BatchGroup(
+            src_hw=(side, side),
+            device_ids=device_ids,
+            frames=frames,
+            metas=metas,
+            bucket=bucket,
+            model=group.model,
+            crops=out_placements,
+            rows=rows,
+        )
+        return cgroup, out_placements, full_rows, len(rows)
 
     def _coasted_detections(self, tracker, spec) -> List[pb.Detection]:
         """Gated-idle emission: advance the stream's tracker one frame
@@ -2706,6 +3023,21 @@ class InferenceEngine:
         self._m_device.labels(group.model or self._spec.name).observe(
             device_ms
         )
+        # r17 per-shard attribution: under the shard-segmented layout
+        # each mesh slice was busy for the WHOLE dispatch (the chips run
+        # the same program in lockstep), so every shard that carried
+        # frames is charged the full device_ms — per-chip measured and
+        # attributed time then agree by construction and conservation
+        # holds per shard as well as in aggregate.
+        shard_frames = shard_streams = None
+        if group.rows is not None and self._shards > 1:
+            seg = max(1, group.bucket // self._shards)
+            shard_frames = {}
+            shard_streams = {}
+            for j in range(len(group.device_ids)):
+                s = str(group.rows[j] // seg)
+                shard_frames[s] = shard_frames.get(s, 0) + 1
+                shard_streams.setdefault(s, []).append(group.device_ids[j])
         if group.crops is not None:
             # MOSAIC canvas batch: the fps window counts the STREAMS the
             # canvases served, and occupancy is the crop-pixel area
@@ -2716,6 +3048,7 @@ class InferenceEngine:
                 device_ms, len(group.device_ids), streams=streams,
                 area_frac=CanvasPacker.area_fraction(
                     group.crops, len(group.device_ids), group.src_hw[0]),
+                shard_frames=shard_frames,
             )
             if self.capacity is not None:
                 # Ledger attribution by packed canvas share: each
@@ -2726,10 +3059,17 @@ class InferenceEngine:
                 for p in group.crops:
                     a = ((p.dst[2] - p.dst[0]) * (p.dst[3] - p.dst[1]))
                     areas[p.device_id] = areas.get(p.device_id, 0) + a
+                crop_shards = None
+                if shard_streams is not None:
+                    crop_shards = {}
+                    for did in areas:
+                        s = str(self._shard_of(did) % self._shards)
+                        crop_shards.setdefault(s, []).append(did)
                 self.capacity.note_batch(
                     group.model or self._spec.name, group.src_hw,
                     group.bucket, device_ms, list(areas),
                     weights=list(areas.values()), kind="roi",
+                    shard_streams=crop_shards,
                 )
             self._emit_canvas(inflight, host, spec, device_ms, t_drained)
             return
@@ -2738,6 +3078,7 @@ class InferenceEngine:
         self.perf.note_batch(
             group.model or self._spec.name, group.src_hw, group.bucket,
             device_ms, len(group.device_ids),
+            shard_frames=shard_frames,
         )
         if self.capacity is not None:
             # Ledger attribution by slot occupancy: the bucket's cost
@@ -2746,6 +3087,7 @@ class InferenceEngine:
             self.capacity.note_batch(
                 group.model or self._spec.name, group.src_hw,
                 group.bucket, device_ms, group.device_ids,
+                shard_streams=shard_streams,
             )
         slo_latency = (
             self._slo_latency
@@ -2764,22 +3106,26 @@ class InferenceEngine:
             self.perf.note_roi_emit(len(group.device_ids))
         for i, device_id in enumerate(group.device_ids):
             meta = group.metas[i]
+            # Shard-segmented layout (r17): slot i's device outputs (and
+            # its leased frame) live at batch row rows[i]; identity on
+            # the single-chip path.
+            row = group.rows[i] if group.rows is not None else i
             # Structured log correlation: every record logged while this
             # slot emits (tracker, annotate, publish, quality) carries
             # stream=<id> seq=<packet> (utils/logging.py injector).
             ctx = set_log_context(stream=device_id, seq=meta.packet)
             try:
                 self._emit_slot(
-                    inflight, host, i, device_id, meta, spec, now_ms,
+                    inflight, host, row, device_id, meta, spec, now_ms,
                     device_ms, slo_latency, t_drain0, t_drained,
                 )
             finally:
                 reset_log_context(ctx)
 
-    def _emit_slot(self, inflight, host, i, device_id, meta, spec, now_ms,
+    def _emit_slot(self, inflight, host, row, device_id, meta, spec, now_ms,
                    device_ms, slo_latency, t_drain0, t_drained) -> None:
         group = inflight.group
-        detections = self._to_detections(host, i, spec)
+        detections = self._to_detections(host, row, spec)
         if self._cfg.track and spec.kind == "detect":
             # Unconditionally — empty frames MUST reach the tracker so
             # misses accumulate and stale tracks expire; skipping them
@@ -2790,16 +3136,16 @@ class InferenceEngine:
                     and group.crops is None):
                 # CASCADE harvest: letterbox each tracked detection's
                 # crop into its device clip ring (scattered next tick).
-                # Classic full-frame slots only — frames[i] is the
+                # Classic full-frame slots only — frames[row] is the
                 # leased host buffer, valid until _emit returns; canvas
                 # and clip slots have no per-stream full frame here.
                 try:
                     self._cascade.harvest(
-                        device_id, group.frames[i], detections, meta)
+                        device_id, group.frames[row], detections, meta)
                 except Exception:
                     log.exception("cascade harvest failed; continuing")
         if self.quality is not None:
-            self._observe_quality(host, i, device_id, meta, detections)
+            self._observe_quality(host, row, device_id, meta, detections)
         latency = max(0.0, now_ms - meta.timestamp_ms) if meta.timestamp_ms else 0.0
         result = pb.InferenceResult(
             device_id=device_id,
@@ -2909,7 +3255,12 @@ class InferenceEngine:
             else 0.0
         )
         n_classes = self._num_classes(spec)
-        for ci in range(len(group.device_ids)):
+        # Shard-segmented canvas batches (r17): placement .canvas already
+        # names the GLOBAL batch row, so host outputs index directly;
+        # identity range on the single-chip path.
+        canvas_rows = (group.rows if group.rows is not None
+                       else range(len(group.device_ids)))
+        for ci in canvas_rows:
             cells = by_canvas.get(ci)
             if not cells:
                 continue
@@ -3134,12 +3485,20 @@ class InferenceEngine:
             # per-tick figure via amortize_n — a head pass every N ticks
             # is 1/N of its cost per tick at steady state).
             side = self._cascade.side
+            streams = [stream for stream, _ in res.head_tracks]
+            shard_streams = None
+            if self._shards > 1 and self._shard_of is not None and streams:
+                shard_streams = {}
+                for stream in set(streams):
+                    s = str(self._shard_of(stream) % self._shards)
+                    shard_streams.setdefault(s, []).append(stream)
             self.capacity.note_batch(
                 f"cascade/{self._cfg.cascade_model}", (side, side),
                 len(res.head_tracks) or 1, res.head_ms,
-                [stream for stream, _ in res.head_tracks],
+                streams,
                 kind="cascade",
                 amortize_n=self._cfg.cascade_every_n,
+                shard_streams=shard_streams,
             )
         if tracer.enabled and res.head_ms is not None:
             t_now = time.time()

@@ -186,6 +186,12 @@ class CapacityTracker:
         self._t0: Optional[float] = None       # first attributed batch
         self._streams: Dict[str, _StreamLedger] = {}
         self._cells: Dict[Tuple[str, str, int], _Cell] = {}
+        # Mesh-native serving (ISSUE 17): per-shard conservation ledgers.
+        # Data-parallel replication means every chip is busy for the full
+        # batch wall time, so a batch adds device_ms to EACH contributing
+        # shard's measured AND attributed totals — per-shard drift is
+        # 0.0 by construction, same as the aggregate.
+        self._shards: Dict[str, Dict[str, float]] = {}
         self._agg = _BusyRing(slow_window_s, bin_s)
         # Conservation invariant state.
         self.attributed_ms = 0.0
@@ -216,6 +222,13 @@ class CapacityTracker:
             "vep_capacity_measured_ms_total",
             "Device time measured per batch (conservation denominator)"
         ).labels()
+        self._m_shard_attr = reg.counter(
+            "vep_capacity_shard_attributed_ms_total",
+            "Device time attributed per dp mesh shard (ms)", ("shard",))
+        self._m_shard_meas = reg.counter(
+            "vep_capacity_shard_measured_ms_total",
+            "Device time measured per dp mesh shard (ms; replicated "
+            "program — each chip busy the full batch)", ("shard",))
         self._m_util = reg.gauge(
             "vep_capacity_utilization",
             "Tick-budget utilization per burn window", ("window",))
@@ -241,6 +254,7 @@ class CapacityTracker:
                    device_ms: float, streams: Sequence[str], *,
                    weights: Optional[Sequence[float]] = None,
                    kind: str = "full", amortize_n: int = 1,
+                   shard_streams: Optional[Dict[str, Sequence[str]]] = None,
                    now: Optional[float] = None) -> None:
         """Attribute one measured device batch back to its occupant
         streams.
@@ -251,9 +265,19 @@ class CapacityTracker:
         omitted = equal split. ``amortize_n``: dispatch cadence in ticks
         (cascade head = cfg.cascade_every_n) — raw cost lands in the
         ledger, cost/amortize_n in the steady-state per-tick figure.
-        Shares are exact fractions of ``device_ms``, so attributed and
-        measured totals conserve by construction; the residual float
-        error is tracked and gated, never assumed away."""
+        Conservation is exact BY CONSTRUCTION: the float residual of the
+        share split is folded into the last share, so the attributed and
+        measured running totals advance by the identical float — drift
+        reads 0.0, not "within tolerance" (the multichip smoke gates the
+        literal zero). The folded residual magnitude is still tracked as
+        ``max_batch_rel_err``.
+
+        Mesh-native serving: ``shard_streams`` maps dp shard label ->
+        that shard's occupant streams for this batch. Replicated
+        programs keep every chip busy for the full wall time, so each
+        listed shard's measured AND attributed ledgers advance by the
+        full ``device_ms`` (per-shard drift 0.0 by the same
+        construction)."""
         now = self._clock() if now is None else now
         device_ms = float(device_ms)
         ids = list(streams) or [OVERHEAD_STREAM]
@@ -264,8 +288,10 @@ class CapacityTracker:
                       else [device_ms / len(ids)] * len(ids))
         else:
             shares = [device_ms / len(ids)] * len(ids)
-        attributed = sum(shares)
-        rel_err = (abs(attributed - device_ms)
+        resid = device_ms - sum(shares)
+        shares[-1] += resid
+        attributed = device_ms
+        rel_err = (abs(resid)
                    / max(abs(device_ms), 1e-12)) if device_ms else 0.0
         amortize = max(1, int(amortize_n))
         geometry = f"{src_hw[0]}x{src_hw[1]}"
@@ -296,10 +322,22 @@ class CapacityTracker:
             cell.busy_ms += device_ms
             cell.batches += 1
             self._agg.record(device_ms, now)
+            if shard_streams:
+                for shard in shard_streams:
+                    rec = self._shards.get(shard)
+                    if rec is None:
+                        rec = self._shards[shard] = {
+                            "attributed": 0.0, "measured": 0.0}
+                    rec["measured"] += device_ms
+                    rec["attributed"] += device_ms
         for sid, share in zip(ids, shares):
             self._m_stream_ms.labels(sid, kind).inc(share)
         self._m_attr.inc(attributed)
         self._m_meas.inc(device_ms)
+        if shard_streams:
+            for shard in shard_streams:
+                self._m_shard_attr.labels(str(shard)).inc(device_ms)
+                self._m_shard_meas.labels(str(shard)).inc(device_ms)
 
     def note_coast(self, streams: Sequence[str]) -> None:
         """Register zero-cost occupants (MOSAIC gated-idle coast groups:
@@ -396,9 +434,10 @@ class CapacityTracker:
             attributed = self.attributed_ms
             measured = self.measured_ms
             max_err = self.max_conservation_rel_err
+            shard_recs = {s: dict(rec) for s, rec in self._shards.items()}
         drift = abs(attributed - measured) / max(measured, 1e-9) \
             if measured else 0.0
-        return {
+        out = {
             "attributed_ms": attributed,
             "measured_ms": measured,
             "rel_drift": drift,
@@ -406,6 +445,18 @@ class CapacityTracker:
             "balanced": (drift <= CONSERVATION_REL_TOL
                          and max_err <= CONSERVATION_REL_TOL),
         }
+        if shard_recs:
+            out["shards"] = {
+                s: {
+                    "attributed_ms": rec["attributed"],
+                    "measured_ms": rec["measured"],
+                    "rel_drift": (abs(rec["attributed"] - rec["measured"])
+                                  / max(rec["measured"], 1e-9)
+                                  if rec["measured"] else 0.0),
+                }
+                for s, rec in sorted(shard_recs.items())
+            }
+        return out
 
     def streams(self) -> Dict[str, dict]:
         """Per-stream ledger rows (copies)."""

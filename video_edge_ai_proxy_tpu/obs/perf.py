@@ -146,6 +146,21 @@ class _H2DCell:
         self.slots = 0
 
 
+class _ShardCell:
+    """Per-(model, bucket, shard) mesh-serving attribution: pre-resolved
+    counter children + running totals (same fixed-allocation discipline
+    as :class:`_BatchCell`; these are NEW label families so the existing
+    aggregate series keep their label tuples)."""
+
+    __slots__ = ("frames_child", "busy_child", "frames", "busy_ms")
+
+    def __init__(self, frames_child, busy_child):
+        self.frames_child = frames_child
+        self.busy_child = busy_child
+        self.frames = 0
+        self.busy_ms = 0.0
+
+
 class _BatchCell:
     """Per-(model, geometry, bucket) hot-path state: pre-resolved metric
     children + EMA accumulator, so ``note_batch`` is lookups and float
@@ -191,6 +206,8 @@ class PerfTracker:
         self._cells: Dict[Tuple[str, str, int], _BatchCell] = {}
         # (model, bucket) -> H2D transfer cell
         self._h2d: Dict[Tuple[str, int], _H2DCell] = {}
+        # (model, bucket, shard) -> mesh-serving shard cell
+        self._shard_cells: Dict[Tuple[str, int, str], _ShardCell] = {}
         self._fps = _RateWindow(window_s=fps_window_s)
 
         self._m_compile_s = reg.histogram(
@@ -236,6 +253,18 @@ class PerfTracker:
         self._m_fps = reg.gauge(
             "vep_perf_fps",
             "Aggregate emitted frames/second (sliding window)")
+        # Mesh-native serving (ISSUE 17): per-shard attribution rides NEW
+        # counter families keyed by shard, so every pre-existing series
+        # above keeps its exact label tuple (exposition-lint stability).
+        self._m_shard_frames = reg.counter(
+            "vep_perf_shard_frames_total",
+            "Real frames served per dp mesh shard",
+            ("model", "bucket", "shard"))
+        self._m_shard_busy = reg.counter(
+            "vep_perf_shard_busy_ms_total",
+            "Device batch milliseconds attributed per dp mesh shard "
+            "(data-parallel replication: every chip runs the full "
+            "program wall time)", ("model", "bucket", "shard"))
         self._m_h2d_bytes = reg.counter(
             "vep_h2d_bytes",
             "Host->device bytes shipped per dispatched batch (uint8 "
@@ -349,7 +378,8 @@ class PerfTracker:
     def note_batch(self, model: str, src_hw: Tuple[int, int], bucket: int,
                    device_ms: float, frames: int, *,
                    streams: Optional[int] = None,
-                   area_frac: Optional[float] = None) -> None:
+                   area_frac: Optional[float] = None,
+                   shard_frames: Optional[Dict[str, int]] = None) -> None:
         """Record one drained device batch: ``frames`` real frames in a
         ``bucket``-slot program that ran for ``device_ms``.
 
@@ -359,7 +389,12 @@ class PerfTracker:
         emitted, not canvases), and ``area_frac`` the crop-pixel share
         of the canvas plane. With ``area_frac`` the occupancy gauge
         reports crop-level occupancy — a half-empty canvas must NOT read
-        as one fully-occupied slot."""
+        as one fully-occupied slot.
+
+        Mesh-native serving: ``shard_frames`` maps dp shard label ->
+        real frames that shard contributed to this batch; each listed
+        shard is charged the FULL ``device_ms`` (replicated program —
+        every chip is busy for the whole batch wall time)."""
         geometry = self._geometry(src_hw)
         key = (model, geometry, bucket)
         cell = self._cells.get(key)
@@ -387,6 +422,16 @@ class PerfTracker:
         if util is not None:
             cell.mfu.set(util)
             cell.tflops.set(flops / (cell.ema_ms * 1e-3) / 1e12)
+        if shard_frames:
+            for shard, n in shard_frames.items():
+                skey = (model, bucket, str(shard))
+                scell = self._shard_cells.get(skey)
+                if scell is None:
+                    scell = self._make_shard_cell(skey)
+                scell.frames_child.inc(int(n))
+                scell.busy_child.inc(device_ms)
+                scell.frames += int(n)
+                scell.busy_ms += float(device_ms)
         now = self._clock()
         self._fps.add(streams if streams is not None else frames, now)
         self._m_fps.set(self._fps.rate(now))
@@ -514,6 +559,16 @@ class PerfTracker:
         with self._lock:
             return self._h2d.setdefault(key, cell)
 
+    def _make_shard_cell(self, key: Tuple[str, int, str]) -> _ShardCell:
+        model, bucket, shard = key
+        cell = _ShardCell(
+            frames_child=self._m_shard_frames.labels(
+                model, str(bucket), shard),
+            busy_child=self._m_shard_busy.labels(model, str(bucket), shard),
+        )
+        with self._lock:
+            return self._shard_cells.setdefault(key, cell)
+
     def _make_cell(self, key: Tuple[str, str, int]) -> _BatchCell:
         model, _geometry, bucket = key
         b = str(bucket)
@@ -555,6 +610,13 @@ class PerfTracker:
                                         2) if slots else 0.0,
                     "mfu_pct": round(util, 3) if util is not None else None,
                 })
+            shards = [
+                {"model": model, "bucket": bucket, "shard": shard,
+                 "frames": scell.frames,
+                 "busy_ms": round(scell.busy_ms, 3)}
+                for (model, bucket, shard), scell in sorted(
+                    self._shard_cells.items())
+            ]
             h2d = []
             h2d_seconds = 0.0
             h2d_hidden = 0.0
@@ -586,6 +648,8 @@ class PerfTracker:
             "h2d_hidden_pct": (round(100.0 * h2d_hidden / h2d_seconds, 1)
                                if h2d_seconds > 0 else None),
         }
+        if shards:
+            out["shards"] = shards
         with self._lock:
             roi = dict(self._roi)
         gated = roi["idle"] + roi["roi"] + roi["full"]

@@ -10,8 +10,8 @@ from .distributed import initialize as initialize_distributed
 from .mesh import AXES, factor_mesh, make_mesh, single_device_mesh
 from .ring_attention import make_ring_attn_fn, ring_attention_local
 from .sharding import (
-    DEFAULT_RULES, batch_sharding, param_shardings, place_params, replicated,
-    unbox,
+    DEFAULT_RULES, assemble_sharded, batch_sharding, param_shardings,
+    place_params, replicated, shard_put, unbox,
 )
 from .train import (
     TrainState, Trainer, cross_entropy_loss, make_trainer,
@@ -24,8 +24,8 @@ __all__ = [
     "initialize_distributed", "pipeline",
     "make_ring_attn_fn", "ring_attention_local",
     "make_ulysses_attn_fn", "ulysses_attention_local",
-    "DEFAULT_RULES", "batch_sharding", "param_shardings", "place_params",
-    "replicated", "unbox",
+    "DEFAULT_RULES", "assemble_sharded", "batch_sharding", "param_shardings",
+    "place_params", "replicated", "shard_put", "unbox",
     "TrainState", "Trainer", "cross_entropy_loss", "make_trainer",
     "with_ring_attention", "with_ulysses_attention",
 ]

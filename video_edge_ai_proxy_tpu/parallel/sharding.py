@@ -44,6 +44,47 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def shard_put(frames: Any, sharding: NamedSharding):
+    """Sharded H2D with one async ``device_put`` per mesh slice.
+
+    ``jax.device_put(host_array, NamedSharding)`` routes through a single
+    synchronous transfer path on several backends; issuing one per-slice
+    ``device_put`` lets every chip's DMA engine pull its own slice
+    concurrently, and ``make_array_from_single_device_arrays`` stitches
+    the committed pieces back into one global array with the requested
+    sharding (no data movement). Slices of a C-contiguous host array
+    along the leading (batch) axis are themselves contiguous views, so
+    each transfer is a single flat copy. Falls back to the plain put when
+    the sharding cannot enumerate per-device index maps."""
+    try:
+        dmap = sharding.addressable_devices_indices_map(frames.shape)
+    except Exception:
+        return jax.device_put(frames, sharding)
+    arrs = [jax.device_put(frames[idx], d) for d, idx in dmap.items()]
+    return jax.make_array_from_single_device_arrays(
+        frames.shape, sharding, arrs)
+
+
+def assemble_sharded(pieces: Any, shape: tuple, sharding: NamedSharding):
+    """Stitch per-shard single-device arrays into one global dp-sharded
+    array with NO data movement on the common dp-only mesh.
+
+    ``pieces[s]`` is shard s's batch segment (``shape[0]/len(pieces)``
+    rows) already committed on that shard's primary device — e.g. a
+    per-shard state-pool gather. When an extra mesh axis replicates the
+    batch block over several devices, the piece is device_put to the
+    replicas (device-to-device)."""
+    seg = shape[0] // max(1, len(pieces))
+    arrs = []
+    for d, idx in sharding.addressable_devices_indices_map(shape).items():
+        s = (idx[0].start or 0) // seg if seg else 0
+        piece = pieces[s]
+        if d not in piece.devices():
+            piece = jax.device_put(piece, d)
+        arrs.append(piece)
+    return jax.make_array_from_single_device_arrays(shape, sharding, arrs)
+
+
 def unbox(params: Any) -> Any:
     """Strip nn.Partitioned boxes (for code that wants raw arrays)."""
     return nn.meta.unbox(params)

@@ -79,7 +79,7 @@ def _pct(values, points=(50, 90, 95, 99)) -> Optional[dict]:
 def lockstep_checksum(
     trace_path: str, *, model: str = "tiny_yolov8",
     device_id: Optional[str] = None, limit: int = 0,
-    perturb=None, zero_prior: bool = True,
+    perturb=None, zero_prior: bool = True, mesh=None,
 ) -> dict:
     """Replay a trace deterministically through bus -> collector ->
     serving step and fold the content checksum over every emitted batch.
@@ -89,7 +89,12 @@ def lockstep_checksum(
     latest-wins can never drop a frame — replay order is trace order and
     the fold is exact, not racy. ``perturb(variables) -> variables`` is
     the seeded-fault hook (tests perturb one weight and the checksum must
-    move). Returns {"checksum", "frames", "batches", "model"}.
+    move). ``mesh`` (r17) places every batch dp-sharded through the
+    mesh-serving H2D path (parallel.shard_put) instead of a plain
+    transfer — at dp=1 the checksum must stay bit-identical to the
+    single-chip golden, the smoke gate pinning mesh-native serving to
+    the exact same numerics. Returns {"checksum", "frames", "batches",
+    "model"}.
     """
     import jax
     import jax.numpy as jnp
@@ -128,8 +133,15 @@ def lockstep_checksum(
             frames += 1
             for group in col.collect():
                 batches += 1
-                part = int(np.asarray(step(
-                    variables, jnp.asarray(group.frames))))
+                if mesh is not None:
+                    from ..parallel import batch_sharding, shard_put
+
+                    placed = shard_put(
+                        np.ascontiguousarray(group.frames),
+                        batch_sharding(mesh, group.frames.ndim))
+                else:
+                    placed = jnp.asarray(group.frames)
+                part = int(np.asarray(step(variables, placed)))
                 carry = (carry + part) & CHECKSUM_MASK
     finally:
         bus.close()

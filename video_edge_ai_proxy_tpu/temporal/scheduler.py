@@ -102,6 +102,11 @@ class CascadeScheduler:
             threshold=threshold, enter_n=enter_n, exit_n=exit_n)
         self._pool: Optional[TrackStatePool] = None
         self._packer = None
+        # Mesh-native serving (engine.configure_mesh before first
+        # harvest): _resolve builds a dp-sharded pool instead.
+        self._mesh = None
+        self._mesh_shards = 1
+        self._mesh_shard_of = None
         self.side = 0
         self.clip_len = 0
         self.ticks = 0
@@ -115,6 +120,17 @@ class CascadeScheduler:
         self.head: Optional[Callable] = None
 
     # -- lazy geometry (registry imports jax; CLAUDE.md lazy-import rule) --
+
+    def configure_mesh(self, *, mesh, shards: int, shard_of) -> None:
+        """Engine wiring (before the first harvest resolves geometry):
+        clip rings become per-shard device pools so each chip's cascade
+        state lives where its streams are served. ``shard_of`` maps a
+        STREAM id to its dp shard (engine/collector.stream_shard); track
+        keys are ``stream#track_id`` so the pool wrapper strips the
+        track suffix before routing."""
+        self._mesh = mesh
+        self._mesh_shards = max(1, int(shards))
+        self._mesh_shard_of = shard_of
 
     def _resolve(self) -> None:
         if self._pool is not None:
@@ -133,7 +149,24 @@ class CascadeScheduler:
         self._packer = CanvasPacker(
             side=self.side, gap=0, max_canvases=1,
             min_crop=min(16, self.side))
-        self._pool = TrackStatePool(self.side, self.clip_len)
+        if self._mesh is not None:
+            # Any mesh (even dp=1) takes the sharded pool so the head
+            # batch carries the mesh sharding the compiled program
+            # expects (a committed single-device array would force a
+            # second program variant).
+            from .state_pool import ShardedTrackStatePool
+
+            stream_shard_of = self._mesh_shard_of
+
+            def _key_shard(key: str) -> int:
+                return stream_shard_of(key.split("#", 1)[0])
+
+            self._pool = ShardedTrackStatePool(
+                self.side, self.clip_len, mesh=self._mesh,
+                shards=self._mesh_shards, shard_of=_key_shard,
+                buckets=BUCKETS)
+        else:
+            self._pool = TrackStatePool(self.side, self.clip_len)
 
     # -- stream-keyed dict protocol (engine GC union membership) -----------
 
@@ -250,9 +283,18 @@ class CascadeScheduler:
                 due = [k for k in self._tracks if self._pool.full(k)]
                 due = due[:BUCKETS[-1]]
                 if due:
-                    bucket = bucket_for(len(due))
-                    slot_idx, time_idx = self._pool.gather_indices(
-                        due, bucket)
+                    plan = getattr(self._pool, "plan", None)
+                    if plan is not None:
+                        # Sharded pool: shard-segmented batch layout.
+                        # due_rows[i] = global row of due[i]; -1 means
+                        # that shard's segment overflowed — the track
+                        # stays full and rides the next cadence tick.
+                        slot_idx, time_idx, due_rows, _ = plan(due)
+                    else:
+                        due_rows = None
+                        bucket = bucket_for(len(due))
+                        slot_idx, time_idx = self._pool.gather_indices(
+                            due, bucket)
                     pool = self._pool
         if due:
             # Head dispatch OUTSIDE the lock: compile on a cache miss
@@ -273,10 +315,13 @@ class CascadeScheduler:
                     if self.perf is not None:
                         self.perf.note_cascade_head(len(due))
                     for i, key in enumerate(due):
+                        row = due_rows[i] if due_rows is not None else i
+                        if row < 0:           # dropped by the shard plan
+                            continue
                         rec = self._tracks.get(key)
                         if rec is None:       # expired mid-dispatch
                             continue
-                        score = float(outputs["event_score"][i])
+                        score = float(outputs["event_score"][row])
                         rec.last_score = score
                         rec.observed += 1
                         head_tracks.append((rec.stream, rec.meta))
@@ -290,9 +335,9 @@ class CascadeScheduler:
                             "score": score,
                             "tick": tick,
                             "features": [float(v)
-                                         for v in outputs["features"][i]],
+                                         for v in outputs["features"][row]],
                             "logits": [float(v)
-                                       for v in outputs["logits"][i]],
+                                       for v in outputs["logits"][row]],
                             "meta": rec.meta,
                             "history": (list(rec.history)
                                         if kind == "enter" else []),

@@ -36,12 +36,17 @@ class TrackStatePool:
 
     _GROW = 8
 
-    __slots__ = ("side", "clip_len", "_slots", "_free", "_cursor", "_fill",
-                 "_pool", "_capacity", "_high")
+    __slots__ = ("side", "clip_len", "device", "_slots", "_free", "_cursor",
+                 "_fill", "_pool", "_capacity", "_high")
 
-    def __init__(self, side: int, clip_len: int):
+    def __init__(self, side: int, clip_len: int, device=None):
         self.side = int(side)
         self.clip_len = int(clip_len)
+        # Mesh-sharded serving: each shard's sub-pool commits its ring to
+        # that shard's chip, so scatter/gather traffic stays local to the
+        # chip that serves the shard's streams. None = default placement
+        # (single-chip behavior unchanged).
+        self.device = device
         self._slots: Dict[str, int] = {}      # track key -> row (>= 1)
         self._free: List[int] = []
         self._cursor: Dict[int, int] = {}     # row -> next write position
@@ -107,6 +112,12 @@ class TrackStatePool:
                    // self._GROW) * self._GROW
             self._pool = jnp.zeros(
                 (cap, self.clip_len, self.side, self.side, 3), jnp.uint8)
+            if self.device is not None:
+                import jax
+
+                # Committed arrays stay put: every later .at[].set / pad
+                # keeps the ring on this shard's chip.
+                self._pool = jax.device_put(self._pool, self.device)
             self._capacity = cap
         elif need > self._capacity:
             grow = ((need - self._capacity + self._GROW - 1)
@@ -188,3 +199,155 @@ class TrackStatePool:
         clips = jnp.take(self._pool, jnp.asarray(slot_idx), axis=0)
         t = jnp.asarray(time_idx)[:, :, None, None, None]
         return jnp.take_along_axis(clips, t, axis=1)
+
+
+def shard_devices(mesh, shards: int) -> list:
+    """Primary device per dp index: shard s's pools commit here. With
+    extra mesh axes the dp block spans several devices; the first is the
+    primary (assemble_sharded replicates to the rest on demand)."""
+    axis = list(mesh.axis_names).index("dp")
+    blocks = np.moveaxis(np.asarray(mesh.devices), axis, 0)
+    blocks = blocks.reshape(shards, -1)
+    return [blocks[s][0] for s in range(shards)]
+
+
+class ShardedTrackStatePool:
+    """dp-sharded twin of TrackStatePool for mesh-native cascade serving.
+
+    One sub-ring per mesh shard, committed to that shard's chip, so a
+    track's clip state lives where its stream is served (streams are
+    pinned to shards by ``engine.collector.stream_shard``). Presents the
+    same dict-protocol + scatter/gather surface the scheduler and the
+    engine GC already consume, plus :meth:`plan` — the shard-segmented
+    head-batch layout (the scheduler maps head outputs back through the
+    returned rows). ``gather`` stitches the per-shard sub-gathers into
+    one dp-sharded device batch (``parallel.sharding.assemble_sharded``)
+    so the cascade head program reads every chip's clips locally — the
+    state pool never migrates clips between chips and never round-trips
+    them through the host.
+    """
+
+    def __init__(self, side: int, clip_len: int, *, mesh, shards: int,
+                 shard_of, buckets: Sequence[int] = (4, 8, 16, 32, 64)):
+        self.side = int(side)
+        self.clip_len = int(clip_len)
+        self.mesh = mesh
+        self.shards = max(1, int(shards))
+        self._shard_of = shard_of            # track key -> shard index
+        self._buckets = tuple(
+            sorted(b for b in buckets if b % self.shards == 0)
+        ) or (self.shards,)
+        self.pools = [TrackStatePool(side, clip_len, device=d)
+                      for d in shard_devices(mesh, self.shards)]
+
+    # -- dict-protocol surface (same as TrackStatePool) --------------------
+
+    def _pool_for(self, key: str) -> TrackStatePool:
+        return self.pools[self._shard_of(key)]
+
+    def __bool__(self) -> bool:
+        return any(len(p) for p in self.pools)
+
+    def __len__(self) -> int:
+        return sum(len(p) for p in self.pools)
+
+    def __iter__(self):
+        for p in self.pools:
+            yield from p
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._pool_for(key)
+
+    def pop(self, key: str, default=None):
+        return self._pool_for(key).pop(key, default)
+
+    @property
+    def high_water(self) -> int:
+        return max(p.high_water for p in self.pools)
+
+    def slots_in_use(self) -> int:
+        return sum(p.slots_in_use() for p in self.pools)
+
+    @property
+    def array(self):
+        """Per-shard device arrays (None before first scatter)."""
+        return [p.array for p in self.pools]
+
+    def full(self, key: str) -> bool:
+        return self._pool_for(key).full(key)
+
+    # -- sharded scatter / gather ------------------------------------------
+
+    def scatter(self, keys: Sequence[str], tiles: np.ndarray,
+                bucket: Optional[int] = None) -> int:
+        """Route each track's tile to its shard's sub-ring. ``bucket``
+        (the caller's aggregate pad target) is recomputed PER SHARD from
+        the bucket ladder — each chip's scatter program stays
+        shape-stable independently."""
+        per: List[list] = [[] for _ in range(self.shards)]
+        for i, key in enumerate(keys):
+            per[self._shard_of(key)].append((i, key))
+        cap = self._buckets[-1] // self.shards
+        aux = 0
+        for s, entries in enumerate(per):
+            if not entries:
+                continue
+            entries = entries[:cap]
+            sub_keys = [k for _, k in entries]
+            sub_tiles = tiles[[i for i, _ in entries]]
+            sub_bucket = next(
+                (b for b in self._buckets
+                 if b // self.shards >= len(entries)), None)
+            aux += self.pools[s].scatter(
+                sub_keys, sub_tiles,
+                bucket=(sub_bucket // self.shards) if sub_bucket else None)
+        return aux
+
+    def plan(self, keys: Sequence[str]):
+        """Shard-segmented head-batch layout for ``keys`` (due tracks):
+        ``(slot_idx [B], time_idx [B, T], rows, B)``. ``rows[i]`` is the
+        global batch row of ``keys[i]`` (-1 = dropped: that shard's
+        segment overflowed the largest bucket; the track stays due and
+        rides the next cadence). Padded rows gather each sub-ring's
+        permanent-zero row 0."""
+        S = self.shards
+        per: List[list] = [[] for _ in range(S)]
+        rows = [-1] * len(keys)
+        cap = self._buckets[-1] // S
+        for i, key in enumerate(keys):
+            s = self._shard_of(key)
+            if len(per[s]) < cap:
+                per[s].append((i, key))
+        need = max((len(p) for p in per), default=0) or 1
+        bucket = next(b for b in self._buckets if b // S >= need)
+        seg = bucket // S
+        T = self.clip_len
+        slot_idx = np.zeros((bucket,), np.int32)
+        time_idx = np.zeros((bucket, T), np.int32)
+        for s, entries in enumerate(per):
+            if not entries:
+                continue
+            sub_slot, sub_time = self.pools[s].gather_indices(
+                [k for _, k in entries], seg)
+            slot_idx[s * seg:(s + 1) * seg] = sub_slot
+            time_idx[s * seg:(s + 1) * seg] = sub_time
+            for j, (i, _key) in enumerate(entries):
+                rows[i] = s * seg + j
+        return slot_idx, time_idx, rows, bucket
+
+    def gather(self, slot_idx: np.ndarray, time_idx: np.ndarray):
+        """dp-sharded clips ``[B, T, side, side, 3] uint8``: per-shard
+        local gathers stitched with no cross-chip movement."""
+        from ..parallel.sharding import assemble_sharded, batch_sharding
+
+        bucket = int(slot_idx.shape[0])
+        seg = bucket // self.shards
+        pieces = []
+        for s, pool in enumerate(self.pools):
+            if pool.array is None:
+                pool._ensure(0)   # committed zero ring (idle shard)
+            pieces.append(pool.gather(
+                slot_idx[s * seg:(s + 1) * seg],
+                time_idx[s * seg:(s + 1) * seg]))
+        shape = (bucket, self.clip_len, self.side, self.side, 3)
+        return assemble_sharded(pieces, shape, batch_sharding(self.mesh, 5))

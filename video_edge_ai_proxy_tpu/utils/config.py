@@ -230,8 +230,9 @@ class EngineConfig:
     prof_max_ms: int = 10_000          # cap on ?ms= (400 above this)
     # Output-quality observability (obs/quality.py): device-computed
     # per-frame luma mean/variance + inter-frame diff energy folded into
-    # the serving step (ops/preprocess.py frame_quality_stats; single-chip
-    # only — the mesh path doesn't shard the thumbnail state yet), host
+    # the serving step (ops/preprocess.py frame_quality_stats; under
+    # engine.mesh the thumbnail carry state is dp-sharded per slice —
+    # runner._ShardedThumbPool — so quality rides the mesh path too), host
     # black/frozen/flatline verdict state machines with time hysteresis,
     # detection drift scoring, and the degradation ladder's first-shed
     # set. quality=False disables the subsystem and /api/v1/quality
