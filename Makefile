@@ -4,7 +4,7 @@
 .PHONY: all proto native install test bench graft clean redis-conformance \
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
 	roi-smoke fleet-obs-smoke stem-smoke router-smoke cascade-smoke \
-	capacity-smoke autoscale-smoke multichip-serve-smoke
+	capacity-smoke autoscale-smoke multichip-serve-smoke hbm-smoke
 
 all: proto native
 
@@ -221,6 +221,28 @@ capacity-smoke:
 			   d['forecast']['tts_first_s'], d['forecast']['tts_last_s'], \
 			   d['admission']['storm_by_member'], \
 			   d['admission']['saturating_member_admissions']))"
+
+# HBM attribution acceptance (round 21): track-churn pool exactness
+# (aggregate + per-shard under dp=2) across a grow-by-8 ring
+# reallocation, fake-clock OOM forecast monotonicity, a memory-blind
+# admission storm the byte-exhausted member must survive untouched, and
+# the hbm=False bit-exactness replay pin. Gates live in
+# tools/hbm_smoke.py and exit non-zero on breach; the committed
+# HBM_r01.json artifact is a pinned run of this tool. ~30 s.
+hbm-smoke:
+	python tools/hbm_smoke.py | tee /tmp/vep_hbm_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_hbm_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); \
+		print('hbm: pool delta %d B (shard %s), ring %d growth events, tto %.0fs->%.0fs monotone=%s, storm %s (exhausted member: %d admissions), hbm-off bitexact=%s' \
+		% (d['pools']['max_abs_delta_bytes'], \
+		   d['pools']['dp2']['shard_max_abs_delta_bytes'], \
+		   d['pools']['aggregate']['ring_growth_events'], \
+		   d['forecast']['tto_first_s'], d['forecast']['tto_last_s'], \
+		   d['forecast']['tto_monotone_decreasing'], \
+		   d['admission']['storm_by_member'], \
+		   d['admission']['exhausted_member_placements'], \
+		   d['replay']['hbm_off_bitexact']))"
 
 autoscale-smoke:
 	python tools/autoscale_smoke.py | tee /tmp/vep_autoscale_smoke.json

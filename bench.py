@@ -375,17 +375,28 @@ def main() -> None:
     # (BASELINE.md "Live vs offline MFU" table). Cost analysis may be
     # unsupported on a backend: report nulls, never fail the bench.
     from video_edge_ai_proxy_tpu.obs.perf import (
-        DEFAULT_PEAK_TFLOPS, cost_summary, mfu_pct,
+        DEFAULT_PEAK_TFLOPS, cost_summary, memory_summary, mfu_pct,
     )
 
     step_flops = 0.0
+    hbm_temp_bytes = None
     try:
-        step_flops = cost_summary(
-            jax.jit(one_batch).lower(base_dev).compile()
-        ).get("flops", 0.0)
+        compiled_step = jax.jit(one_batch).lower(base_dev).compile()
+        step_flops = cost_summary(compiled_step).get("flops", 0.0)
+        # r21 memory attribution: the single-batch serving program's XLA
+        # workspace high-water mark — the static footprint obs/hbm.py
+        # ledgers per program at engine compile time.
+        hbm_temp_bytes = memory_summary(compiled_step).get("temp_bytes")
     except Exception:
         pass
     live_mfu = mfu_pct(step_flops, batch_ms, DEFAULT_PEAK_TFLOPS)
+
+    # r21 pool attribution: bytes the bench's device-resident carries pin
+    # across ticks — the quality thumb ring plus the cascade clip pool —
+    # mirroring the engine's registered vep_hbm_pool_bytes surfaces.
+    hbm_pool_bytes = streams * 32 * 32 * 4          # f32 quality thumbs
+    if cas_T:
+        hbm_pool_bytes += streams * cas_T * cas_side * cas_side * 3
 
     # Golden gate: pinned inputs + pinned weights must reproduce the
     # committed content checksum bit-exactly (replay/goldens.json). A
@@ -437,6 +448,12 @@ def main() -> None:
                         if step_flops and batch_ms else None),
         "live_mfu_pct": round(live_mfu, 2) if live_mfu is not None else None,
         "peak_tflops": DEFAULT_PEAK_TFLOPS,
+        # r21 memory observability: static program workspace (XLA temp
+        # high-water of the single-batch serving program) and the bench's
+        # device-resident carry pools, the committed cross-check for the
+        # engine's live vep_hbm_* families.
+        "hbm_program_temp_bytes": hbm_temp_bytes,
+        "hbm_pool_bytes": hbm_pool_bytes,
         "checksum": total,
         "checksum_key": golden_key,
         "checksum_golden": golden,

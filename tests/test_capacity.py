@@ -134,6 +134,33 @@ class TestLedgerConservation:
         assert rows["idle1"]["by_kind"] == {"coast": 0.0}
         assert cap.conservation()["measured_ms"] == 0.0
 
+    def test_departed_stream_expires_without_breaking_conservation(self):
+        """r21 satellite: a stream idle past the slow window drops from
+        the per-stream map (bounded ledger memory under churn), while
+        the conservation counters — running totals, independent of the
+        map — stay balanced across the expiry."""
+        cap, clock = make_tracker()          # slow_window_s=100
+        cap.note_batch("det", (64, 64), 2, 10.0, ["gone", "live"])
+        clock.now += 150.0                   # "gone" never seen again
+        cap.note_batch("det", (64, 64), 1, 5.0, ["live"])
+        cap.evaluate(force=True)
+        rows = cap.streams()
+        assert "gone" not in rows
+        assert rows["live"]["device_ms"] == pytest.approx(10.0)
+        cons = cap.conservation()
+        assert cons["balanced"] is True
+        assert cons["measured_ms"] == pytest.approx(15.0)
+        assert cons["attributed_ms"] == pytest.approx(15.0)
+        snap = cap.snapshot()
+        assert snap["expired"]["streams"] == 1
+        assert snap["expired"]["device_ms"] == pytest.approx(5.0)
+        # A coast touch counts as liveness: "live" survives the sweep.
+        clock.now += 90.0
+        cap.note_coast(["live"])
+        clock.now += 20.0
+        cap.evaluate(force=True)
+        assert "live" in cap.streams()
+
 
 # ---------------------------------------------------------------------------
 # forecast math

@@ -337,6 +337,117 @@ class TestCapacityFleetMerge:
 
 
 # ---------------------------------------------------------------------------
+# HBM plane in the fleet merge (r21 satellite)
+
+
+def _hbm_member_page(instance: str) -> str:
+    """A member exposition with live vep_hbm_* families (registered and
+    driven by a real HbmTracker — the lint check covers what the plane
+    actually renders, including the sharded pool label)."""
+    from video_edge_ai_proxy_tpu.obs.hbm import HbmTracker
+
+    r = Registry()
+    r.set_const_labels(instance=instance)
+    r.counter("vep_frames_total", "frames", ("stream",)).labels(
+        "cam1").inc(2)
+    hbm = HbmTracker(budget_bytes=1_000_000, fast_window_s=10.0,
+                     slow_window_s=100.0, eval_interval_s=0.0,
+                     clock=lambda: 1000.0, registry=r)
+    hbm.register_pool("thumbs", lambda: 4096)
+    hbm.register_pool("track_state", lambda: {"0": 100, "1": 300})
+    hbm.note_program("det", (64, 64), 4, {
+        "argument_bytes": 100, "output_bytes": 50, "temp_bytes": 30,
+        "code_bytes": 10, "alias_bytes": 20})
+    hbm.evaluate(force=True)
+    return r.render()
+
+
+def _hbm_snapshot():
+    return {"budget_bytes": 1_000_000, "used_bytes": 300_000,
+            "utilization": {"fast": 0.3, "slow": 0.3},
+            "burn": {"fast": 0.333, "slow": 0.333}, "burning": False,
+            "headroom_bytes": 700_000, "time_to_oom_s": 240.0,
+            "pressure": False}
+
+
+class TestHbmFleetMerge:
+    def _agg(self):
+        """m0 reports the HBM plane, m1 does not (pre-r21 member /
+        hbm=False): the mixed-version fleet must merge cleanly with -1
+        sentinels, never a fake zero that would read as OOM-now."""
+        agg = FleetAggregator(
+            ["m0=http://127.0.0.1:1", "m1=http://127.0.0.1:1"],
+            scrape_interval_s=0.2)
+        _seed_member(agg._members[0], _hbm_member_page("m0"), streams=2)
+        agg._members[0].hbm = _hbm_snapshot()
+        _seed_member(agg._members[1], _member_page("m1", 5, 0), streams=1)
+        return agg
+
+    def test_mixed_version_health_rows(self):
+        health = {h["instance"]: h for h in self._agg().health()}
+        m0, m1 = health["m0"], health["m1"]
+        assert m0["hbm"] is True
+        assert m0["hbm_headroom_bytes"] == 700_000
+        assert m0["hbm_utilization"] == pytest.approx(0.3)
+        assert m0["time_to_oom_s"] == pytest.approx(240.0)
+        # The hbm-less peer merges with None signals: the router treats
+        # it as memory-blind (admitting on time alone), never as full.
+        assert m1["hbm"] is False
+        assert m1["hbm_headroom_bytes"] is None
+        assert m1["hbm_utilization"] is None
+        assert m1["time_to_oom_s"] is None
+
+    def test_merged_exposition_hbm_families_lint_clean(self):
+        text = self._agg().merged_exposition()
+        assert lint_exposition(text) == []
+        # Member-side vep_hbm_* samples survive the merge with their
+        # instance label...
+        assert ('vep_hbm_pool_bytes{instance="m0",pool="track_state"}'
+                ' 400') in text
+        assert 'vep_hbm_used_bytes{instance="m0"}' in text
+        assert 'vep_hbm_donated_saved_bytes{instance="m0"} 20' in text
+        # ...and the fleet-level member-HBM gauges render with the -1
+        # unreported sentinel for the hbm-less peer.
+        assert ('vep_fleet_member_hbm_headroom_bytes{instance="m0"} '
+                '700000') in text
+        assert ('vep_fleet_member_hbm_headroom_bytes{instance="m1"} '
+                '-1') in text
+        assert ('vep_fleet_member_time_to_oom_seconds{instance="m1"} '
+                '-1') in text
+
+    def test_scrape_tolerates_missing_hbm_endpoint(self):
+        """A member whose /api/v1/hbm answers 400 (plane disabled) or
+        404 (pre-r21 build) keeps scraping clean: metrics/stats/slo
+        land, hbm stays empty."""
+        agg = FleetAggregator(["m0=http://127.0.0.1:1"],
+                              scrape_interval_s=0.2)
+        pages = {
+            "/metrics": _member_page("m0", 1, 0).encode(),
+            "/api/v1/stats": json.dumps(
+                {"engine": {"streams": {}}}).encode(),
+            "/api/v1/slo": json.dumps({"burning": False}).encode(),
+            "/api/v1/capacity": json.dumps({"headroom": 0.5}).encode(),
+        }
+
+        def fetch(url):
+            for suffix, body in pages.items():
+                if url.endswith(suffix):
+                    return body
+            raise OSError("HTTP 400: hbm plane disabled")
+
+        agg._fetch = fetch
+        agg.scrape_once()
+        m0 = agg._members[0]
+        assert m0.alive is True
+        assert m0.hbm == {}
+        row = {h["instance"]: h for h in agg.health()}["m0"]
+        assert row["up"] is True
+        assert row["hbm"] is False and row["hbm_headroom_bytes"] is None
+        # The capacity plane it DOES report still lands.
+        assert row["headroom"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
 # Warming member state (r19): scraped-alive but prewarm incomplete
 
 

@@ -1,0 +1,512 @@
+"""HBM attribution plane tests (obs/hbm.py, r21): the peak-ring window
+model, per-program footprint aggregation (donated-aliasing credit,
+recompile overwrite), the register_pool exactness protocol (int and
+sharded dict shapes, error isolation), the EWMA time_to_oom_s forecast,
+the /api/v1/hbm endpoint convention, the resilience-ladder hbm_pressure
+wire, and the hbm=False bit-identical replay pin.
+
+All tracker tests run sleep-free on an injected clock and a private
+Registry (the tests/test_capacity.py conventions); the engine tests
+hand-step ticks exactly like tests/test_cascade.py."""
+
+import json
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+from video_edge_ai_proxy_tpu.obs.hbm import (
+    DEFAULT_SYNTHETIC_BUDGET_BYTES, HbmTracker, _PeakRing)
+from video_edge_ai_proxy_tpu.obs.metrics import Registry, lint_exposition
+from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = float(now)
+
+    def __call__(self):
+        return self.now
+
+
+def make_tracker(**kw):
+    clock = FakeClock(kw.pop("now", 1000.0))
+    kw.setdefault("budget_bytes", 1_000_000)
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("slow_window_s", 100.0)
+    kw.setdefault("eval_interval_s", 0.0)
+    hbm = HbmTracker(clock=clock, registry=Registry(), **kw)
+    return hbm, clock
+
+
+# ---------------------------------------------------------------------------
+# peak ring
+
+
+class TestPeakRing:
+    def test_window_peak_and_epoch_reuse(self):
+        ring = _PeakRing(span_s=10.0, bin_s=1.0)
+        for t, v in enumerate((100.0, 900.0, 200.0, 50.0)):
+            ring.record(v, now=float(t))
+        # Memory is a level: the window carries the MAX, never a sum.
+        assert ring.peak(window_s=10.0, now=3.0) == pytest.approx(900.0)
+        assert ring.peak(window_s=1.5, now=3.0) == pytest.approx(200.0)
+        # A bin re-claimed one lap later resets lazily — the stale peak
+        # from the previous epoch must not leak into the new window.
+        ring.record(7.0, now=100.0)
+        assert ring.peak(window_s=10.0, now=100.0) == pytest.approx(7.0)
+
+    def test_same_bin_keeps_high_water(self):
+        ring = _PeakRing(span_s=4.0, bin_s=1.0)
+        ring.record(5.0, now=3.2)
+        ring.record(2.0, now=3.9)            # lower sample, same bin
+        assert ring.peak(window_s=4.0, now=3.9) == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# program footprints
+
+
+def _summary(argument=100, output=50, temp=30, code=10, alias=0):
+    return {"argument_bytes": argument, "output_bytes": output,
+            "temp_bytes": temp, "code_bytes": code, "alias_bytes": alias}
+
+
+class TestProgramFootprints:
+    def test_code_sums_workspace_takes_max(self):
+        hbm, _ = make_tracker()
+        hbm.note_program("det", (64, 64), 4, _summary(code=10, temp=30))
+        hbm.note_program("det", (64, 64), 8,
+                         _summary(argument=500, temp=100, code=25))
+        used = hbm.evaluate(force=True)["used_bytes"]
+        # Programs execute serially: resident = Σ code + MAX single
+        # workspace (650), never the sum of both workspaces (830).
+        assert used == (10 + 25) + (500 + 50 + 100)
+
+    def test_donated_aliasing_credited(self):
+        hbm, _ = make_tracker()
+        hbm.note_program("det", (64, 64), 4,
+                         _summary(argument=400, output=400, alias=400))
+        progs = hbm.programs()
+        row = progs["det|classic|64x64|4|-"]
+        assert row["alias_bytes"] == 400
+        # workspace = arg + out + temp - alias, floored at 0.
+        assert row["workspace_bytes"] == 400 + 30
+        snap = hbm.snapshot()
+        assert snap["donated_saved_bytes"] == 400
+
+    def test_recompile_same_key_overwrites_not_accumulates(self):
+        hbm, _ = make_tracker()
+        hbm.note_program("det", (64, 64), 4, _summary(code=10))
+        hbm.note_program("det", (64, 64), 4, _summary(code=12))
+        progs = hbm.programs()
+        assert len(progs) == 1
+        row = progs["det|classic|64x64|4|-"]
+        assert row["code_bytes"] == 12       # resident programs, not history
+        assert row["compiles"] == 2
+
+    def test_mesh_and_stem_split_the_key(self):
+        hbm, _ = make_tracker()
+        hbm.note_program("det", (64, 64), 4, _summary())
+        hbm.note_program("det", (64, 64), 4, _summary(), stem="s2d")
+        hbm.note_program("det", (64, 64), 4, _summary(), mesh="dp2")
+        assert set(hbm.programs()) == {
+            "det|classic|64x64|4|-", "det|s2d|64x64|4|-",
+            "det|classic|64x64|4|dp2"}
+
+    def test_empty_summary_ignored(self):
+        hbm, _ = make_tracker()
+        hbm.note_program("det", (64, 64), 4, {})
+        assert hbm.programs() == {}
+
+
+# ---------------------------------------------------------------------------
+# pool ledger
+
+
+class TestPoolLedger:
+    def test_int_and_sharded_dict_shapes(self):
+        hbm, _ = make_tracker()
+        hbm.register_pool("thumbs", lambda: 4096)
+        hbm.register_pool("track_state", lambda: {"0": 100, "1": 300})
+        pools = hbm.pools()
+        assert pools["total"] == 4096 + 400
+        assert pools["pools"]["thumbs"] == {"bytes": 4096, "shards": None}
+        assert pools["pools"]["track_state"]["bytes"] == 400
+        assert pools["pools"]["track_state"]["shards"] == {"0": 100,
+                                                           "1": 300}
+
+    def test_reregister_replaces_callable(self):
+        hbm, _ = make_tracker()
+        hbm.register_pool("thumbs", lambda: 1)
+        hbm.register_pool("thumbs", lambda: 2)   # sharded warmup swap
+        pools = hbm.pools()
+        assert pools["pools"]["thumbs"]["bytes"] == 2
+        assert pools["total"] == 2
+
+    def test_raising_pool_reads_zero_with_error_row(self):
+        hbm, _ = make_tracker()
+        hbm.register_pool("good", lambda: 10)
+        hbm.register_pool("bad", lambda: 1 / 0)
+        pools = hbm.pools()
+        assert pools["total"] == 10              # forecast degrades...
+        assert "ZeroDivisionError" in pools["pools"]["bad"]["error"]
+        # ...and evaluate (the tick-thread caller) survives too.
+        assert hbm.evaluate(force=True)["used_bytes"] == 10
+
+    def test_live_callable_tracks_pool_mutation(self):
+        hbm, _ = make_tracker()
+        holder = [128]
+        hbm.register_pool("ring", lambda: holder[0])
+        assert hbm.pools()["total"] == 128
+        holder[0] = 4096                          # grow-by-8 reallocation
+        assert hbm.pools()["total"] == 4096
+        holder[0] = 0                             # pool released
+        assert hbm.pools()["total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# budget + forecast
+
+
+class TestForecast:
+    def test_ramp_produces_falling_monotone_tto(self):
+        hbm, clock = make_tracker(budget_bytes=1_000_000)
+        holder = [0]
+        hbm.register_pool("ramp", lambda: holder[0])
+        series = []
+        for t in range(1, 121):
+            clock.now = 1000.0 + t
+            holder[0] = 4000 * t                 # linear allocation ramp
+            state = hbm.evaluate(force=True)
+            if t >= 10:                          # EMA settled
+                series.append(state["time_to_oom_s"])
+        assert all(v is not None for v in series)
+        assert all(b < a for a, b in zip(series, series[1:]))
+        assert state["slope_per_s"] > 0.0
+
+    def test_flat_usage_has_no_oom_forecast(self):
+        hbm, clock = make_tracker()
+        hbm.register_pool("flat", lambda: 500_000)
+        for t in range(1, 30):
+            clock.now = 1000.0 + t
+            state = hbm.evaluate(force=True)
+        # Steady bytes → slope EMA ~0 → no forecast (not trending toward
+        # OOM is None, never a huge number), and no pressure.
+        assert state["time_to_oom_s"] is None
+        assert state["pressure"] is False
+
+    def test_forecast_inside_horizon_raises_pressure(self):
+        hbm, clock = make_tracker(budget_bytes=1_000_000,
+                                  pressure_horizon_s=120.0)
+        holder = [0]
+        hbm.register_pool("ramp", lambda: holder[0])
+        for t in range(1, 60):
+            clock.now = 1000.0 + t
+            holder[0] = 15_000 * t               # OOM in ~20 s at the end
+            hbm.evaluate(force=True)
+        assert hbm._last["time_to_oom_s"] < 120.0
+        assert hbm.pressure() is True
+
+    def test_burning_requires_both_windows_over_objective(self):
+        hbm, clock = make_tracker(
+            budget_bytes=1_000, fast_window_s=5.0, slow_window_s=50.0,
+            util_objective=0.5)
+        holder = [900]
+        hbm.register_pool("spike", lambda: holder[0])
+        # A 3 s spike: fast window burns, the slow window still carries
+        # the spike PEAK (peak ring, not a diluting sum) — burning.
+        for t in range(3):
+            clock.now = 1000.0 + t
+            hbm.evaluate(force=True)
+        state = hbm.evaluate(force=True)
+        assert state["burn"]["fast"] > 1.0 and state["burn"]["slow"] > 1.0
+        assert state["burning"] is True
+        # Once the spike ages out of BOTH windows the verdict clears.
+        holder[0] = 100
+        clock.now = 1000.0 + 200
+        state = hbm.evaluate(force=True)
+        assert state["burning"] is False
+
+    def test_evaluate_throttled_unless_forced(self):
+        hbm, clock = make_tracker(eval_interval_s=5.0)
+        holder = [100]
+        hbm.register_pool("p", lambda: holder[0])
+        first = hbm.evaluate()
+        holder[0] = 900
+        assert hbm.evaluate() is first          # throttled: cached dict
+        assert hbm.evaluate(force=True) is not first
+
+    def test_set_budget_and_synthetic_default(self):
+        hbm, _ = make_tracker(budget_bytes=0)
+        assert hbm.budget_bytes == DEFAULT_SYNTHETIC_BUDGET_BYTES
+        assert hbm.budget_measured is False
+        hbm.set_budget(8 << 30)
+        assert hbm.budget_bytes == 8 << 30
+        assert hbm.budget_measured is True
+        hbm.set_budget(0)                        # no-budget report ignored
+        assert hbm.budget_bytes == 8 << 30
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            HbmTracker(util_objective=0.0, registry=Registry())
+        with pytest.raises(ValueError):
+            HbmTracker(fast_window_s=60.0, slow_window_s=60.0,
+                       registry=Registry())
+        with pytest.raises(ValueError):
+            HbmTracker(budget_bytes=-1, registry=Registry())
+
+    def test_snapshot_shape_and_lint(self):
+        reg = Registry()
+        hbm = HbmTracker(
+            budget_bytes=1_000_000, fast_window_s=10.0,
+            slow_window_s=100.0, eval_interval_s=0.0,
+            clock=FakeClock(1000.0), registry=reg)
+        hbm.register_pool("thumbs", lambda: 4096)
+        hbm.register_pool("track_state", lambda: {"0": 100, "1": 300})
+        hbm.note_program("det", (64, 64), 4, _summary(alias=20))
+        hbm.evaluate(force=True)
+        snap = hbm.snapshot()
+        assert snap["budget_bytes"] == 1_000_000
+        assert snap["budget_measured"] is False
+        assert set(snap["utilization"]) == {"fast", "slow"}
+        assert snap["used_bytes"] == snap["pools"]["total"] \
+            + snap["program_code_bytes"] + snap["program_workspace_bytes"]
+        assert "det|classic|64x64|4|-" in snap["programs"]
+        json.dumps(snap)                         # JSON-able end to end
+        # The vep_hbm_* families render lint-clean.
+        assert lint_exposition(reg.render()) == []
+        text = reg.render()
+        for fam in ("vep_hbm_budget_bytes", "vep_hbm_used_bytes",
+                    "vep_hbm_pool_bytes", "vep_hbm_headroom_bytes",
+                    "vep_hbm_time_to_oom_seconds",
+                    "vep_hbm_utilization", "vep_hbm_burn_rate",
+                    "vep_hbm_donated_saved_bytes"):
+            assert fam in text
+
+
+# ---------------------------------------------------------------------------
+# resilience ladder wire
+
+
+class TestLadderHbmPressure:
+    def test_hbm_pressure_escalates_under_hysteresis(self):
+        from video_edge_ai_proxy_tpu.resilience import DegradationLadder
+
+        clk = FakeClock()
+        lad = DegradationLadder(
+            escalate_after_s=0.5, recover_after_s=2.0, depth_threshold=99,
+            lag_factor=100.0, clock=clk)
+        # Queue and lag are calm: memory pressure alone must walk the
+        # ladder, under the same sustained-window hysteresis as the
+        # other sources (one blip escalates nothing).
+        lad.observe(queue_depth=0, tick_lag_s=0.0, tick_budget_s=0.01,
+                    hbm_pressure=True)
+        clk.now += 0.1
+        assert lad.observe(queue_depth=0, tick_lag_s=0.0,
+                           tick_budget_s=0.01) == "normal"
+        for _ in range(20):
+            clk.now += 0.1
+            rung = lad.observe(queue_depth=0, tick_lag_s=0.0,
+                               tick_budget_s=0.01, hbm_pressure=True)
+        assert rung != "normal"
+        assert lad.snapshot()["transitions"].get("shed", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# engine integration: endpoint convention + mesh exactness + replay pin
+
+
+def _meta(ts=None):
+    return FrameMeta(width=64, height=64, channels=3,
+                     timestamp_ms=ts or int(time.time() * 1000),
+                     is_keyframe=True)
+
+
+def _blob_frame(delta=0, key=1):
+    """Gray frame with one color-keyed blob (the models/blob.py gauge
+    contract; ``delta`` flickers BLUE so the tracker keeps its id)."""
+    frame = np.full((64, 64, 3), 114, np.uint8)
+    frame[20:40, 20:40] = (64 + delta, 255, key * 32 + 16)
+    return frame
+
+
+class _PM:
+    def list(self):
+        return []
+
+
+class TestHbmEndpointConvention:
+    def test_disabled_hbm_answers_400_envelope(self):
+        import urllib.error
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        bus = MemoryFrameBus()
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5))
+        assert eng.hbm is None                   # default off
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/api/v1/hbm")
+            assert ei.value.code == 400
+            body = json.loads(ei.value.read())
+            assert set(body) == {"code", "message"}
+            assert "engine.hbm" in body["message"]
+        finally:
+            srv.stop()
+            bus.close()
+
+    def test_enabled_hbm_serves_snapshot_and_stats_embed(self):
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        bus = MemoryFrameBus()
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5,
+            hbm=True))
+        assert eng.hbm is not None
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            with urllib.request.urlopen(base + "/api/v1/hbm") as r:
+                body = json.loads(r.read())
+            assert {"budget_bytes", "used_bytes", "utilization",
+                    "headroom_bytes", "time_to_oom_s", "programs",
+                    "pools"} <= set(body)
+            # Pre-warmup the pools are registered but unmaterialized.
+            assert {"thumbs", "track_state", "prefetch",
+                    "collector_host"} <= set(body["pools"]["pools"])
+            # The one-call dashboard embed carries the same snapshot.
+            with urllib.request.urlopen(base + "/api/v1/stats") as r:
+                stats = json.loads(r.read())
+            assert stats["obs"]["hbm"]["budget_bytes"] == \
+                body["budget_bytes"]
+        finally:
+            srv.stop()
+            bus.close()
+
+
+class TestMeshPoolExactness:
+    def test_dp2_track_state_shards_match_sub_ring_nbytes(self):
+        """Per-shard exactness under a dp=2 mesh: the tracked
+        track_state row must equal each sub-ring's ``.nbytes`` and the
+        aggregate must be exactly the shard sum (ISSUE 18 acceptance,
+        the tests-side twin of tools/hbm_smoke.py's soak gate)."""
+        from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+        from video_edge_ai_proxy_tpu.temporal.state_pool import (
+            ShardedTrackStatePool,
+        )
+
+        bus = MemoryFrameBus()
+        try:
+            for did in ("cam0", "cam4"):     # crc32-pinned: shard 0 / 1
+                bus.create_stream(did, 64 * 64 * 3)
+            eng = InferenceEngine(
+                bus,
+                EngineConfig(model="tiny_blob_gauge",
+                             batch_buckets=(1, 2, 4), tick_ms=5,
+                             prefetch=False, track=True, cascade=True,
+                             cascade_model="tiny_videomae",
+                             cascade_every_n=2, hbm=True,
+                             mesh={"dp": 2}),
+                annotations=AnnotationQueue(handler=lambda batch: True))
+            eng.warmup()
+            eng._drain_q = queue.Queue(maxsize=8)
+            for f in range(10):
+                delta = 15 if f % 2 == 0 else -15
+                bus.publish("cam0", _blob_frame(delta, key=1), _meta())
+                bus.publish("cam4", _blob_frame(delta, key=2), _meta())
+                groups = eng._collector.collect()
+                eng._dispatch(groups, time.perf_counter())
+                while True:
+                    try:
+                        inflight = eng._drain_q.get_nowait()
+                    except queue.Empty:
+                        break
+                    try:
+                        eng._emit(inflight)
+                    finally:
+                        eng._collector.release(inflight.group)
+                        eng._drain_q.task_done()
+                eng._cascade_tick()
+
+            pool = eng._cascade._pool
+            assert isinstance(pool, ShardedTrackStatePool)
+            tracked = eng.hbm.pools()["pools"]["track_state"]
+            want = pool.nbytes()                 # {shard: bytes}
+            assert tracked["shards"] == want
+            assert tracked["bytes"] == sum(want.values())
+            assert tracked["bytes"] > 0          # rings materialized
+            # Each shard row against its sub-ring's own array metadata.
+            for s, sub in enumerate(pool.pools):
+                assert tracked["shards"][str(s)] == sub.nbytes()
+        finally:
+            bus.close()
+
+
+class TestHbmChecksumPin:
+    def test_hbm_off_default_bit_identical(self):
+        """The HBM plane is a pure observation tap: the device outputs
+        an engine emits must fold the SAME checksum with hbm=True as
+        with the default hbm=False — the plane reads array metadata,
+        never contents (the capacity=False / roi=False kill-switch pin,
+        applied to hbm)."""
+        from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+        from video_edge_ai_proxy_tpu.replay.checksum import (
+            CHECKSUM_MASK,
+            device_checksum,
+            finalize_checksum,
+        )
+
+        def run(hbm):
+            b = MemoryFrameBus()
+            try:
+                b.create_stream("cam1", 64 * 64 * 3)
+                eng = InferenceEngine(
+                    b, EngineConfig(model="tiny_blob_gauge",
+                                    batch_buckets=(1, 2, 4), tick_ms=5,
+                                    prefetch=False, hbm=hbm),
+                    annotations=AnnotationQueue(handler=lambda batch: True))
+                eng.warmup()
+                eng._drain_q = queue.Queue(maxsize=8)
+                carry = 0
+                # Blob frames so valid detections exist — a flat-frame
+                # pin would compare 0 == 0 and prove nothing.
+                for f, key in enumerate((1, 3, 5, 7)):
+                    b.publish("cam1",
+                              _blob_frame(15 if f % 2 == 0 else -15, key),
+                              _meta())
+                    groups = eng._collector.collect()
+                    eng._dispatch(groups, time.perf_counter())
+                    inflight = eng._drain_q.get(timeout=10)
+                    part = int(np.asarray(
+                        device_checksum(inflight.outputs)))
+                    carry = (carry + part) & CHECKSUM_MASK
+                    eng._emit(inflight)
+                    eng._collector.release(inflight.group)
+                    eng._drain_q.task_done()
+                if hbm:       # the plane actually ran on this pass
+                    assert eng.hbm is not None
+                    assert eng.hbm.evaluate(force=True)["used_bytes"] > 0
+                else:
+                    assert eng.hbm is None
+                return finalize_checksum(carry)
+            finally:
+                b.close()
+
+        on, off = run(hbm=True), run(hbm=False)
+        assert on == off
+        assert on != 0

@@ -393,6 +393,68 @@ class TestLedger:
         assert not out["balanced"] and out["lost"] == 19
 
 
+class TestLedgerCompaction:
+    """r21 satellite: interval-compacted storage — the healthy steady
+    state costs one [lo, hi, member] run per stream, and storage stays
+    O(migrations + gaps + duplicates), never O(packets)."""
+
+    def test_steady_state_folds_to_one_run(self):
+        led = MigrationLedger()
+        for p in range(5000):
+            led.note_delivery("cam0", "m0", p)
+        # 5000 ordered same-member deliveries = exactly one run.
+        assert led._runs["cam0"] == [[0, 4999, "m0"]]
+        assert led._multi.get("cam0", {}) == {}
+        out = led.balance("cam0")
+        assert out["balanced"] and out["streams"][0]["delivered"] == 5000
+        assert led.next_cursor("cam0") == 5000
+
+    def test_migration_gap_and_dup_keep_exact_rows(self):
+        led = MigrationLedger()
+        # m0 serves 0..999; live migration hands 1000..1999 to m1;
+        # packets 2000-2002 die with m1; m2 resumes at 2003 and
+        # re-produces 1999 once (cutover overlap).
+        for p in range(1000):
+            led.note_delivery("cam0", "m0", p)
+        for p in range(1000, 2000):
+            led.note_delivery("cam0", "m1", p)
+        for p in range(2003, 2100):
+            led.note_delivery("cam0", "m2", p)
+        led.note_delivery("cam0", "m2", 1999)
+        out = led.balance("cam0")
+        row = out["streams"][0]
+        # Same verdict rows as the per-packet design...
+        assert row["lost"] == 3 and row["missing"] == [2000, 2001, 2002]
+        assert row["duplicated"] == 1
+        assert row["dup_examples"][1999] == ["m1", "m2"]
+        assert row["members"] == ["m0", "m1", "m2"]
+        assert row["delivered"] == 1000 + 1000 + 97
+        assert led.next_cursor("cam0") == 2100
+        # ...with bounded internal storage: 3 member runs, +1 split by
+        # the duplicate, never thousands of per-packet entries.
+        assert len(led._runs["cam0"]) <= 4
+        assert len(led._multi["cam0"]) == 1
+
+    def test_out_of_order_gap_fill_merges_runs(self):
+        led = MigrationLedger()
+        for p in (0, 1, 3, 4):
+            led.note_delivery("cam0", "m0", p)
+        assert len(led._runs["cam0"]) == 2
+        led.note_delivery("cam0", "m0", 2)    # late arrival fills the gap
+        assert led._runs["cam0"] == [[0, 4, "m0"]]
+        assert led.balance("cam0")["balanced"]
+
+    def test_third_delivery_appends_to_owner_list(self):
+        led = MigrationLedger()
+        for p in range(5):
+            led.note_delivery("cam0", "m0", p)
+        led.note_delivery("cam0", "m1", 2)
+        led.note_delivery("cam0", "m2", 2)
+        out = led.balance("cam0")
+        assert out["streams"][0]["dup_examples"][2] == ["m0", "m1", "m2"]
+        assert out["duplicated"] == 2        # deliveries beyond the first
+
+
 # ---------------------------------------------------------------------------
 # breaker isolation
 
@@ -605,6 +667,71 @@ class TestAdmitHeadroom:
             assert router.admit(f"cam{i}", f"rtsp://cam{i}") == "m1"
         assert len(members["m0"].started) == 0
         assert len(members["m2"].started) == 0
+
+
+# ---------------------------------------------------------------------------
+# memory-safe admission (r21: obs/hbm.py feeds admit)
+
+
+def _hbm_row(fleet, name, headroom_bytes, tto=None):
+    fleet.rows[name].update(
+        hbm=True, hbm_headroom_bytes=headroom_bytes,
+        hbm_utilization=(None if headroom_bytes is None
+                         else 0.99 if headroom_bytes <= 0 else 0.3),
+        time_to_oom_s=tto)
+
+
+class TestAdmitMemorySafety:
+    def test_byte_exhausted_member_takes_zero_admissions(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # m1 has the best TIME headroom in the fleet but zero HBM
+        # headroom — time and bytes are independent ways to be full.
+        _cap_row(fleet, "m0", 0.60)
+        _cap_row(fleet, "m1", 0.90)
+        _cap_row(fleet, "m2", 0.50)
+        _hbm_row(fleet, "m0", 8 << 30)
+        _hbm_row(fleet, "m1", 0)
+        _hbm_row(fleet, "m2", 4 << 30)
+        for i in range(10):
+            assert router.admit(f"cam{i}", f"rtsp://cam{i}") == "m0"
+        assert len(members["m1"].started) == 0
+
+    def test_oom_forecast_member_excluded_inside_horizon(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        _cap_row(fleet, "m0", 0.50)
+        _cap_row(fleet, "m1", 0.90)
+        _hbm_row(fleet, "m0", 4 << 30)
+        _hbm_row(fleet, "m1", 4 << 30,
+                 tto=router.admit_oom_horizon_s / 2)
+        for i in range(6):
+            assert router.admit(f"cam{i}", f"rtsp://cam{i}") == "m0"
+        assert len(members["m1"].started) == 0
+        # Outside the horizon the forecast is advisory, not disqualifying.
+        _hbm_row(fleet, "m1", 4 << 30,
+                 tto=router.admit_oom_horizon_s * 100)
+        assert router.admit("late", "rtsp://late") == "m1"
+
+    def test_memory_blind_members_admit_on_time_alone(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # Pre-r21 rows carry no hbm keys at all: the r18 time-headroom
+        # policy must be unchanged (no KeyError, no implicit exclusion).
+        _cap_row(fleet, "m0", 0.80)
+        _cap_row(fleet, "m1", 0.30)
+        _cap_row(fleet, "m2", 0.50)
+        assert router.admit("cam0", "rtsp://cam0") == "m0"
+
+    def test_all_memory_unsafe_still_places_least_bad(self):
+        router, fleet, members, clock = make_router()
+        router.run_pass()
+        # Every reporter byte-exhausted: max time-headroom still beats
+        # failing closed (the all-saturated convention, memory flavor).
+        for n, h in (("m0", 0.20), ("m1", 0.60), ("m2", 0.40)):
+            _cap_row(fleet, n, h)
+            _hbm_row(fleet, n, 0)
+        assert router.admit("cam0", "rtsp://cam0") == "m1"
 
 
 # ---------------------------------------------------------------------------

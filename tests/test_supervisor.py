@@ -158,6 +158,40 @@ class TestScaleOut:
         assert decision["fleet_tts_s"] == 80.0
         assert decision["action"] == "spawn"
 
+    def test_spawn_on_oom_forecast_inside_horizon(self):
+        """r21: fleet_tto_s (earliest member time_to_oom_s) is a spawn
+        trigger of its own — a fleet can run out of BYTES with all the
+        time headroom in the world."""
+        router = FakeRouter()
+        router.set("m0", time_to_oom_s=90.0)
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router))
+        decision = sup.run_pass()
+        assert decision["action"] == "spawn"
+        assert decision["reason"] == "oom_forecast"
+        assert decision["fleet_tto_s"] == 90.0
+        event = sup.events[-1]
+        assert event["reason"] == "oom_forecast"
+        assert event["fleet_tto_s"] == 90.0
+        # Compute saturation outranks it in the reason taxonomy (it is
+        # the faster-moving signal): both inside the horizon names
+        # saturation_forecast.
+        router2 = FakeRouter()
+        router2.set("m0", time_to_saturation_s=60.0, time_to_oom_s=90.0)
+        sup2 = _sup(router2, FakeClock(),
+                    spawner=_spawner_factory(router2))
+        assert sup2.run_pass()["reason"] == "saturation_forecast"
+
+    def test_no_spawn_when_oom_forecast_beyond_horizon(self):
+        router = FakeRouter()
+        router.set("m0", time_to_oom_s=100_000.0)
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router))
+        decision = sup.run_pass()
+        assert decision["action"] in ("hold", "none")
+        assert decision["reason"] != "oom_forecast"
+        assert not router.added
+
     def test_no_spawn_when_forecast_flat_or_beyond_horizon(self):
         router = FakeRouter()
         sup = _sup(router, FakeClock(),

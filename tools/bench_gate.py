@@ -241,6 +241,40 @@ def capacity_info(baseline_dir: str):
     return None
 
 
+def hbm_info(baseline_dir: str):
+    """Newest committed HBM_r*.json's memory-ledger row, or None.
+
+    Round 21 informational carry-through: perf-gate logs show the HBM
+    attribution plane's pool-byte exactness, OOM-forecast monotonicity,
+    and memory-aware-admission verdict next to the fps verdict. NEVER
+    gated here — hbm_smoke.py hard-gates its own run; this is trend
+    visibility only.
+    """
+    paths = sorted(glob.glob(os.path.join(baseline_dir, "HBM_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(art, dict) or "pools" not in art:
+            continue
+        pools = art.get("pools") or {}
+        forecast = art.get("forecast") or {}
+        admission = art.get("admission") or {}
+        replay = art.get("replay") or {}
+        return {
+            "artifact": os.path.basename(path),
+            "pool_max_abs_delta_bytes": pools.get("max_abs_delta_bytes"),
+            "tto_monotone_decreasing": forecast.get(
+                "tto_monotone_decreasing"),
+            "exhausted_member_placements": admission.get(
+                "exhausted_member_placements"),
+            "hbm_off_bitexact": replay.get("hbm_off_bitexact"),
+        }
+    return None
+
+
 def autoscale_info(baseline_dir: str):
     """Newest committed AUTOSCALE_r*.json's lifecycle row, or None.
 
@@ -376,6 +410,9 @@ def main(argv=None) -> int:
     capacity = capacity_info(args.baseline_dir)
     if capacity is not None:
         report["capacity"] = capacity        # informational, never gated
+    hbm = hbm_info(args.baseline_dir)
+    if hbm is not None:
+        report["hbm"] = hbm                  # informational, never gated
     autoscale = autoscale_info(args.baseline_dir)
     if autoscale is not None:
         report["autoscale"] = autoscale      # informational, never gated

@@ -520,6 +520,16 @@ class Collector:
             slot["cur"].append(idx)
             return slot["bufs"][idx], idx
 
+    def pool_nbytes(self) -> int:
+        """Total bytes held by the pooled batch buffers across shapes —
+        the obs/hbm.py ``register_pool`` tap for the collector's host
+        staging pool (the canvas/batch buffers the device step reads
+        from). Sums live ``.nbytes`` under the pool lock so the figure
+        is exact against the constituent arrays at any instant."""
+        with self._pool_lock:
+            return sum(buf.nbytes for slot in self._pool.values()
+                       for buf in slot["bufs"])
+
     def _unrotate(self, shape: tuple) -> None:
         """No group was emitted from the last-handed-out buffer (every
         read came back empty): hand it back so idle ticks do not grow the

@@ -387,10 +387,11 @@ class _TimedStep:
     """
 
     __slots__ = ("_jit", "_aot", "_perf", "_model", "_src_hw", "_bucket",
-                 "_on_success")
+                 "_on_success", "_on_compiled")
 
     def __init__(self, jit_fn, perf: PerfTracker, model: str,
-                 src_hw: tuple, bucket: int, on_first_success=None):
+                 src_hw: tuple, bucket: int, on_first_success=None,
+                 on_compiled=None):
         self._jit = jit_fn
         self._aot = None          # None = not compiled; False = jit path
         self._perf = perf
@@ -402,6 +403,10 @@ class _TimedStep:
         # success so a program whose compile reliably fails is never
         # recorded (and re-failed) on every future spawn's boot.
         self._on_success = on_first_success
+        # Fired once with the AOT executable right after note_compile —
+        # the r21 HBM plane's memory_analysis() tap. Never fires on the
+        # jit fallback (no executable handle to analyze there).
+        self._on_compiled = on_compiled
 
     def __call__(self, variables, *args):
         out = self._invoke(variables, *args)
@@ -429,6 +434,9 @@ class _TimedStep:
             self._perf.note_compile(
                 self._model, self._src_hw, self._bucket,
                 time.perf_counter() - t0, compiled=compiled)
+            if self._on_compiled is not None:
+                cb, self._on_compiled = self._on_compiled, None
+                cb(compiled)
             self._aot = compiled
         if self._aot is not False:
             try:
@@ -592,6 +600,13 @@ class _ThumbPool:
                 thumbs, jnp.asarray(np.asarray(rows, np.int32)), axis=0)
         self._pool = self._pool.at[idx].set(src)
 
+    def nbytes(self) -> int:
+        """Device bytes held by the thumbnail ring right now (0 before
+        first scatter) — obs/hbm.py ``register_pool`` tap. Capacity-
+        based like the track-state ring: grown rows stay allocated after
+        their streams GC. Metadata only, no transfer."""
+        return int(self._pool.nbytes) if self._pool is not None else 0
+
 
 class _ShardedThumbPool:
     """Per-mesh-slice thumbnail state for mesh serving (r17 tentpole
@@ -691,6 +706,12 @@ class _ShardedThumbPool:
                 rows=[r for r, _ in pairs],
             )
 
+    def nbytes(self) -> Dict[str, int]:
+        """Per-shard thumbnail ring bytes ``{shard: bytes}`` — the
+        obs/hbm.py sharded ``register_pool`` shape (each sub-pool's
+        figure is exact against its own ring's ``.nbytes``)."""
+        return {str(s): sub.nbytes() for s, sub in enumerate(self._subs)}
+
 
 class _Prefetched:
     """Handle for one batch placement in flight on the transfer thread."""
@@ -757,6 +778,25 @@ class _PrefetchStage:
         except queue.Full:
             log.warning("transfer queue full at stop; abandoning thread")
         self._thread.join(timeout=10)
+
+    def nbytes(self) -> int:
+        """Device bytes currently parked in the prefetch stage: placed-
+        and-undispatched batches sitting in the depth-2 in-queue — the
+        obs/hbm.py ``register_pool`` tap for the double-buffered input
+        slots. Snapshots the queue under its own mutex (the stdlib-
+        sanctioned way to size a live Queue); handles not yet placed (or
+        errored) count 0. Metadata reads only."""
+        with self._q.mutex:
+            pending = list(self._q.queue)
+        total = 0
+        for pre in pending:
+            placed = getattr(pre, "placed", None)
+            if placed is None:
+                continue
+            parts = placed if isinstance(placed, (list, tuple)) else (placed,)
+            for part in parts:
+                total += int(getattr(part, "nbytes", 0) or 0)
+        return total
 
     def submit(self, group: BatchGroup, stop_event) -> Optional[_Prefetched]:
         """Queue a placement; blocks (in interruptible slices) while both
@@ -1194,6 +1234,43 @@ class InferenceEngine:
             # r17: device frame statistics run under the mesh too — the
             # thumbnail pool shards per dp slice (warmup).
             self._quality_device = self._cfg.quality_thumb > 0
+        # HBM attribution plane (obs/hbm.py, r21): the memory mirror of
+        # the capacity plane — compiled-program footprints tapped at the
+        # same _TimedStep cache-miss site obs/perf.py uses, plus live
+        # byte ledgers for every device/host pool the engine owns. The
+        # register_pool callables close over self attributes, so the
+        # warmup swaps to sharded twins (and the collector being built
+        # later) stay tracked with no re-registration. cfg.hbm=False
+        # leaves it None — no compile tap, no pool callables,
+        # /api/v1/hbm answers 400, serving bit-identical (test-pinned
+        # kill switch, capacity convention).
+        self.hbm = None
+        if self._cfg.hbm:
+            from ..obs.hbm import HbmTracker
+
+            self.hbm = HbmTracker(
+                budget_bytes=self._cfg.hbm_budget_bytes,
+                fast_window_s=self._cfg.hbm_fast_window_s,
+                slow_window_s=self._cfg.hbm_slow_window_s,
+                util_objective=self._cfg.hbm_util_objective,
+                eval_interval_s=self._cfg.hbm_eval_interval_s,
+                pressure_horizon_s=self._cfg.hbm_pressure_horizon_s,
+            )
+            self.hbm.register_pool(
+                "thumbs",
+                lambda: self._thumbs.nbytes() if self._thumbs is not None
+                else 0)
+            self.hbm.register_pool(
+                "track_state",
+                lambda: self._cascade.pool_nbytes()
+                if self._cascade is not None else 0)
+            self.hbm.register_pool(
+                "prefetch",
+                lambda: self._xfer.nbytes() if self._xfer is not None else 0)
+            self.hbm.register_pool(
+                "collector_host",
+                lambda: self._collector.pool_nbytes()
+                if self._collector is not None else 0)
 
     @property
     def cascade(self):
@@ -1390,6 +1467,18 @@ class InferenceEngine:
             # slice receives exactly its streams' frames.
             shards=self._shards,
         )
+        if self.hbm is not None and not self._cfg.hbm_budget_bytes:
+            # Resolve the real device budget now that the backend is up:
+            # device.memory_stats() reports bytes_limit on the TPU; the
+            # CPU twin (no memory stats) keeps the synthetic default so
+            # forecasts stay meaningful in tests/soaks.
+            try:
+                stats = jax.devices()[0].memory_stats() or {}
+                limit = int(stats.get("bytes_limit", 0) or 0)
+            except Exception:
+                limit = 0
+            if limit > 0:
+                self.hbm.set_budget(limit)
         log.info(
             "engine ready: model=%s kind=%s input=%d backend=%s",
             self._spec.name, self._spec.kind, self._spec.input_size,
@@ -2220,9 +2309,37 @@ class InferenceEngine:
 
             fn = _TimedStep(jax.jit(raw, donate_argnums=donate),
                             self.perf, model, src_hw, bucket,
-                            on_first_success=record)
+                            on_first_success=record,
+                            on_compiled=self._hbm_compile_tap(
+                                model, src_hw, bucket))
             self._step_cache[key] = fn
         return fn
+
+    def _hbm_compile_tap(self, model: str, src_hw: tuple, bucket: int):
+        """``on_compiled`` callback for a :class:`_TimedStep`: records
+        the program's ``memory_analysis()`` footprint (argument/output/
+        temp/code bytes, donated aliasing credited) into the HBM plane
+        under its (model, stem, geometry, bucket, mesh) key. None when
+        cfg.hbm is off — the wrapper then carries no callback at all,
+        keeping the kill-switch path bit-identical and free."""
+        if self.hbm is None:
+            return None
+        stem = getattr(self._cfg, "stem", "classic")
+        mesh = f"dp{self._shards}" if self._mesh is not None else ""
+
+        def tap(compiled, _model=model, _hw=src_hw, _bucket=bucket,
+                _stem=stem, _mesh=mesh):
+            from ..obs.perf import memory_summary
+
+            try:
+                self.hbm.note_program(
+                    _model, _hw, _bucket, memory_summary(compiled),
+                    stem=_stem, mesh=_mesh)
+            except Exception:     # footprint attribution must never
+                log.debug(        # take down the drain thread
+                    "hbm compile tap failed", exc_info=True)
+
+        return tap
 
     # -- engine loop --
 
@@ -2260,6 +2377,12 @@ class InferenceEngine:
                         # queues physically back up.
                         slo_burning=(self._slo_burning
                                      and self._cfg.slo_ladder),
+                        # Memory-level pressure (r21, obs/hbm.py): shed/
+                        # stretch BEFORE the allocator OOMs — a byte
+                        # forecast inside the horizon is as real as a
+                        # queue backing up. One cached-dict read.
+                        hbm_pressure=(self.hbm is not None
+                                      and self.hbm.pressure()),
                     )
                     self._apply_rung_cap(rung)
                 # One bus enumeration per tick, threaded everywhere.
@@ -2925,6 +3048,11 @@ class InferenceEngine:
             # Throttled internally to capacity_eval_interval_s — per-tick
             # cost between refreshes is one clock read and a compare.
             self.capacity.evaluate()
+        if self.hbm is not None:
+            # Same stance for the byte ledger: the registered pool
+            # callables are metadata reads, and between refreshes the
+            # per-tick cost is one clock read and a compare.
+            self.hbm.evaluate()
 
     def _slo_tick(self, inferred: Sequence[str]) -> None:
         """Per-tick SLO sampling + throttled evaluation (obs/slo.py).
@@ -3448,7 +3576,9 @@ class InferenceEngine:
                 jax.jit(_build_cascade_head(
                     model, self._cfg.cascade_score_w,
                     self._cfg.cascade_score_b)),
-                self.perf, label, (side, side), bucket)
+                self.perf, label, (side, side), bucket,
+                on_compiled=self._hbm_compile_tap(
+                    label, (side, side), bucket))
             self._step_cache[key] = fn
         else:
             self._m_cache_hit.inc()

@@ -3,7 +3,7 @@ stall/watermark detection (ISSUE r7 tentpole), live device-performance
 attribution and SLO burn-rate evaluation (ISSUE r9 tentpole).
 
 Pure-Python, jax-free at import, importable from control-plane and worker
-code alike. Eight modules:
+code alike. Modules:
 
 - :mod:`metrics` — process-wide counters/gauges/log2-histograms, rendered
   once by ``/metrics`` (Prometheus 0.0.4) and ``/api/v1/stats`` (JSON).
@@ -40,9 +40,17 @@ code alike. Eight modules:
   forecast, and SRE-style fast/slow capacity burn rates
   (``vep_capacity_*``, ``/api/v1/capacity``) — the signal
   ``StreamRouter.admit`` consumes for headroom-aware placement.
+- :mod:`hbm` — the memory mirror of :mod:`capacity` (ISSUE r21
+  tentpole): static per-program footprints from ``memory_analysis()``
+  at AOT-compile time, dynamic per-pool byte accounting via registered
+  ``nbytes`` callables, a window-peak utilization model over the
+  device's HBM budget, and an EWMA-slope ``time_to_oom_s`` forecast
+  (``vep_hbm_*``, ``/api/v1/hbm``) feeding the degradation ladder,
+  memory-aware admission, and the supervisor's scale-out decision.
 """
 
 from .capacity import CapacityTracker
+from .hbm import HbmTracker
 from .metrics import Registry, registry
 from .perf import PerfTracker, cost_summary, mfu_pct
 from .prof import Profiler
@@ -56,6 +64,7 @@ from .watch import Watchdog
 
 __all__ = [
     "CapacityTracker",
+    "HbmTracker",
     "Registry",
     "registry",
     "PerfTracker",

@@ -29,6 +29,9 @@ Three tiers, one object (``CapacityTracker``, engine-owned like
   weight fractions of the measured time, the running attributed and
   measured totals are both exported, and ``conservation()`` verdicts
   them within float tolerance (tools/capacity_smoke.py hard-gates it).
+  Rows idle past the slow window expire (r21) so the dict stays bounded
+  under stream churn; the conservation counters run independently of
+  the live dict, so expiry never unbalances them.
 - **Headroom model + forecast.** Busy device-milliseconds accumulate in
   fixed time-binned rings (the obs/slo.py ``_BinRing`` idiom — zero
   allocation on the hot path), per (model, geometry, bucket) cell and
@@ -123,7 +126,7 @@ class _StreamLedger:
     lock; snapshot() hands out copies)."""
 
     __slots__ = ("device_ms", "by_kind", "batches", "frames",
-                 "ema_ms_per_frame", "amortized_ms")
+                 "ema_ms_per_frame", "amortized_ms", "last_seen")
 
     def __init__(self):
         self.device_ms = 0.0          # total attributed device time
@@ -135,6 +138,10 @@ class _StreamLedger:
         # cascade head shares land divided by their dispatch cadence, so
         # this reads as the stream's steady-state cost per engine tick.
         self.amortized_ms = 0.0
+        # Last attribution touch (tracker clock); drives departed-stream
+        # expiry once a stream has been idle past the slow window (r21 —
+        # the ledger dict must not grow without bound under churn).
+        self.last_seen = 0.0
 
 
 class _Cell:
@@ -193,10 +200,17 @@ class CapacityTracker:
         # 0.0 by construction, same as the aggregate.
         self._shards: Dict[str, Dict[str, float]] = {}
         self._agg = _BusyRing(slow_window_s, bin_s)
-        # Conservation invariant state.
+        # Conservation invariant state. The running totals are COUNTERS,
+        # independent of the per-stream dict: expiring an idle stream's
+        # ledger row (below) never unbalances them.
         self.attributed_ms = 0.0
         self.measured_ms = 0.0
         self.max_conservation_rel_err = 0.0
+        # Departed-stream expiry (r21): rows idle past the slow window
+        # are dropped from the live dict; their attributed totals are
+        # folded into these aggregates so snapshot coverage stays whole.
+        self.expired_streams = 0
+        self.expired_ms = 0.0
         # Forecast state (updated only in evaluate()).
         self._next_eval = 0.0
         self._prev_util: Optional[float] = None
@@ -311,6 +325,7 @@ class CapacityTracker:
                 led.batches += 1
                 led.frames += 1
                 led.amortized_ms += share / amortize
+                led.last_seen = now
                 led.ema_ms_per_frame = (
                     share if led.ema_ms_per_frame is None
                     else 0.9 * led.ema_ms_per_frame + 0.1 * share)
@@ -344,12 +359,14 @@ class CapacityTracker:
         no device work at all) so the ledger's stream coverage matches
         the serving set — a coasting stream reads as costing 0 ms, not
         as missing."""
+        now = self._clock()
         with self._lock:
             for sid in streams:
                 led = self._streams.get(sid)
                 if led is None:
                     led = self._streams[sid] = _StreamLedger()
                 led.batches += 1
+                led.last_seen = now
                 led.by_kind.setdefault("coast", 0.0)
 
     # -- forecast (tick thread, throttled) ------------------------------
@@ -411,6 +428,20 @@ class CapacityTracker:
         self._m_burn.labels("slow").set(burn_slow)
         self._m_headroom.set(headroom)
         self._m_tts.set(tts if tts is not None else -1.0)
+        # Departed-stream expiry (r21): a stream idle past the slow
+        # window has left the serving set (the engine stopped attributing
+        # to it); its row no longer informs any live decision, so drop it
+        # and fold its total into the expired aggregates. Conservation is
+        # untouched — attributed_ms/measured_ms are running counters, not
+        # sums over the live dict.
+        with self._lock:
+            cutoff = now - self.slow_window_s
+            gone = [sid for sid, led in self._streams.items()
+                    if led.last_seen < cutoff]
+            for sid in gone:
+                led = self._streams.pop(sid)
+                self.expired_streams += 1
+                self.expired_ms += led.device_ms
         with self._lock:
             cells = list(self._cells.items())
             t0 = self._t0
@@ -501,5 +532,7 @@ class CapacityTracker:
             "time_to_saturation_s": state["time_to_saturation_s"],
             "conservation": self.conservation(),
             "streams": self.streams(),
+            "expired": {"streams": self.expired_streams,
+                        "device_ms": round(self.expired_ms, 3)},
             "cells": cells,
         }

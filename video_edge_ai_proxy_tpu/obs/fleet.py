@@ -61,6 +61,13 @@ Fleet metric families (all gauges unless noted):
 - ``vep_fleet_member_time_to_saturation_seconds{instance}`` —
   EWMA-slope saturation forecast (-1 when unreported or not burning
   toward saturation)
+- ``vep_fleet_member_hbm_headroom_bytes{instance}`` — device-memory
+  headroom from the member's r21 HBM plane (-1 when the member does not
+  report it — mixed-version fleet)
+- ``vep_fleet_member_hbm_utilization{instance}`` — fast-window HBM
+  utilization (-1 when unreported)
+- ``vep_fleet_member_time_to_oom_seconds{instance}`` — EWMA byte-slope
+  OOM forecast (-1 when unreported or not trending toward OOM)
 - ``vep_fleet_scrapes_total{instance}`` /
   ``vep_fleet_scrape_failures_total{instance}`` (counters)
 """
@@ -172,6 +179,7 @@ class MemberState:
         self.stats: dict = {}
         self.slo: dict = {}
         self.capacity: dict = {}
+        self.hbm: dict = {}
         # r16 flap-free health (updated once per scrape pass, never at
         # read time): EMA of the instantaneous score + a hysteresis-banded
         # healthy verdict with entry timestamps.
@@ -226,6 +234,22 @@ class MemberState:
 
     def time_to_saturation_s(self) -> Optional[float]:
         v = (self.capacity or {}).get("time_to_saturation_s")
+        return float(v) if v is not None else None
+
+    # r21 HBM signals; all None when the member does not report the HBM
+    # plane (disabled or pre-r21 — mixed-version fleet).
+
+    def hbm_headroom_bytes(self) -> Optional[float]:
+        v = (self.hbm or {}).get("headroom_bytes")
+        return float(v) if v is not None else None
+
+    def hbm_util(self) -> Optional[float]:
+        util = (self.hbm or {}).get("utilization") or {}
+        v = util.get("fast")
+        return float(v) if v is not None else None
+
+    def time_to_oom_s(self) -> Optional[float]:
+        v = (self.hbm or {}).get("time_to_oom_s")
         return float(v) if v is not None else None
 
 
@@ -352,11 +376,20 @@ class FleetAggregator:
                     # Capacity plane disabled (400) or a pre-r18 member
                     # (404) — merge the rest; health rows carry None.
                     capacity = {}
+                try:
+                    hbm = json.loads(
+                        self._fetch(m.base_url + "/api/v1/hbm"))
+                except Exception:
+                    # HBM plane disabled (400) or a pre-r21 member (404)
+                    # — merge the rest; health rows carry None and the
+                    # fleet gauges render -1 sentinels.
+                    hbm = {}
                 with self._lock:
                     m.families = parse_exposition(text)
                     m.stats = stats
                     m.slo = slo
                     m.capacity = capacity
+                    m.hbm = hbm
                     m.alive = True
                     m.last_ok = time.monotonic()
                     m.last_err = ""
@@ -436,6 +469,12 @@ class FleetAggregator:
             "headroom": m.headroom(),
             "capacity_utilization": m.capacity_util(),
             "time_to_saturation_s": m.time_to_saturation_s(),
+            # r21 HBM plane (None-keyed when unreported — the router
+            # treats those as memory-blind, admitting on time alone).
+            "hbm": bool(m.hbm),
+            "hbm_headroom_bytes": m.hbm_headroom_bytes(),
+            "hbm_utilization": m.hbm_util(),
+            "time_to_oom_s": m.time_to_oom_s(),
             "score": round(score, 4),
             "score_ema": round(m.score_ema, 4)
             if m.score_ema is not None else None,
@@ -607,6 +646,19 @@ class FleetAggregator:
             "trending toward saturation)",
             lambda r: r["time_to_saturation_s"]
             if r["time_to_saturation_s"] is not None else -1.0)
+        fam("vep_fleet_member_hbm_headroom_bytes", "gauge",
+            "Device-memory headroom in bytes (-1 when unreported)",
+            lambda r: r["hbm_headroom_bytes"]
+            if r["hbm_headroom_bytes"] is not None else -1.0)
+        fam("vep_fleet_member_hbm_utilization", "gauge",
+            "Fast-window HBM utilization (-1 when unreported)",
+            lambda r: r["hbm_utilization"]
+            if r["hbm_utilization"] is not None else -1.0)
+        fam("vep_fleet_member_time_to_oom_seconds", "gauge",
+            "EWMA byte-slope OOM forecast (-1 when unreported or not "
+            "trending toward OOM)",
+            lambda r: r["time_to_oom_s"]
+            if r["time_to_oom_s"] is not None else -1.0)
         fam("vep_fleet_scrapes_total", "counter",
             "Successful member scrapes", lambda r: r["scrapes"])
         fam("vep_fleet_scrape_failures_total", "counter",

@@ -73,6 +73,45 @@ def cost_summary(compiled) -> dict:
     return out
 
 
+def memory_summary(compiled) -> dict:
+    """Device-memory footprint from an XLA compiled executable's
+    ``memory_analysis()`` — the byte-side sibling of :func:`cost_summary`
+    feeding the r21 HBM plane (obs/hbm.py).
+
+    Duck-typed with the same tolerance: backends without memory analysis
+    (or older jax returning None) normalize to ``{}`` — callers treat a
+    missing footprint as "memory unknown", never as an error. Keys when
+    available: ``argument_bytes``, ``output_bytes``, ``temp_bytes``,
+    ``code_bytes`` (generated executable), ``alias_bytes`` (donated-
+    argument aliasing — bytes the output shares with donated inputs).
+    """
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if mem is None:
+        return {}
+    out: dict = {}
+    for key, attr in (
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("code_bytes", "generated_code_size_in_bytes"),
+        ("alias_bytes", "alias_size_in_bytes"),
+    ):
+        try:
+            val = getattr(mem, attr)
+        except Exception:
+            continue
+        if val is None:
+            continue
+        try:
+            out[key] = int(val)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
 def mfu_pct(flops: float, device_ms: float,
             peak_tflops: float) -> Optional[float]:
     """Model FLOPs utilization: achieved FLOP/s over peak, percent.

@@ -150,16 +150,21 @@ class DegradationLadder:
                 else None
 
     def observe(self, *, queue_depth: int, tick_lag_s: float,
-                tick_budget_s: float, slo_burning: bool = False) -> str:
+                tick_budget_s: float, slo_burning: bool = False,
+                hbm_pressure: bool = False) -> str:
         """Feed one tick's pressure signals; returns the current rung name.
         ``slo_burning`` is the SLO engine's aggregate burn verdict — an
         SLO-level pressure source ORed with the queue-level ones, subject
-        to the same escalate/recover hysteresis."""
+        to the same escalate/recover hysteresis. ``hbm_pressure`` (r21,
+        obs/hbm.py) is the device-memory verdict — burning HBM or an OOM
+        forecast inside the horizon sheds/stretches BEFORE the allocator
+        fails, under the same hysteresis."""
         now = self._clock()
         pressure = (
             queue_depth >= self.depth_threshold
             or tick_lag_s > self.lag_factor * tick_budget_s
             or slo_burning
+            or hbm_pressure
         )
         fleet_edge: Optional[bool] = None
         with self._lock:

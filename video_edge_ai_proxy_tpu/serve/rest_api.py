@@ -226,6 +226,11 @@ def build_app(
             # /api/v1/capacity serves).
             "capacity": engine.capacity.snapshot()
             if engine is not None and engine.capacity is not None else None,
+            # r21 HBM attribution: program/pool byte ledger, budget
+            # utilization + time_to_oom_s forecast (the same snapshot
+            # /api/v1/hbm serves).
+            "hbm": engine.hbm.snapshot()
+            if engine is not None and engine.hbm is not None else None,
         }
         return web.json_response(out)
 
@@ -282,6 +287,20 @@ def build_app(
             return _error(
                 400, "capacity plane disabled (engine.capacity config)")
         out = await asyncio.to_thread(engine.capacity.snapshot)
+        return web.json_response(out)
+
+    async def hbm(_request: web.Request) -> web.Response:
+        """HBM attribution plane (obs/hbm.py): per-program compiled
+        memory footprints (donated aliasing credited), live per-pool
+        byte ledgers, budget utilization/burn and the EWMA-slope
+        time_to_oom_s forecast. 400 when the plane is disabled
+        (engine.hbm config, same kill-switch convention as
+        /api/v1/capacity)."""
+        if engine is None:
+            return _error(400, "engine not running")
+        if engine.hbm is None:
+            return _error(400, "hbm plane disabled (engine.hbm config)")
+        out = await asyncio.to_thread(engine.hbm.snapshot)
         return web.json_response(out)
 
     async def trace(request: web.Request) -> web.Response:
@@ -500,6 +519,7 @@ def build_app(
     app.router.add_get("/api/v1/quality", quality)
     app.router.add_get("/api/v1/cascade", cascade)
     app.router.add_get("/api/v1/capacity", capacity)
+    app.router.add_get("/api/v1/hbm", hbm)
     app.router.add_get("/api/v1/trace", trace)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)

@@ -256,12 +256,29 @@ class MigrationLedger:
     member compiling in-tick overwrote frames (latest-frame-wins), and
     the r19 AOT prewarm cache removed that ramp — conservation holds
     from the very first frame.
+
+    Storage is interval-compacted (r21, ISSUE 18 satellite): the healthy
+    steady state — one member delivering packets in order — folds into a
+    single ``[lo, hi, member]`` run per stream instead of one dict entry
+    per packet, so a day-long 30 fps stream costs three ints, not 2.6 M
+    entries, and ledger memory is O(streams + migrations + gaps +
+    duplicates) at the item-4 1,000-stream scale. Runs are contiguous,
+    single-member and duplicate-free by construction; packets delivered
+    more than once move to a ``packet -> [members...]`` side table with
+    their exact owner lists (splitting the run they came from), so
+    :meth:`balance` reports the same rows — including duplicate owner
+    attribution — as the per-packet design, and the loss count comes
+    from interval gaps, never from scanning ``range(lo, hi + 1)``.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
-        # stream -> packet -> [members...] (len > 1 == duplicate)
-        self._seen: Dict[str, Dict[int, List[str]]] = {}
+        # stream -> sorted disjoint [lo, hi, member] runs (contiguous,
+        # duplicate-free, single-member spans).
+        self._runs: Dict[str, List[list]] = {}
+        # stream -> packet -> [members...] for packets delivered more
+        # than once (always len >= 2; exact delivery-order owner lists).
+        self._multi: Dict[str, Dict[int, List[str]]] = {}
         self.migrations: List[dict] = []
         self._m_lost = obs_registry.gauge(
             "vep_router_ledger_lost_frames",
@@ -272,12 +289,55 @@ class MigrationLedger:
             "Conservation ledger: packets delivered more than once, all "
             "streams (0 = balanced)").labels()
 
+    @staticmethod
+    def _run_before(runs: List[list], p: int) -> int:
+        """Index of the last run with lo <= p (-1 when none): the only
+        run that can contain p, and the left neighbor for inserts."""
+        lo_i, hi_i = 0, len(runs)
+        while lo_i < hi_i:
+            mid = (lo_i + hi_i) // 2
+            if runs[mid][0] <= p:
+                lo_i = mid + 1
+            else:
+                hi_i = mid
+        return lo_i - 1
+
     def note_delivery(self, stream: str, member: str, packet: int,
                       trace_id: int = 0) -> None:
         with self._lock:
-            owners = self._seen.setdefault(stream, {}).setdefault(
-                int(packet), [])
-            owners.append(member)
+            p = int(packet)
+            multi = self._multi.setdefault(stream, {})
+            owners = multi.get(p)
+            if owners is not None:
+                owners.append(member)       # 3rd+ delivery of a known dup
+                return
+            runs = self._runs.setdefault(stream, [])
+            i = self._run_before(runs, p)
+            if i >= 0 and runs[i][1] >= p:
+                # Second delivery of a run-held packet: split the run
+                # around it and move it to the side table with its exact
+                # owner list (original run member first).
+                rlo, rhi, rm = runs[i]
+                pieces = []
+                if p > rlo:
+                    pieces.append([rlo, p - 1, rm])
+                if p < rhi:
+                    pieces.append([p + 1, rhi, rm])
+                runs[i:i + 1] = pieces
+                multi[p] = [rm, member]
+                return
+            prev = runs[i] if i >= 0 else None
+            nxt = runs[i + 1] if i + 1 < len(runs) else None
+            if prev is not None and prev[2] == member and prev[1] == p - 1:
+                prev[1] = p
+                if (nxt is not None and nxt[2] == member
+                        and nxt[0] == p + 1):
+                    prev[1] = nxt[1]        # filled the gap between two
+                    del runs[i + 1]         # same-member runs: one run now
+            elif nxt is not None and nxt[2] == member and nxt[0] == p + 1:
+                nxt[0] = p
+            else:
+                runs.insert(i + 1, [p, p, member])
 
     def record_migration(self, entry: dict) -> None:
         with self._lock:
@@ -288,36 +348,64 @@ class MigrationLedger:
         resume cursor for a replay-backed stream. None before any
         delivery."""
         with self._lock:
-            seen = self._seen.get(stream)
-            return (max(seen) + 1) if seen else None
+            runs = self._runs.get(stream) or []
+            multi = self._multi.get(stream) or {}
+            if not runs and not multi:
+                return None
+            top = runs[-1][1] if runs else None
+            if multi:
+                m_top = max(multi)
+                top = m_top if top is None else max(top, m_top)
+            return top + 1
 
     def balance(self, stream: Optional[str] = None) -> dict:
         """Conservation verdict. ``stream`` None checks every stream.
         ``balanced`` is True iff zero lost AND zero duplicated."""
         with self._lock:
             streams = ([stream] if stream is not None
-                       else sorted(self._seen))
+                       else sorted(set(self._runs) | set(self._multi)))
             rows = []
             total_lost = total_dup = 0
             for s in streams:
-                seen = self._seen.get(s, {})
-                if not seen:
+                runs = self._runs.get(s) or []
+                multi = self._multi.get(s) or {}
+                if not runs and not multi:
                     rows.append({"stream": s, "delivered": 0,
                                  "lost": 0, "duplicated": 0})
                     continue
-                lo, hi = min(seen), max(seen)
-                missing = [p for p in range(lo, hi + 1) if p not in seen]
-                dups = {p: owners for p, owners in seen.items()
-                        if len(owners) > 1}
-                members = sorted({m for owners in seen.values()
-                                  for m in owners})
-                total_lost += len(missing)
-                total_dup += sum(len(o) - 1 for o in dups.values())
+                # Disjoint coverage: runs, plus the dup singletons (a
+                # packet lives in exactly one of the two structures).
+                intervals = sorted(
+                    [(r[0], r[1]) for r in runs]
+                    + [(p, p) for p in multi])
+                lo = intervals[0][0]
+                hi = max(b for _, b in intervals)
+                delivered = (sum(r[1] - r[0] + 1 for r in runs)
+                             + len(multi))
+                missing: List[int] = []
+                lost = 0
+                cur = lo           # first covered point
+                for a, b in intervals:
+                    if a > cur + 1:
+                        gap = a - cur - 1
+                        lost += gap
+                        if len(missing) < 20:
+                            missing.extend(range(
+                                cur + 1,
+                                min(a, cur + 1 + (20 - len(missing)))))
+                    cur = max(cur, b)
+                dups = {p: list(o) for p, o in multi.items()}
+                members = sorted(
+                    {r[2] for r in runs}
+                    | {m for o in multi.values() for m in o})
+                duplicated = sum(len(o) - 1 for o in multi.values())
+                total_lost += lost
+                total_dup += duplicated
                 rows.append({
-                    "stream": s, "delivered": len(seen),
+                    "stream": s, "delivered": delivered,
                     "range": [lo, hi], "members": members,
-                    "lost": len(missing), "missing": missing[:20],
-                    "duplicated": sum(len(o) - 1 for o in dups.values()),
+                    "lost": lost, "missing": missing,
+                    "duplicated": duplicated,
                     "dup_examples": dict(sorted(dups.items())[:5]),
                 })
         self._m_lost.set(total_lost)
@@ -351,6 +439,7 @@ class StreamRouter:
         drain_timeout_s: float = 8.0,
         drain_poll_s: float = 0.25,
         admit_saturation_horizon_s: float = 60.0,
+        admit_oom_horizon_s: float = 60.0,
         ema_alpha: float = 0.4,
         healthy_above: float = 0.7,
         unhealthy_below: float = 0.4,
@@ -373,6 +462,11 @@ class StreamRouter:
         # this horizon takes NO new admissions while any alternative
         # exists (obs/capacity.py time_to_saturation_s).
         self.admit_saturation_horizon_s = float(admit_saturation_horizon_s)
+        # r21: the byte-side twin — a member out of HBM headroom, or
+        # forecast to OOM within this horizon (obs/hbm.py
+        # time_to_oom_s), takes no new admissions even when its TIME
+        # headroom is still positive.
+        self.admit_oom_horizon_s = float(admit_oom_horizon_s)
         self.fleet = fleet or FleetAggregator(
             members, scrape_interval_s=scrape_interval_s,
             ema_alpha=ema_alpha, healthy_above=healthy_above,
@@ -627,6 +721,11 @@ class StreamRouter:
            out of headroom) is excluded while ANY unsaturated
            capacity-reporting member exists; when every reporter is
            saturated the least-bad one still beats blind hashing.
+           Memory is a second dimension of the same filter (r21): a row
+           reporting the HBM plane with zero byte-headroom, or an OOM
+           forecast within ``admit_oom_horizon_s``, is memory-unsafe
+           and excluded even when its TIME headroom is positive — time
+           and bytes are independent ways to be full.
         2. **score_ema** — no capacity reporters (pre-r18 fleet): max
            EMA health score, instance-name tie-break (the satellite
            determinism fix — the old scan kept first-seen on ties).
@@ -637,11 +736,23 @@ class StreamRouter:
         scored = [r for r in candidates if r.get("headroom") is not None]
         if scored:
             horizon = self.admit_saturation_horizon_s
+            oom_horizon = self.admit_oom_horizon_s
+
+            def memory_unsafe(r: dict) -> bool:
+                if not r.get("hbm"):
+                    return False    # memory-blind member: time decides
+                hb = r.get("hbm_headroom_bytes")
+                if hb is not None and hb <= 0:
+                    return True
+                tto = r.get("time_to_oom_s")
+                return tto is not None and tto <= oom_horizon
+
             safe = [
                 r for r in scored
                 if r["headroom"] > 0.0
                 and not (r.get("time_to_saturation_s") is not None
                          and r["time_to_saturation_s"] <= horizon)
+                and not memory_unsafe(r)
             ]
             pool = safe or scored
             pool.sort(key=lambda r: (

@@ -47,6 +47,9 @@ Metric families (obs registry, lint-clean under ``lint_exposition``):
   forecast driving scale-out (-1 = no member trending to saturation)
 - ``vep_supervisor_fleet_min_headroom`` — worst-member forecast
   headroom driving scale-in (-1 = unreported)
+- ``vep_supervisor_fleet_time_to_oom_seconds`` — earliest OOM forecast
+  across serving members (obs/hbm.py, r21; -1 = no member trending to
+  device-memory exhaustion)
 - ``vep_supervisor_surplus_held_seconds`` — how long the scale-in
   surplus condition has held (0 while breached)
 - ``vep_supervisor_passes_total`` — decision passes
@@ -138,6 +141,10 @@ class FleetSupervisor:
             "vep_supervisor_fleet_min_headroom",
             "Worst-member forecast headroom driving scale-in (-1 = "
             "unreported)").labels()
+        self._m_tto = obs_registry.gauge(
+            "vep_supervisor_fleet_time_to_oom_seconds",
+            "Earliest member OOM forecast driving scale-out (-1 = no "
+            "member trending to device-memory exhaustion)").labels()
         self._m_surplus = obs_registry.gauge(
             "vep_supervisor_surplus_held_seconds",
             "How long the scale-in surplus condition has held (0 while "
@@ -195,6 +202,8 @@ class FleetSupervisor:
                if r.get("time_to_saturation_s") is not None]
         head = [r["headroom"] for r in serving
                 if r.get("headroom") is not None]
+        tto = [r["time_to_oom_s"] for r in serving
+               if r.get("time_to_oom_s") is not None]
         return {
             "members": len(self.router.clients),
             "serving": [r["instance"] for r in serving],
@@ -203,6 +212,10 @@ class FleetSupervisor:
             # member's streams degrade first regardless of peer headroom,
             # and shed_to_fleet only helps while peers have room.
             "fleet_tts_s": min(tts) if tts else None,
+            # Same earliest-casualty logic for device memory (r21,
+            # obs/hbm.py): the first member whose allocator fails takes
+            # every stream on it down at once.
+            "fleet_tto_s": min(tto) if tto else None,
             # Scale-in wants the WORST member comfortable, and every
             # serving member reporting (one capacity-less member means
             # the surplus claim is unverifiable — hold).
@@ -262,6 +275,7 @@ class FleetSupervisor:
         self._record({"action": "spawn", "reason": reason,
                       "member": member, "url": base_url,
                       "fleet_tts_s": view["fleet_tts_s"],
+                      "fleet_tto_s": view.get("fleet_tto_s"),
                       "min_headroom": view["min_headroom"]})
         log.info("spawned %s (%s): %s", member, reason, base_url)
         return member
@@ -347,6 +361,16 @@ class FleetSupervisor:
                 member = self._try_spawn("saturation_forecast", view)
                 decision["action"] = "spawn" if member else "hold"
                 decision["member"] = member
+            elif (view["fleet_tto_s"] is not None
+                    and view["fleet_tto_s"] <= self.spawn_horizon_s):
+                # Device memory trending to exhaustion is as terminal as
+                # compute saturation — an OOM kills every stream on the
+                # member at once — but slower-moving, so it ranks after
+                # the saturation forecast (r21, obs/hbm.py).
+                decision["reason"] = "oom_forecast"
+                member = self._try_spawn("oom_forecast", view)
+                decision["action"] = "spawn" if member else "hold"
+                decision["member"] = member
             elif held >= self.surplus_hold_s:
                 decision["reason"] = "headroom_surplus"
                 victim = self._try_retire(view, health)
@@ -362,6 +386,8 @@ class FleetSupervisor:
             self._m_headroom.set(view["min_headroom"]
                                  if view["min_headroom"] is not None
                                  else -1.0)
+            self._m_tto.set(view["fleet_tto_s"]
+                            if view["fleet_tto_s"] is not None else -1.0)
             self._m_surplus.set(held)
             return decision
 
@@ -403,6 +429,8 @@ class FleetSupervisor:
                         "headroom": r.get("headroom"),
                         "time_to_saturation_s":
                             r.get("time_to_saturation_s"),
+                        "time_to_oom_s": r.get("time_to_oom_s"),
+                        "hbm_headroom_bytes": r.get("hbm_headroom_bytes"),
                         "healthy": r.get("healthy"),
                     }
                     for r in health

@@ -368,6 +368,14 @@ class CascadeScheduler:
 
     # -- introspection -------------------------------------------------------
 
+    def pool_nbytes(self):
+        """Device bytes held by the track-state clip ring: an int for the
+        single-chip pool, ``{shard: bytes}`` for the sharded pool, 0
+        before the pool resolves — the engine's obs/hbm.py
+        ``register_pool`` tap (the callable closes over the scheduler,
+        so the configure_mesh pool swap stays tracked)."""
+        return self._pool.nbytes() if self._pool is not None else 0
+
     def snapshot(self) -> dict:
         """JSON-able state for /api/v1/cascade and the obs.cascade stats
         section (r9 convention: quiet numbers, no device sync)."""

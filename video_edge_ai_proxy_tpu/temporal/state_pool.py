@@ -101,6 +101,15 @@ class TrackStatePool:
         row = self._slots.get(key)
         return row is not None and self._fill.get(row, 0) >= self.clip_len
 
+    def nbytes(self) -> int:
+        """Device bytes held by the ring RIGHT NOW (0 before the array
+        materializes) — the obs/hbm.py ``register_pool`` protocol.
+        Capacity-based, not occupancy-based: grow-by-8 rows stay
+        allocated after their tracks churn out, and the HBM plane
+        accounts for what the allocator holds, not what is logically
+        live. Metadata read only (``.nbytes``) — no transfer, no sync."""
+        return int(self._pool.nbytes) if self._pool is not None else 0
+
     # -- device ring -------------------------------------------------------
 
     def _ensure(self, rows: int) -> None:
@@ -275,6 +284,13 @@ class ShardedTrackStatePool:
 
     def full(self, key: str) -> bool:
         return self._pool_for(key).full(key)
+
+    def nbytes(self) -> Dict[str, int]:
+        """Per-shard ring bytes ``{shard: bytes}`` — the obs/hbm.py
+        sharded ``register_pool`` shape (the tracker sums shards for the
+        aggregate; the exactness pin checks each shard against its
+        sub-ring's ``.nbytes``)."""
+        return {str(s): p.nbytes() for s, p in enumerate(self.pools)}
 
     # -- sharded scatter / gather ------------------------------------------
 

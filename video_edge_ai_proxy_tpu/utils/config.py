@@ -343,6 +343,33 @@ class EngineConfig:
     # this; burning when BOTH windows exceed 1.0 (SRE multi-window).
     capacity_util_objective: float = 0.8
     capacity_eval_interval_s: float = 1.0    # forecast refresh throttle
+    # HBM attribution plane (obs/hbm.py, r21): the memory mirror of the
+    # capacity plane — per-(model, stem, geometry, bucket, mesh) compiled
+    # program footprints (memory_analysis() at the step-cache-miss site,
+    # donated aliasing credited), live register_pool byte ledgers for
+    # thumb/track-state/prefetch/collector pools, and an EWMA byte-slope
+    # time_to_oom_s forecast against the device budget that feeds the
+    # resilience ladder, StreamRouter._pick_admission, and the
+    # supervisor. hbm=False (default) is the kill switch: no compile tap,
+    # no pool callables, /api/v1/hbm answers 400, serving bit-identical
+    # (test-pinned, capacity=False convention).
+    hbm: bool = False
+    # 0 = auto: device.memory_stats()["bytes_limit"] on the real TPU,
+    # obs/hbm.py DEFAULT_SYNTHETIC_BUDGET_BYTES (4 GiB) on the CPU twin
+    # which reports no memory stats. Nonzero pins a synthetic budget
+    # (tests/soaks shrink it to make the forecast bite).
+    hbm_budget_bytes: int = 0
+    hbm_fast_window_s: float = 60.0          # fast high-water window
+    hbm_slow_window_s: float = 1800.0        # slow high-water window
+    # Sustainable HBM utilization: burn = window-peak util over this.
+    # Higher than the capacity objective (0.8) — memory is a level, and
+    # a level parked at 85% is fine where a rate at 85% is not.
+    hbm_util_objective: float = 0.9
+    hbm_eval_interval_s: float = 1.0         # forecast refresh throttle
+    # OOM forecast inside this horizon => pressure() true => the engine
+    # feeds hbm_pressure into the resilience ladder (shed before the
+    # allocator fails, not after).
+    hbm_pressure_horizon_s: float = 120.0
     # Persistent AOT prewarm cache (r19, engine/aot_cache.py).
     # compile_cache_dir above makes a RESTART cheap; this makes a fresh
     # SPAWN cheap: the cache dir carries a versioned prewarm manifest
