@@ -4,7 +4,8 @@
 .PHONY: all proto native install test bench graft clean redis-conformance \
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
 	roi-smoke fleet-obs-smoke stem-smoke router-smoke cascade-smoke \
-	capacity-smoke autoscale-smoke multichip-serve-smoke hbm-smoke
+	capacity-smoke autoscale-smoke multichip-serve-smoke hbm-smoke \
+	fault-smoke
 
 all: proto native
 
@@ -243,6 +244,28 @@ hbm-smoke:
 		   d['admission']['storm_by_member'], \
 		   d['admission']['exhausted_member_placements'], \
 		   d['replay']['hbm_off_bitexact']))"
+
+# Device-fault acceptance (round 22): hard-error shard loss dp4->dp3 on
+# the 8-virtual-device CPU twin (detect <=2 ticks, failover inside
+# budget with AOT survivor-variant prewarm, deterministic stream
+# evacuation, >=90% pin retention), an informational stall leg dp3->dp2
+# (hysteresis + probe quorum), and the frame-conservation ledger: zero
+# lost / zero duplicated outside the declared failover windows. Gates
+# live in tools/fault_smoke.py and exit non-zero on breach; the
+# committed FAULT_r01.json artifact is a pinned run of this tool. ~30 s.
+fault-smoke:
+	python tools/fault_smoke.py --out FAULT_r01.json | tee /tmp/vep_fault_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_fault_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); h=d['hard_fault']; led=d['ledger']; \
+		print('fault: hard dp4->dp3 detect %d ticks, failover %.0fms (aot %d/%d), evac %.0fms, pin retention %.2f; stall dp3->dp2 %.0fms composes=%s; ledger lost=%d dup=%d outside-window=%d (excused device_fault=%d)' \
+		% (h['detect_ticks'], h['failover']['failover_ms'], \
+		   h['failover']['aot']['recorded'], h['failover']['aot']['prewarmed'], \
+		   h['evac_first_result_ms'], h['pin_retention'], \
+		   d['stall_fault']['failover']['failover_ms'], \
+		   d['stall_fault']['repin_composes'], \
+		   led['lost'], led['duplicated'], led['lost_outside_window'], \
+		   led['dropped'].get('device_fault', 0)))"
 
 autoscale-smoke:
 	python tools/autoscale_smoke.py | tee /tmp/vep_autoscale_smoke.json

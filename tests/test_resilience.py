@@ -315,6 +315,57 @@ class TestDeadLetterSpool:
         assert sp.snapshot()["dropped_batches"] == 1
         assert sp.pending() == 0
 
+    def test_truncated_tail_salvaged_skip_and_count(self, tmp_path):
+        """ISSUE r22 satellite: a torn tail record (crash mid-write,
+        external truncation) costs its TAIL, not the whole batch — the
+        intact item prefix is delivered, the missing items counted."""
+        sp = DeadLetterSpool(str(tmp_path))
+        path = sp.put([b"keep-one", b"keep-two", b"torn-tail"])
+        blob = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(blob[:-4])      # hand-truncate inside the last item
+        out = []
+        assert sp.drain(lambda items: out.extend(items) or True) == 1
+        assert out == [b"keep-one", b"keep-two"]
+        snap = sp.snapshot()
+        assert snap["truncated_batches"] == 1
+        assert snap["dropped_events"] == 1       # only the torn item
+        assert snap["dropped_batches"] == 0      # batch NOT whole-dropped
+        assert snap["drained_events"] == 2
+        assert sp.pending() == 0                 # salvaged file removed
+
+    def test_tear_inside_item_length_prefix(self, tmp_path):
+        # The tear can land mid-length-prefix, not just mid-payload.
+        sp = DeadLetterSpool(str(tmp_path))
+        path = sp.put([b"whole", b"victim"])
+        blob = open(path, "rb").read()
+        # magic + count + (len + b"whole") + 2 bytes of victim's prefix
+        cut = len(b"VEPSPOOL1\n") + 4 + 4 + len(b"whole") + 2
+        with open(path, "wb") as fh:
+            fh.write(blob[:cut])
+        out = []
+        assert sp.drain(lambda items: out.extend(items) or True) == 1
+        assert out == [b"whole"]
+        snap = sp.snapshot()
+        assert snap["truncated_batches"] == 1 and snap["dropped_events"] == 1
+
+    def test_tear_before_first_item_drops_whole_file(self, tmp_path):
+        # Nothing salvageable past the header: counted as a dropped
+        # batch (with its declared events), never delivered empty.
+        sp = DeadLetterSpool(str(tmp_path))
+        path = sp.put([b"a", b"b"])
+        blob = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(blob[:len(b"VEPSPOOL1\n") + 4 + 1])
+        delivered = []
+        assert sp.drain(lambda items: delivered.append(items) or True) == 0
+        assert delivered == []
+        snap = sp.snapshot()
+        assert snap["dropped_batches"] == 1
+        assert snap["dropped_events"] == 2       # both declared items
+        assert snap["truncated_batches"] == 0
+        assert sp.pending() == 0
+
 
 class TestDegradationLadder:
     def make(self, clk, wd=None):

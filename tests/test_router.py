@@ -496,6 +496,107 @@ class TestBreakerIsolation:
         assert sorted(router.ring.members) == ["m0", "m1", "m2"]
 
 
+class _FakeResponse:
+    def __init__(self, payload: bytes):
+        self._payload = payload
+
+    def read(self):
+        return self._payload
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class TestMemberClientRetryDeadline:
+    """r22 satellite: control calls retry transient faults under a hard
+    per-call deadline, and an open breaker aborts without retrying."""
+
+    @staticmethod
+    def _counters(client):
+        return (client._m_retries.labels(client.name).value,
+                client._m_deadline.labels(client.name).value)
+
+    def test_transient_fault_retried_within_deadline(self, monkeypatch):
+        import urllib.request
+
+        clk = FakeClock()
+        calls = []
+
+        def flaky_urlopen(req, timeout=None):
+            calls.append(timeout)
+            if len(calls) == 1:
+                raise ConnectionResetError("member mid-restart")
+            return _FakeResponse(b'{"engine": {}}')
+
+        monkeypatch.setattr(urllib.request, "urlopen", flaky_urlopen)
+        client = MemberClient("mr1", "http://member:9999", timeout_s=1.0,
+                              clock=clk, sleep=clk.sleep)
+        r0, d0 = self._counters(client)
+        assert client.stats() == {"engine": {}}
+        r1, d1 = self._counters(client)
+        assert (r1 - r0, d1 - d0) == (1, 0)
+        assert len(calls) == 2
+        # Both attempts' socket timeouts fit the whole-call budget.
+        assert all(t <= client.timeout_s for t in calls)
+        # One transient fault does not move the breaker (threshold 3,
+        # and the retried success confirms the member is back).
+        assert client.breaker.state == "closed"
+
+    def test_hung_socket_contained_by_deadline(self, monkeypatch):
+        import random
+        import urllib.request
+
+        clk = FakeClock()
+        timeouts = []
+
+        def hung_urlopen(req, timeout=None):
+            # A wedged member: every read burns its full socket timeout
+            # (plus the socket layer's slop) and then times out.
+            timeouts.append(timeout)
+            clk.now += timeout + 0.001
+            raise TimeoutError("read timed out")
+
+        monkeypatch.setattr(urllib.request, "urlopen", hung_urlopen)
+        client = MemberClient("mr2", "http://member:9999", timeout_s=1.0,
+                              deadline_s=1.5, retry_attempts=4,
+                              clock=clk, sleep=clk.sleep)
+        client.retry._rng = random.Random(7)
+        r0, d0 = self._counters(client)
+        with pytest.raises(TimeoutError):
+            client.stats()
+        r1, d1 = self._counters(client)
+        assert d1 - d0 == 1
+        # First attempt got the full socket timeout; later attempts were
+        # clamped to the shrinking budget, so the whole call burned
+        # ~deadline_s — not retry_attempts * timeout_s.
+        assert timeouts[0] == client.timeout_s
+        assert all(t <= client.timeout_s for t in timeouts)
+        assert clk.now < 4 * client.timeout_s
+        assert clk.now <= client.deadline_s + 0.01
+
+    def test_breaker_open_aborts_without_retry(self, monkeypatch):
+        import urllib.request
+
+        def boom(req, timeout=None):
+            raise AssertionError("urlopen must not run with the breaker open")
+
+        monkeypatch.setattr(urllib.request, "urlopen", boom)
+        clk = FakeClock()
+        client = MemberClient("mr3", "http://member:9999", timeout_s=1.0,
+                              failure_threshold=2, clock=clk, sleep=clk.sleep)
+        for _ in range(client.breaker.failure_threshold):
+            client.breaker.record_failure()
+        assert client.breaker.state == "open"
+        r0, d0 = self._counters(client)
+        with pytest.raises(BreakerOpen):
+            client.stats()
+        r1, d1 = self._counters(client)
+        assert (r1 - r0, d1 - d0) == (0, 0)
+
+
 # ---------------------------------------------------------------------------
 # health-aware admission (admit)
 

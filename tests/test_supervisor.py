@@ -242,6 +242,90 @@ class TestScaleOut:
         assert "m0" in router.clients and "m1" in router.clients
 
 
+class TestDeviceFault:
+    """r22 satellite: a member's survivor-mesh failover count increasing
+    is a HARD capacity loss — it spawns inside the symmetric cooldown,
+    while soft forecasts keep respecting it."""
+
+    def test_hard_fault_spawns_inside_spawn_cooldown(self):
+        router = FakeRouter()
+        clock = FakeClock()
+        router.set("m0", time_to_saturation_s=10.0,
+                   device_fault_failovers=0)
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   spawn_cooldown_s=10.0)
+        assert sup.run_pass()["action"] == "spawn"      # forecast spawn
+        router.set("a0", warming=False, headroom=0.9)   # landed
+        clock.advance(5.0)                              # inside cooldown
+        # Soft forecast still held back...
+        assert sup.run_pass()["action"] == "hold"
+        # ...but a chip death on m0 is not a forecast echo.
+        router.set("m0", device_fault_failovers=1)
+        decision = sup.run_pass()
+        assert decision["action"] == "spawn"
+        assert decision["reason"] == "device_fault"
+        assert decision["fault_members"] == ["m0"]
+        assert len(router.added) == 2
+        event = sup.events[-1]
+        assert event["action"] == "spawn"
+        assert event["reason"] == "device_fault"
+
+    def test_fault_edge_consumed_after_one_attempt(self):
+        router = FakeRouter()
+        clock = FakeClock()
+        router.set("m0", device_fault_failovers=0)
+        sup = _sup(router, clock, spawner=_spawner_factory(router),
+                   spawn_cooldown_s=0.0)
+        sup.run_pass()                                  # seeds the count
+        router.set("m0", device_fault_failovers=1)
+        assert sup.run_pass()["reason"] == "device_fault"
+        router.set("a0", warming=False, headroom=0.9)
+        clock.advance(60.0)
+        # Count still elevated but unchanged: no second spawn per pass.
+        decision = sup.run_pass()
+        assert decision["reason"] != "device_fault"
+        assert len(router.added) == 1
+        # A FURTHER failover is a fresh edge.
+        router.set("m0", device_fault_failovers=2)
+        assert sup.run_pass()["reason"] == "device_fault"
+        assert len(router.added) == 2
+
+    def test_first_observation_never_fires_on_history(self):
+        # A supervisor attached to a fleet with failover history must
+        # not spawn for faults it never witnessed.
+        router = FakeRouter()
+        router.set("m0", device_fault_failovers=7)
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router))
+        decision = sup.run_pass()
+        assert decision["reason"] != "device_fault"
+        assert not router.added
+
+    def test_fault_ranked_above_saturation_forecast(self):
+        router = FakeRouter()
+        router.set("m0", time_to_saturation_s=10.0,
+                   device_fault_failovers=0)
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router))
+        sup.run_pass()                                  # seeds + spawns
+        router.set("a0", warming=False, headroom=0.9)
+        router.set("m0", device_fault_failovers=1)
+        clock_independent = sup.run_pass()
+        assert clock_independent["reason"] == "device_fault"
+
+    def test_fault_spawn_still_respects_max_members_and_warming(self):
+        router = FakeRouter(members=("m0", "m1"))
+        router.set("m0", device_fault_failovers=0)
+        sup = _sup(router, FakeClock(),
+                   spawner=_spawner_factory(router), max_members=2)
+        sup.run_pass()
+        router.set("m0", device_fault_failovers=1)
+        decision = sup.run_pass()
+        assert decision["reason"] == "device_fault"
+        assert decision["action"] == "hold"             # fleet ceiling
+        assert not router.added
+
+
 class TestAdvisory:
     def test_no_spawner_records_advice_without_acting(self):
         router = FakeRouter()

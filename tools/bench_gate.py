@@ -375,6 +375,42 @@ def multichip_serve_info(baseline_dir: str):
     return None
 
 
+def fault_info(baseline_dir: str):
+    """Newest committed FAULT_r*.json's shard-loss row, or None.
+
+    Round 22 informational carry-through: perf-gate logs show the
+    device-fault smoke's detection latency, failover wall time, stream
+    evacuation latency, pin retention, and the frame-conservation
+    verdict next to the fps verdict. NEVER gated here — fault_smoke.py
+    hard-gates its own run (detect ticks, failover budget, evac bound,
+    retention floor, zero lost/dup outside the declared windows); this
+    is trend visibility only.
+    """
+    paths = sorted(glob.glob(os.path.join(baseline_dir, "FAULT_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(art, dict) or "hard_fault" not in art:
+            continue
+        hard = art.get("hard_fault") or {}
+        fail = hard.get("failover") or {}
+        ledger = art.get("ledger") or {}
+        return {
+            "artifact": os.path.basename(path),
+            "detect_ticks": hard.get("detect_ticks"),
+            "failover_ms": fail.get("failover_ms"),
+            "evac_first_result_ms": hard.get("evac_first_result_ms"),
+            "pin_retention": hard.get("pin_retention"),
+            "ledger_lost": ledger.get("lost"),
+            "ledger_duplicated": ledger.get("duplicated"),
+            "ledger_lost_outside_window": ledger.get("lost_outside_window"),
+        }
+    return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("input", nargs="?", default="-",
@@ -419,6 +455,9 @@ def main(argv=None) -> int:
     multichip = multichip_serve_info(args.baseline_dir)
     if multichip is not None:
         report["multichip_serve"] = multichip  # informational, never gated
+    fault = fault_info(args.baseline_dir)
+    if fault is not None:
+        report["fault"] = fault              # informational, never gated
     print(json.dumps(report, indent=2))
     return 0 if report["passed"] else 1
 

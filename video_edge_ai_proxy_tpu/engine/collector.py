@@ -42,6 +42,46 @@ def stream_shard(device_id: str, shards: int) -> int:
     return zlib.crc32(device_id.encode("utf-8")) % shards
 
 
+def make_repin(base_shard_of, shards: int, dead):
+    """Deterministic rendezvous re-pin for survivor-mesh failover
+    (device-fault domain, r22).
+
+    ``base_shard_of`` is the routing function that was live when the
+    fault hit (``stream_shard`` bound to the old shard count, or a
+    previous failover's repin — composition handles cascaded faults);
+    ``shards`` its shard count; ``dead`` the faulted shard indices.
+    Survivor shards keep their old index order in the rebuilt mesh
+    (new shard i == i-th surviving old shard, same physical device), so:
+
+    - a stream whose home shard survives maps to that shard's new index
+      — it stays on the SAME device, state intact, which is what makes
+      failover a re-pin and not a full crc32 reshuffle (surviving
+      shards keep >= 90% of their pins by construction: they keep all
+      of them);
+    - a stream whose home shard died re-pins by highest-random-weight
+      (rendezvous) hashing over the survivors — deterministic,
+      uniformly spread, and stable under further shard loss (only
+      streams of the newly dead shard move again)."""
+    dead = frozenset(int(s) for s in dead)
+    survivors = [s for s in range(int(shards)) if s not in dead]
+    if not survivors:
+        raise ValueError("no surviving shards to re-pin onto")
+    new_index = {s: i for i, s in enumerate(survivors)}
+
+    def repin(device_id: str) -> int:
+        home = base_shard_of(device_id)
+        idx = new_index.get(home)
+        if idx is not None:
+            return idx
+        best = max(
+            survivors,
+            key=lambda t: zlib.crc32(f"{device_id}@{t}".encode("utf-8")),
+        )
+        return new_index[best]
+
+    return repin
+
+
 @dataclass
 class BatchGroup:
     """One shape-homogeneous device batch (before padding)."""
@@ -287,6 +327,9 @@ class Collector:
                 self._shards = 1
             else:
                 self._buckets = sharded
+        # Stream -> shard routing override (device-fault failover,
+        # ``repin``): None = the stable crc32 ``stream_shard`` map.
+        self._shard_fn = None
         # Degradation-ladder bucket cap (resilience/ladder.py rung 2):
         # None = full bucket list; an int hides buckets above it so new
         # batches compile/run at the next-smaller device program.
@@ -660,10 +703,35 @@ class Collector:
         """Partition a stream list (or (device_id, ...) tuple list) into
         per-shard lists, preserving order within each shard."""
         out: List[list] = [[] for _ in range(self._shards)]
+        fn = self._shard_fn
         for item in devs:
             did = item if isinstance(item, str) else item[0]
-            out[stream_shard(did, self._shards)].append(item)
+            s = stream_shard(did, self._shards) if fn is None else fn(did)
+            out[s % self._shards].append(item)
         return out
+
+    def repin(self, *, shards: int, shard_of,
+              buckets: Optional[Sequence[int]] = None) -> None:
+        """Survivor-mesh failover re-pin (device-fault domain, r22): swap
+        the routing function and shard count in one tick-thread call.
+        ``shard_of`` is a ``make_repin`` closure (or any stream -> shard
+        map the engine installs — engine and collector MUST share it,
+        same invariant as ``stream_shard``). The live assembly window is
+        invalidated: its slot plan was laid out under the old routing and
+        would land frames in segments the new mesh does not own; the
+        frames are still on their rings and next tick's plan re-reads
+        them (latest-wins, nothing lost). ``buckets`` replaces the bucket
+        list (the survivor dp count divides a different subset); buckets
+        not divisible by the new shard count are dropped, engine
+        pre-filter convention."""
+        self._window = None
+        self._shards = max(1, int(shards))
+        self._shard_fn = shard_of if self._shards > 1 else None
+        if buckets is not None:
+            sharded = tuple(sorted(
+                b for b in buckets if b % self._shards == 0))
+            if sharded:
+                self._buckets = sharded
 
     # -- incremental batch assembly (between ticks) --
 

@@ -798,6 +798,16 @@ class _PrefetchStage:
                 total += int(getattr(part, "nbytes", 0) or 0)
         return total
 
+    def reset(self, shards: int) -> None:
+        """Survivor-mesh failover (engine/fault.py): parity slots keyed
+        on the old shard count are meaningless once the mesh shrinks, so
+        drop them wholesale and restart attribution at slot 0. The tick
+        thread owns both submission and failover, and the failover path
+        waits every in-flight handle before calling this, so the queue
+        is empty and no key can be mid-flight."""
+        self.shards = max(1, int(shards))
+        self._slots.clear()
+
     def submit(self, group: BatchGroup, stop_event) -> Optional[_Prefetched]:
         """Queue a placement; blocks (in interruptible slices) while both
         slots are occupied — same bounded-pipeline stance as the drain
@@ -837,6 +847,19 @@ class _PrefetchStage:
                 # whole window was hidden behind compute.
                 pre.overlapped_s = pre.transfer_s
             pre.ready.set()
+
+
+def _group_slots(group: BatchGroup) -> int:
+    """Stream slots a batch group will emit when healthy — the unit the
+    FaultLedger (engine/fault.py) conserves. Coast groups emit one
+    result per coast entry, canvas groups one per distinct crop stream
+    (``_emit_canvas`` seeds its results dict from crop device_ids), and
+    classic groups one per occupied slot."""
+    if group.coast:
+        return len(group.coast)
+    if group.crops:
+        return len({c.device_id for c in group.crops})
+    return len(group.device_ids)
 
 
 class _RoiGate:
@@ -1271,6 +1294,22 @@ class InferenceEngine:
                 "collector_host",
                 lambda: self._collector.pool_nbytes()
                 if self._collector is not None else 0)
+        # Device-fault domain (engine/fault.py, r22): per-dispatch
+        # deadline/error watchdog + FaultLedger conservation proof +
+        # bounded-time survivor-mesh failover. cfg.fault=False leaves it
+        # None — no tap in the dispatch/drain paths, /api/v1/faults
+        # answers 400, serving bit-identical (test-pinned kill switch,
+        # capacity/hbm convention).
+        self.faults = None
+        if self._cfg.fault:
+            from .fault import FaultPlane
+
+            self.faults = FaultPlane(
+                deadline_ms=self._cfg.fault_dispatch_deadline_ms,
+                hysteresis=self._cfg.fault_hysteresis,
+                failover_budget_ms=self._cfg.fault_failover_budget_ms,
+                probe_timeout_ms=self._cfg.fault_probe_timeout_ms,
+            )
 
     @property
     def cascade(self):
@@ -1428,6 +1467,15 @@ class InferenceEngine:
                 self._cascade.configure_mesh(
                     mesh=self._mesh, shards=dp, shard_of=self._shard_of,
                 )
+            if self.faults is not None:
+                # Shard -> device-name strings for XLA-error attribution
+                # (a raw device error names the chip, not the shard).
+                from ..temporal.state_pool import shard_devices
+
+                self.faults.configure(shards=dp, shard_devices={
+                    s: [str(d)]
+                    for s, d in enumerate(shard_devices(self._mesh, dp))
+                })
             log.info(
                 "engine mesh: %s (buckets -> %s)",
                 dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
@@ -2353,6 +2401,13 @@ class InferenceEngine:
             # log-and-keep-going stance as the reference's worker loops,
             # rtsp_to_rtmp.py:186-187).
             try:
+                # Device-fault failover (engine/fault.py, r22): shards
+                # marked pending by the dispatch error path or the stall
+                # probe fail over HERE, at the top of the tick — the one
+                # point where this thread owns every mesh-coupled
+                # structure and no dispatch is mid-flight on it.
+                if self.faults is not None and self.faults.pending():
+                    self._execute_failover()
                 # Degradation ladder: one observe per tick (queue depth +
                 # last tick's duration vs budget); the rung gates the
                 # stages below. Closed-ladder overhead is one comparison.
@@ -2513,6 +2568,238 @@ class InferenceEngine:
                 if elapsed < tick_s:
                     self._stop.wait(tick_s - elapsed)
 
+    def _probe_shards(self) -> List[int]:
+        """Default stall probe (engine/fault.py): one tiny H2D+D2H
+        round-trip per shard lead device, each bounded by
+        ``fault_probe_timeout_ms``. A wedged chip cannot answer — its
+        worker thread stays stuck in the fetch (daemon, abandoned) and
+        the shard reports faulted. Probes run concurrently so the whole
+        sweep is one timeout, not shards-many. ``faults.probe_fn``
+        (tests, the chaos soak) replaces this wholesale."""
+        import jax
+
+        from ..temporal.state_pool import shard_devices
+
+        timeout_s = self.faults.probe_timeout_ms / 1000.0
+        leads = shard_devices(self._mesh, self._shards)
+        done = [threading.Event() for _ in leads]
+
+        def roundtrip(dev, ev):
+            try:
+                x = jax.device_put(np.ones((8,), np.float32), dev)
+                if float(np.asarray(x).sum()) == 8.0:
+                    ev.set()
+            except Exception:
+                log.debug("shard probe failed", exc_info=True)
+
+        threads = [
+            threading.Thread(target=roundtrip, args=(dev, ev), daemon=True)
+            for dev, ev in zip(leads, done)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + timeout_s
+        bad: List[int] = []
+        for s, ev in enumerate(done):
+            if not ev.wait(max(0.0, deadline - time.monotonic())):
+                bad.append(s)
+        return bad
+
+    def _execute_failover(self) -> None:
+        """Survivor-mesh failover (tentpole, engine/fault.py): executed
+        at the top of the tick, the one point where this thread owns
+        every mesh-coupled structure and nothing is mid-dispatch.
+        Bounded end to end by ``fault_failover_budget_ms`` (best-effort:
+        each leg is bounded, an over-budget run completes and is
+        reported as such rather than abandoned half-swapped).
+
+        Order matters: (1) flush the drain pipeline so no in-flight
+        batch still references the old mesh's arrays; (2) rebuild the
+        mesh over the survivors IN OLD ORDER — surviving shards keep
+        their physical device, which is what lets ``make_repin`` keep
+        their stream pins (>= 90% gate holds by construction); (3)
+        re-place params, counted-reset the sharded carry state
+        (thumbnails, cascade tracks — a dead chip's rows are gone;
+        state rebuilds from the stream in ticks, and the ledger records
+        the reset instead of pretending), re-pin the collector; (4)
+        record + prewarm the survivor-mesh program variants so the AOT
+        manifest warms the NEXT failover too."""
+        t0 = time.monotonic()
+        budget_s = self.faults.failover_budget_ms / 1000.0
+        pending = self.faults.pending()
+        if self._mesh is None:
+            log.error("fault pending with no mesh; clearing: %s", pending)
+            self.faults.clear_pending("no_mesh")
+            return
+        if any(self._mesh.shape.get(a, 1) > 1
+               for a in ("fsdp", "sp", "tp", "ep", "pp")):
+            # Model-sharded meshes cannot lose a chip without losing
+            # parameter shards — failover is a dp-replication feature.
+            log.error(
+                "device fault on a model-sharded mesh %s; survivor "
+                "failover requires dp-only replication — not failing over",
+                dict(self._mesh.shape),
+            )
+            self.faults.clear_pending("unsupported_mesh")
+            return
+        devs = list(np.asarray(self._mesh.devices).reshape(-1))
+        dead = sorted(s for s in pending if 0 <= int(s) < len(devs))
+        if not dead:
+            log.error("pending fault shards %s out of range; clearing",
+                      pending)
+            self.faults.clear_pending("unattributed")
+            return
+        survivors = [d for s, d in enumerate(devs) if s not in set(dead)]
+        if not survivors:
+            log.error("all %d shards faulted; no survivor mesh — engine "
+                      "keeps the old mesh and the faults stay visible in "
+                      "/api/v1/faults", len(devs))
+            self.faults.clear_pending("no_survivors")
+            return
+        kinds = sorted(set(pending.values()))
+        log.warning(
+            "FAILOVER: shards %s faulted (%s); rebuilding dp%d -> dp%d",
+            dead, ",".join(kinds), len(devs), len(survivors),
+        )
+        # (1) Bounded drain flush: in-flight batches hold old-mesh
+        # arrays (and pooled-buffer leases). Half the budget at most —
+        # a wedged chip's fetch never finishes, and its batch is the
+        # drain thread's to drop (drain_error, counted).
+        flush_deadline = t0 + budget_s / 2.0
+        while self._drain_q.unfinished_tasks \
+                and time.monotonic() < flush_deadline \
+                and not self._stop.is_set():
+            time.sleep(0.01)
+        if self._drain_q.unfinished_tasks:
+            log.warning(
+                "drain pipeline did not flush within %.0f ms; proceeding "
+                "(stuck batches drop as drain_error)",
+                budget_s * 500.0,
+            )
+        from ..parallel import make_mesh
+        from ..temporal.state_pool import shard_devices
+        from .collector import make_repin
+
+        old_shards = self._shards
+        old_shard_of = self._shard_of
+        old_keys = list(self._step_cache.keys())
+        # Stream census BEFORE the swap: pin = home shard's device under
+        # the old routing, kept = that device survived (same stream ->
+        # same chip after the swap, by survivor ordering).
+        streams = list(self._collector.inference_streams())
+        kept = sum(1 for did in streams
+                   if old_shard_of(did) % old_shards not in set(dead))
+        new_shards = len(survivors)
+        new_mesh = make_mesh(dp=new_shards, devices=survivors)
+        repin = make_repin(old_shard_of, old_shards, dead)
+        new_buckets = tuple(
+            b for b in self._cfg.batch_buckets if b % new_shards == 0
+        ) or (new_shards,)
+        # (2) The swap. Step cache first: every cached program was
+        # compiled for the old mesh's sharding.
+        self._step_cache.clear()
+        self._mesh = new_mesh
+        self._shards = new_shards
+        self._shard_of = repin
+        self._buckets = new_buckets
+        if self._xfer is not None:
+            self._xfer.reset(new_shards)
+        # (3) Params back onto the survivor mesh. dp-only means fully
+        # replicated — every survivor holds a complete copy, so
+        # re-placement never needs the dead chip's buffers.
+        for name in list(self._models):
+            spec, mod, variables = self._models[name]
+            try:
+                variables = self._place_variables(variables)
+            except Exception:
+                log.exception(
+                    "re-placing model '%s' on the survivor mesh failed; "
+                    "keeping old placement (XLA will re-shard lazily)",
+                    name,
+                )
+            self._models[name] = (spec, mod, variables)
+            if self._spec is not None and name == self._spec.name:
+                self._variables = variables
+        evacuated: Dict[str, int] = {}
+        if isinstance(self._thumbs, _ShardedThumbPool):
+            evacuated["quality_thumbs"] = len(self._thumbs)
+            self._thumbs = _ShardedThumbPool(
+                self._cfg.quality_thumb, mesh=new_mesh, shards=new_shards,
+                shard_of=repin,
+            )
+        if self._cascade is not None:
+            try:
+                evacuated.update(self._cascade.repin_mesh(
+                    mesh=new_mesh, shards=new_shards, shard_of=repin,
+                ))
+            except Exception:
+                log.exception("cascade re-pin failed; state dropped")
+        self._collector.repin(
+            shards=new_shards, shard_of=repin, buckets=new_buckets,
+        )
+        self.faults.configure(shards=new_shards, shard_devices={
+            s: [str(d)]
+            for s, d in enumerate(shard_devices(new_mesh, new_shards))
+        })
+        # (4) AOT: stamp the survivor-mesh variants of every program the
+        # old mesh served into the manifest, then prewarm whatever the
+        # manifest already holds for THIS mesh spec (a previous failover
+        # to the same survivor count recorded them — warm hit).
+        aot = {"recorded": 0, "prewarmed": 0}
+        if self._aot_dir:
+            from . import aot_cache
+
+            seen = set()
+            for (model, stem, hw, _bucket) in old_keys:
+                for b in new_buckets:
+                    if (model, stem, hw, b) in seen:
+                        continue
+                    seen.add((model, stem, hw, b))
+                    aot_cache.record_program(
+                        self._aot_dir, model=model, stem=stem,
+                        src_hw=hw, bucket=b, mesh=new_mesh,
+                    )
+                    aot["recorded"] += 1
+            programs = aot_cache.load_manifest(self._aot_dir) or []
+            for entry in aot_cache.prewarm_entries(programs,
+                                                   mesh=new_mesh):
+                try:
+                    h, w, bucket = (int(v) for v in entry[:3])
+                    if bucket not in self._buckets:
+                        continue
+                    self.compile_for(
+                        (h, w), bucket, str(entry[3]) or None,
+                        stem=str(entry[4]) if entry[4] else None,
+                    )
+                    aot["prewarmed"] += 1
+                except Exception:
+                    log.exception("survivor prewarm %r failed; continuing",
+                                  entry)
+        failover_ms = (time.monotonic() - t0) * 1000.0
+        event = {
+            "ts": time.time(),
+            "tick": self.ticks,
+            "kinds": kinds,
+            "shards_dead": dead,
+            "survivors": new_shards,
+            "failover_ms": failover_ms,
+            "over_budget": failover_ms > self.faults.failover_budget_ms,
+            "evacuated": evacuated,
+            "streams": {
+                "total": len(streams),
+                "kept": kept,
+                "repinned": len(streams) - kept,
+            },
+            "aot": aot,
+        }
+        self.faults.note_failover(event)
+        log.warning(
+            "FAILOVER complete in %.0f ms: dp%d over %s; %d/%d stream "
+            "pins kept, evacuated=%s, aot=%s",
+            failover_ms, new_shards, [str(d) for d in survivors],
+            kept, len(streams), evacuated, aot,
+        )
+
     def _dispatch(self, groups: List[BatchGroup], t_collect: float) -> None:
         """Dispatch one tick's collected groups to the device.
 
@@ -2536,6 +2823,13 @@ class InferenceEngine:
         may still be reading the pooled host buffer.
         """
         trace_on = tracer.enabled
+        if self.faults is not None:
+            # FaultLedger conservation: every stream slot entering the
+            # device pipeline is counted in here and counted out in the
+            # emit paths (or as a reasoned drop) — the balance the
+            # failover gates check.
+            for g in groups:
+                self.faults.ledger.note_dispatched(_group_slots(g))
         if self._roi is not None and groups:
             # Tracker-coasted groups (gated-idle streams): no device
             # work, but they ride the drain queue so per-stream emit
@@ -2631,7 +2925,16 @@ class InferenceEngine:
                         outputs = dict(outputs)
                         outputs.pop("quality_stats", None)
                         outputs.pop("quality_thumbs", None)
-            except Exception:
+            except Exception as exc:
+                shard = None
+                if self.faults is not None:
+                    # Classify before the lease sweep: an XLA error that
+                    # names a shard's device (or carries fault_shard)
+                    # arms the failover the next tick picks up, and the
+                    # dropped slots below are attributed to it.
+                    shard = self.faults.note_error(exc, self.ticks)
+                reason = ("device_fault" if shard is not None
+                          else "dispatch_error")
                 for gj in range(gi, len(groups)):
                     if gj < len(handles) and handles[gj] is not None:
                         # Bounded: block_until_ready in the transfer loop
@@ -2639,6 +2942,18 @@ class InferenceEngine:
                         # the copy may still be reading the host buffer.
                         handles[gj].ready.wait(timeout=5.0)
                     self._collector.release(groups[gj])
+                    if self.faults is not None:
+                        self.faults.note_dropped(
+                            _group_slots(groups[gj]), reason)
+                    if trace_on:
+                        for did, m in zip(groups[gj].device_ids,
+                                          groups[gj].metas):
+                            if tracer.sampled(m.packet):
+                                tracer.record(
+                                    did, "dropped", m.packet,
+                                    reason=reason,
+                                    trace_id=trace_id_of(m, did),
+                                )
                 raise
             self.batches += 1
             self._m_batches.inc()
@@ -3053,6 +3368,29 @@ class InferenceEngine:
             # callables are metadata reads, and between refreshes the
             # per-tick cost is one clock read and a compare.
             self.hbm.evaluate()
+        if self.faults is not None and self.faults.stall_suspected():
+            # Stall attribution (tick thread — the drain thread only
+            # raised the suspicion): probe each shard's lead device with
+            # a bounded round-trip; shards that fail become pending and
+            # fail over at the top of the next tick. An unattributed
+            # stall (every probe passes — generic contention, not a dead
+            # chip) resolves the suspicion without a failover.
+            try:
+                probe = self.faults.probe_fn or self._probe_shards
+                bad = probe()
+            except Exception:
+                log.exception("shard fault probe failed; unattributed")
+                bad = []
+            marked = self.faults.resolve_stall(bad, self.ticks)
+            if marked:
+                log.warning(
+                    "device stall attributed to shard(s) %s; failover "
+                    "pending", marked,
+                )
+            else:
+                log.warning(
+                    "dispatch deadline overruns resolved unattributed "
+                    "(all shard probes healthy)")
 
     def _slo_tick(self, inferred: Sequence[str]) -> None:
         """Per-tick SLO sampling + throttled evaluation (obs/slo.py).
@@ -3112,6 +3450,9 @@ class InferenceEngine:
                     tracer.record(did, "dropped", m.packet,
                                   reason="shutdown_drain",
                                   trace_id=trace_id_of(m, did))
+        if self.faults is not None:
+            self.faults.note_dropped(
+                _group_slots(inflight.group), "shutdown_drain")
         self._collector.release(inflight.group)
 
     def _drain_loop(self) -> None:
@@ -3128,6 +3469,12 @@ class InferenceEngine:
                 self._emit(inflight)
             except Exception:
                 log.exception("drain failed; continuing")
+                if self.faults is not None:
+                    # Conservative: a partial emission still counts the
+                    # whole group dropped — the ledger's lost figure can
+                    # only understate health, never hide a loss.
+                    self.faults.note_dropped(
+                        _group_slots(inflight.group), "drain_error")
             finally:
                 self._collector.release(inflight.group)
                 # Closes the in-flight window the prefetch stage's
@@ -3148,6 +3495,12 @@ class InferenceEngine:
         host = {k: np.asarray(v) for k, v in inflight.outputs.items()}  # D2H
         t_drained = time.time()
         device_ms = (t_drained - inflight.t_submit) * 1000.0
+        if self.faults is not None:
+            # Stall watchdog signal (engine/fault.py): submit-to-drained
+            # wall time against fault_dispatch_deadline_ms with
+            # hysteresis — a wedged chip shows up here first, as the
+            # drain future that stops resolving on time.
+            self.faults.note_drain(device_ms)
         self._m_device.labels(group.model or self._spec.name).observe(
             device_ms
         )
@@ -3289,6 +3642,12 @@ class InferenceEngine:
             trace_id=meta.trace_id,
             parent_span=meta.parent_span,
         )
+        if self.faults is not None:
+            # (packet, timestamp_ms): monotone per stream even for
+            # producers that never stamp packet ids (ledger dup/rebase
+            # detection, engine/fault.py).
+            self.faults.ledger.note_emitted(
+                device_id, (meta.packet, meta.timestamp_ms))
         self._publish(result)
         if self._cfg.stage_trace:
             self.stage_records.append({
@@ -3465,6 +3824,12 @@ class InferenceEngine:
             trace_id=meta.trace_id,
             parent_span=meta.parent_span,
         )
+        if self.faults is not None:
+            # (packet, timestamp_ms): monotone per stream even for
+            # producers that never stamp packet ids (ledger dup/rebase
+            # detection, engine/fault.py).
+            self.faults.ledger.note_emitted(
+                device_id, (meta.packet, meta.timestamp_ms))
         self._publish(result)
         self._annotate(device_id, meta, detections, spec)
         st = self._stats.setdefault(device_id, StreamStats())

@@ -252,6 +252,28 @@ class MemberState:
         v = (self.hbm or {}).get("time_to_oom_s")
         return float(v) if v is not None else None
 
+    # r22 device-fault signals (rides /api/v1/stats -> obs.faults; no
+    # extra fetch). None when the member does not report the fault
+    # domain (disabled or pre-r22 — mixed-version fleet).
+
+    def _faults(self) -> Optional[dict]:
+        f = ((self.stats or {}).get("obs") or {}).get("faults")
+        return f if isinstance(f, dict) else None
+
+    def device_fault_failovers(self) -> Optional[int]:
+        """Cumulative survivor-mesh failovers the member has executed —
+        the supervisor's device_fault spawn trigger (an INCREASE means a
+        chip just died; the member serves degraded on fewer shards)."""
+        f = self._faults()
+        if f is None or f.get("failovers") is None:
+            return None
+        return int(f["failovers"])
+
+    def device_fault_active(self) -> Optional[bool]:
+        """A fault window is open or shards are pending failover."""
+        f = self._faults()
+        return bool(f.get("active")) if f is not None else None
+
 
 class FleetAggregator:
     """Scrape-and-merge tier over N member engines.
@@ -475,6 +497,10 @@ class FleetAggregator:
             "hbm_headroom_bytes": m.hbm_headroom_bytes(),
             "hbm_utilization": m.hbm_util(),
             "time_to_oom_s": m.time_to_oom_s(),
+            # r22 device-fault domain (None-keyed when unreported — the
+            # supervisor skips fault-blind members).
+            "device_fault_failovers": m.device_fault_failovers(),
+            "device_fault_active": m.device_fault_active(),
             "score": round(score, 4),
             "score_ema": round(m.score_ema, 4)
             if m.score_ema is not None else None,

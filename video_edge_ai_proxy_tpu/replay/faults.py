@@ -35,6 +35,12 @@ them (replay/harness.py):
 - ``score_drift`` — every detect step's scores are scaled down for
   ``duration_s`` (silent model/numerics regression: the drift scorer
   must move and the canary checksum must mismatch while it lasts).
+- ``shard_fault`` — ONE mesh shard's step execution fails hard (or, with
+  ``duration_s`` > 0, stalls its drain fetch for that long) from ``at_s``
+  on (``device_id`` carries the shard index as a string — the device-
+  fault domain's chaos kind, injected by tools/fault_smoke.py as a
+  per-shard failing/stalling step wrapper; the engine must detect,
+  fail over to the survivor mesh, and prove frame conservation).
 
 JSON round-trip so plans can be committed next to artifacts.
 """
@@ -47,7 +53,7 @@ from dataclasses import asdict, dataclass, field
 KINDS = (
     "camera_kill", "camera_restore", "frame_gap", "bus_stall",
     "slow_subscriber", "uplink_down", "bus_flap", "device_stall",
-    "black_frame", "frozen_frame", "score_drift",
+    "black_frame", "frozen_frame", "score_drift", "shard_fault",
 )
 
 #: Schedule template for the resilience kinds (fraction of the soak

@@ -9,7 +9,10 @@ uplink recovers, so a cloud outage costs latency, not data.
 Format: per batch, one ``<seq>.batch`` file — magic header, ``<I`` item
 count, then per item ``<I`` length + raw bytes (the serialized
 AnnotateRequest protos exactly as queued). Writes are atomic (tmp file +
-``os.replace``) so a crash mid-write never leaves a torn batch. The
+``os.replace``) so a crash mid-write never leaves a torn batch; drain
+nevertheless tolerates one (external truncation, non-atomic copies) by
+salvaging the intact item prefix and counting only the torn tail as
+dropped — a damaged file costs its tail, not the whole batch. The
 spool is bounded by ``max_bytes``/``max_batches``; when full, the
 *oldest* batches are evicted (and counted in ``dropped_batches``) so
 accounting still balances: published = delivered + queue-dropped +
@@ -62,6 +65,7 @@ class DeadLetterSpool:
         self.drained_events = 0
         self.dropped_batches = 0
         self.dropped_events = 0
+        self.truncated_batches = 0
         self._m_pending = obs_registry.gauge(
             "vep_spool_pending_batches", "Dead-letter batches awaiting re-drain", ("spool",)
         ).labels(os.path.basename(directory) or "spool")
@@ -73,6 +77,11 @@ class DeadLetterSpool:
         ).labels(os.path.basename(directory) or "spool")
         self._m_dropped = obs_registry.counter(
             "vep_spool_dropped_total", "Spooled batches evicted by size bounds", ("spool",)
+        ).labels(os.path.basename(directory) or "spool")
+        self._m_truncated = obs_registry.counter(
+            "vep_spool_truncated_total",
+            "Spooled batches with a torn tail salvaged on drain",
+            ("spool",),
         ).labels(os.path.basename(directory) or "spool")
         self._m_pending.set(len(existing))
 
@@ -96,24 +105,41 @@ class DeadLetterSpool:
         return b"".join(parts)
 
     @staticmethod
-    def _decode(blob: bytes) -> Optional[List[bytes]]:
+    def _salvage(blob: bytes) -> tuple:
+        """(items, missing) — the valid item prefix of a batch blob plus
+        how many declared items the tail lost. A crash mid-write (or
+        external truncation) tears the file at an arbitrary byte: every
+        length-prefixed item before the tear is intact and recoverable,
+        only the torn tail is gone. (None, 0) when nothing is usable —
+        bad magic or a header too short to carry the count."""
         if not blob.startswith(_MAGIC):
-            return None
+            return None, 0
         off = len(_MAGIC)
         try:
             (count,) = _U32.unpack_from(blob, off)
-            off += _U32.size
-            items: List[bytes] = []
-            for _ in range(count):
-                (n,) = _U32.unpack_from(blob, off)
-                off += _U32.size
-                items.append(blob[off : off + n])
-                if len(items[-1]) != n:
-                    return None
-                off += n
-            return items
         except struct.error:
-            return None
+            return None, 0
+        off += _U32.size
+        items: List[bytes] = []
+        for _ in range(count):
+            try:
+                (n,) = _U32.unpack_from(blob, off)
+            except struct.error:
+                break
+            off += _U32.size
+            item = blob[off : off + n]
+            if len(item) != n:
+                break
+            items.append(item)
+            off += n
+        return items, count - len(items)
+
+    @staticmethod
+    def _decode(blob: bytes) -> Optional[List[bytes]]:
+        """Strict decode: a torn tail is corruption (None). The drain
+        path uses :meth:`_salvage` instead — skip-and-count."""
+        items, missing = DeadLetterSpool._salvage(blob)
+        return items if items is not None and not missing else None
 
     def _evict_locked(self, incoming_bytes: int) -> None:
         files = self._files_locked()
@@ -176,8 +202,14 @@ class DeadLetterSpool:
         ``handler(items) -> True`` deletes the file and continues; False
         stops the drain so order is preserved for the next attempt (an
         exception propagates with the file likewise left in place).
-        Returns the number of batches delivered. Corrupt files are
-        removed and counted as dropped.
+        Returns the number of batches delivered.
+
+        A batch with a torn tail (crash mid-write, external truncation)
+        is *salvaged*, not dropped wholesale: the intact item prefix is
+        delivered and only the missing tail items are counted into
+        ``dropped_events`` (plus ``truncated_batches``). Files unusable
+        past the header (bad magic, short header) are removed and
+        counted as dropped batches.
         """
         delivered = 0
         while True:
@@ -190,14 +222,27 @@ class DeadLetterSpool:
                     blob = open(path, "rb").read()
                 except OSError:
                     break
-                items = self._decode(blob)
-                if items is None:
+                items, missing = self._salvage(blob)
+                if items is None or not items:
+                    # Nothing recoverable: bad magic/header, or the tear
+                    # landed before the first item survived.
                     log.error("spool: corrupt batch %s removed", os.path.basename(path))
                     os.remove(path)
                     self.dropped_batches += 1
+                    self.dropped_events += missing if items is not None else 0
                     self._m_dropped.inc()
                     self._m_pending.set(len(self._files_locked()))
                     continue
+                if missing:
+                    self.truncated_batches += 1
+                    self.dropped_events += missing
+                    self._m_truncated.inc()
+                    log.warning(
+                        "spool: batch %s torn mid-write; salvaged %d of %d items",
+                        os.path.basename(path),
+                        len(items),
+                        len(items) + missing,
+                    )
             # Handler runs outside the lock: it may post to the network.
             if not handler(items):
                 break
@@ -243,4 +288,5 @@ class DeadLetterSpool:
             "drained_events": self.drained_events,
             "dropped_batches": self.dropped_batches,
             "dropped_events": self.dropped_events,
+            "truncated_batches": self.truncated_batches,
         }

@@ -231,6 +231,12 @@ def build_app(
             # /api/v1/hbm serves).
             "hbm": engine.hbm.snapshot()
             if engine is not None and engine.hbm is not None else None,
+            # r22 device-fault domain: watchdog/failover state + the
+            # frame-conservation ledger (the same snapshot
+            # /api/v1/faults serves; the fleet aggregator reads
+            # failovers/active to trigger device_fault spawns).
+            "faults": engine.faults.snapshot()
+            if engine is not None and engine.faults is not None else None,
         }
         return web.json_response(out)
 
@@ -301,6 +307,20 @@ def build_app(
         if engine.hbm is None:
             return _error(400, "hbm plane disabled (engine.hbm config)")
         out = await asyncio.to_thread(engine.hbm.snapshot)
+        return web.json_response(out)
+
+    async def faults(_request: web.Request) -> web.Response:
+        """Device-fault domain (engine/fault.py): watchdog config +
+        state (pending shards, stall suspicion, overrun streak), the
+        detection/failover event log, and the frame-conservation
+        ledger balance. 400 when the domain is disabled (engine.fault
+        config, same kill-switch convention as /api/v1/hbm)."""
+        if engine is None:
+            return _error(400, "engine not running")
+        if engine.faults is None:
+            return _error(
+                400, "fault domain disabled (engine.fault config)")
+        out = await asyncio.to_thread(engine.faults.snapshot)
         return web.json_response(out)
 
     async def trace(request: web.Request) -> web.Response:
@@ -520,6 +540,7 @@ def build_app(
     app.router.add_get("/api/v1/cascade", cascade)
     app.router.add_get("/api/v1/capacity", capacity)
     app.router.add_get("/api/v1/hbm", hbm)
+    app.router.add_get("/api/v1/faults", faults)
     app.router.add_get("/api/v1/trace", trace)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)

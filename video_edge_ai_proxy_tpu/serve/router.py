@@ -79,6 +79,7 @@ from ..obs import registry as obs_registry
 from ..obs.fleet import FleetAggregator
 from ..resilience.breaker import BreakerOpen, CircuitBreaker
 from ..resilience.ladder import RUNGS
+from ..resilience.policy import Deadline, RetryPolicy
 
 log = logging.getLogger(__name__)
 
@@ -171,40 +172,91 @@ class HashRing:
 
 
 class MemberClient:
-    """REST client for one engine member, breaker-guarded.
+    """REST client for one engine member: retry + deadline + breaker.
 
     Every call goes through the member's :class:`CircuitBreaker`
     (``vep_breaker_state{dep="router_<member>"}``): after
     ``failure_threshold`` consecutive faults the router fails fast on
     this member — no connect timeouts burning the control loop — and a
-    half-open probe re-admits it. Timeouts are short: the router's pass
-    must complete well inside one scrape interval.
+    half-open probe re-admits it. On top of the breaker (r22 satellite),
+    each control call runs under a :class:`RetryPolicy` bounded by a
+    per-call :class:`Deadline`: transient faults (a member mid-restart,
+    one dropped SYN) retry with decorrelated jitter instead of failing a
+    whole router/supervisor pass, while a HUNG member's REST socket —
+    the failure mode a plain retry loop makes worse — can never stall
+    the pass past ``deadline_s``, because every attempt's socket timeout
+    is clamped to the remaining budget and the loop refuses to sleep
+    past it. An open breaker aborts immediately (no retrying into a
+    circuit that exists to fail fast). Counters:
+    ``vep_router_member_retries_total{member}`` and
+    ``vep_router_member_deadline_exceeded_total{member}``.
     """
 
     def __init__(self, name: str, base_url: str, *, timeout_s: float = 2.0,
                  failure_threshold: int = 3, recovery_timeout_s: float = 5.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 retry_attempts: int = 2, deadline_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
+        # Whole-call budget: attempts + backoff sleeps all fit inside it.
+        # Default leaves room for one full-timeout attempt, a jittered
+        # backoff, and a clamped second attempt — still well inside one
+        # scrape interval times a small member count.
+        self.deadline_s = (float(deadline_s) if deadline_s is not None
+                           else 2.5 * self.timeout_s)
+        self._clock = clock
+        self.retry = RetryPolicy(
+            max_attempts=max(1, int(retry_attempts)),
+            base_s=0.05, cap_s=0.5, clock=clock, sleep=sleep)
         self.breaker = CircuitBreaker(
             f"router_{name}", failure_threshold=failure_threshold,
             recovery_timeout_s=recovery_timeout_s, clock=clock)
+        self._m_retries = obs_registry.counter(
+            "vep_router_member_retries_total",
+            "Member control-call attempts retried after a transient "
+            "fault", ("member",))
+        self._m_deadline = obs_registry.counter(
+            "vep_router_member_deadline_exceeded_total",
+            "Member control calls that exhausted their deadline budget "
+            "(hung REST socket contained)", ("member",))
 
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None) -> bytes:
         import urllib.request
 
+        deadline = Deadline.after(self.deadline_s, clock=self._clock)
+
         def call() -> bytes:
+            # Per-attempt socket timeout clamped to the remaining
+            # budget: a wedged accept()/read() on the member side times
+            # out when the BUDGET says so, not timeout_s later.
+            deadline.check(f"{self.name} {method} {path}")
             req = urllib.request.Request(
                 self.base_url + path, method=method,
                 data=json.dumps(body).encode() if body is not None else None,
                 headers={"Content-Type": "application/json"}
                 if body is not None else {})
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            timeout = max(0.001, deadline.clamp(self.timeout_s))
+            with urllib.request.urlopen(req, timeout=timeout) as r:
                 return r.read()
 
-        return self.breaker.call(call)
+        def on_retry(_attempt: int, _exc: BaseException,
+                     _delay: float) -> None:
+            self._m_retries.labels(self.name).inc()
+
+        try:
+            return self.retry.run(
+                lambda: self.breaker.call(call),
+                abort_on=(BreakerOpen,), deadline=deadline,
+                on_retry=on_retry)
+        except BreakerOpen:
+            raise
+        except BaseException:
+            if deadline.expired:
+                self._m_deadline.labels(self.name).inc()
+            raise
 
     # -- member control surface (serve/rest_api.py routes) --
 

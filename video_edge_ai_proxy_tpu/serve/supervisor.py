@@ -14,7 +14,11 @@ per interval over the router's merged fleet health:
   :meth:`~.router.StreamRouter.add_member`. The spawned member boots
   against the shared AOT prewarm cache (engine/aot_cache.py) so it
   holds its program set — and takes migrated traffic — within one
-  scrape interval instead of a multi-second compile ramp.
+  scrape interval instead of a multi-second compile ramp. A
+  ``device_fault`` spawn (r22: a member's survivor-mesh failover count
+  increased — a chip died and the member serves degraded) ranks above
+  every forecast and bypasses the symmetric cooldown: the capacity loss
+  already happened, it is not a forecast echo to be damped.
 - **scale in** — when every serving member has held
   ``surplus_headroom`` of forecast headroom for ``surplus_hold_s``
   straight (sustained surplus, not a lull between storm waves), retire
@@ -128,6 +132,11 @@ class FleetSupervisor:
         self._last_spawn: Optional[float] = None
         self._last_retire: Optional[float] = None
         self._surplus_since: Optional[float] = None
+        # r22 device-fault edge trigger: member -> last seen failover
+        # count. A member is first OBSERVED (count recorded, no action),
+        # then an INCREASE is one hard-fault edge — one spawn attempt,
+        # not one per pass while the count stays elevated.
+        self._fault_seen: dict = {}
         self._last_decision: dict = {}
         self.events: List[dict] = []   # bounded lifecycle history
         self._m_members = obs_registry.gauge(
@@ -230,8 +239,14 @@ class FleetSupervisor:
         self.events.append(event)
         del self.events[:-64]
 
-    def _try_spawn(self, reason: str, view: dict) -> Optional[str]:
-        """Bound/cooldown-gated spawn; returns the new member name."""
+    def _try_spawn(self, reason: str, view: dict,
+                   ignore_cooldown: bool = False) -> Optional[str]:
+        """Bound/cooldown-gated spawn; returns the new member name.
+        ``ignore_cooldown`` (device_fault only): a chip death is a step
+        LOSS of capacity, not a forecast echo — the symmetric cooldown
+        that damps forecast ping-pong must not delay replacing it. The
+        bound and warming gates still hold (capacity already booting
+        covers the loss; the fleet ceiling is the operator's)."""
         now = self._clock()
         if view["members"] >= self.max_members:
             self._m_blocked.labels("max_members").inc()
@@ -246,10 +261,12 @@ class FleetSupervisor:
         # utilization, and the capacity forecast reads that slope as
         # burn for a fast-window's worth of seconds — spawning on that
         # echo would ping-pong the member set.
-        for stamp in (self._last_spawn, self._last_retire):
-            if stamp is not None and now - stamp < self.spawn_cooldown_s:
-                self._m_blocked.labels("cooldown").inc()
-                return None
+        if not ignore_cooldown:
+            for stamp in (self._last_spawn, self._last_retire):
+                if stamp is not None \
+                        and now - stamp < self.spawn_cooldown_s:
+                    self._m_blocked.labels("cooldown").inc()
+                    return None
         if self._spawner is None:
             # Advisory mode: the decision is recorded (and visible in
             # the snapshot/metrics) but nothing boots.
@@ -350,11 +367,45 @@ class FleetSupervisor:
                     if self._surplus_since is not None else 0.0)
             # Bounds first (an operator shrinking max_members mid-storm
             # still converges), then the forecast, then surplus.
+            # Device-fault edge detection (r22): an increase in a
+            # member's failover count since the last pass means a chip
+            # died and the member now serves degraded on fewer shards.
+            # First observation of a member only records its count —
+            # a supervisor attached to a fleet with failover history
+            # must not spawn for faults it never witnessed.
+            faulted: List[str] = []
+            for r in health:
+                n = r.get("device_fault_failovers")
+                if n is None:
+                    continue
+                inst = r["instance"]
+                prev = self._fault_seen.get(inst)
+                if prev is None:
+                    self._fault_seen[inst] = int(n)
+                elif int(n) > prev:
+                    faulted.append(inst)
             if view["members"] < self.min_members:
                 decision["reason"] = "min_bound"
                 member = self._try_spawn("min_bound", view)
                 decision["action"] = "spawn" if member else "hold"
                 decision["member"] = member
+            elif faulted:
+                # Ranked above every forecast: the capacity loss already
+                # HAPPENED. Hard faults bypass the symmetric cooldown
+                # (ignore_cooldown) — soft forecasts keep respecting it.
+                decision["reason"] = "device_fault"
+                decision["fault_members"] = faulted
+                member = self._try_spawn("device_fault", view,
+                                         ignore_cooldown=True)
+                decision["action"] = "spawn" if member else "hold"
+                decision["member"] = member
+                # Edge consumed after ONE attempt, spawned or blocked:
+                # re-attempting every pass while the count stays
+                # elevated would hammer max_members/warming forever.
+                for r in health:
+                    n = r.get("device_fault_failovers")
+                    if n is not None and r["instance"] in faulted:
+                        self._fault_seen[r["instance"]] = int(n)
             elif (view["fleet_tts_s"] is not None
                     and view["fleet_tts_s"] <= self.spawn_horizon_s):
                 decision["reason"] = "saturation_forecast"

@@ -132,6 +132,36 @@ class CascadeScheduler:
         self._mesh_shards = max(1, int(shards))
         self._mesh_shard_of = shard_of
 
+    def repin_mesh(self, *, mesh, shards: int, shard_of) -> Dict[str, int]:
+        """Survivor-mesh failover (device-fault domain, r22): counted-
+        reset of the sharded cascade state. The dead chip's clip rings
+        are gone and the survivors' pool slots were laid out for the old
+        shard map, so the whole pool evacuates: tracks and their event
+        machines clear WITHOUT firing (mid-fault exit events would be
+        fabrications — the objects did not leave, the chip did), and the
+        pool rebuilds lazily on the next harvest under the new routing.
+        Returns the evacuation counts the engine folds into the failover
+        event ({kind: n} — FaultLedger evidence, not silent loss)."""
+        with self._lock:
+            n_tracks = len(self._tracks)
+            n_streams = len(self._by_stream)
+            n_slots = (self._pool.slots_in_use()
+                       if self._pool is not None
+                       and hasattr(self._pool, "slots_in_use") else 0)
+            for key in list(self._tracks):
+                self._events.pop(key, None)
+            self._tracks.clear()
+            self._by_stream.clear()
+            self._pool = None           # _resolve rebuilds on new mesh
+            self._mesh = mesh
+            self._mesh_shards = max(1, int(shards))
+            self._mesh_shard_of = shard_of
+        return {
+            "cascade_tracks": n_tracks,
+            "cascade_streams": n_streams,
+            "cascade_slots": n_slots,
+        }
+
     def _resolve(self) -> None:
         if self._pool is not None:
             return

@@ -386,6 +386,30 @@ class EngineConfig:
     # XLA persistent cache dir for this member (overrides
     # compile_cache_dir so manifest and payload travel together).
     aot_cache_dir: str = ""
+    # Device-fault domain (engine/fault.py, r22): per-dispatch deadline/
+    # error watchdog over the dp-sharded megastep — a shard whose program
+    # raises (XLA error) or whose drain fetch overruns
+    # fault_dispatch_deadline_ms for fault_hysteresis consecutive batches
+    # is declared faulted, and the engine executes a bounded-time
+    # failover: survivor mesh rebuild, AOT-warm recompile, deterministic
+    # rendezvous stream re-pin, counted-reset state evacuation — all
+    # proven frame-conserving by the FaultLedger (/api/v1/faults).
+    # fault=False (default) is the kill switch: no watchdog, no ledger
+    # taps, /api/v1/faults answers 400, serving bit-identical
+    # (test-pinned).
+    fault: bool = False
+    # Drain fetch (submit -> host numpy) slower than this is one deadline
+    # overrun; fault_hysteresis consecutive overruns open a stall
+    # suspicion (then the per-shard probe attributes it, or not).
+    fault_dispatch_deadline_ms: float = 5000.0
+    fault_hysteresis: int = 2
+    # Wall-clock budget for one failover (mesh rebuild through first
+    # survivor program recorded); overruns are surfaced, not aborted —
+    # half a failover is strictly worse than a slow one.
+    fault_failover_budget_ms: float = 30000.0
+    # Per-shard health probe (stall attribution): a tiny device
+    # round-trip per shard lead device, failed/overrun => faulted.
+    fault_probe_timeout_ms: float = 2000.0
 
 
 @dataclass
