@@ -5,7 +5,7 @@
 	obs-smoke chaos-smoke prof-smoke quality-smoke perf-gate h2d-smoke \
 	roi-smoke fleet-obs-smoke stem-smoke router-smoke cascade-smoke \
 	capacity-smoke autoscale-smoke multichip-serve-smoke hbm-smoke \
-	fault-smoke
+	fault-smoke journal-smoke
 
 all: proto native
 
@@ -266,6 +266,26 @@ fault-smoke:
 		   d['stall_fault']['repin_composes'], \
 		   led['lost'], led['duplicated'], led['lost_outside_window'], \
 		   led['dropped'].get('device_fault', 0)))"
+
+# Decision-journal acceptance (round 23): CPU-twin engine degraded
+# through a REAL SLO burn, gating that /api/v1/why?stream=S resolves
+# the complete slo episode_open -> ladder escalate -> per-stream
+# cascade_stretch chain with quantitative triggers, ladder-transition /
+# journal-event conservation, deterministic fleet merge, record() mean
+# < 50us (0.5% of the 10ms tick), and journal=False bit-identical
+# serving. Gates live in tools/journal_smoke.py and exit non-zero on
+# breach; the committed JOURNAL_r01.json artifact is a pinned run. ~1 min.
+journal-smoke:
+	python tools/journal_smoke.py --out JOURNAL_r01.json | tee /tmp/vep_journal_smoke.json
+	@python -c "import json; \
+		lines=[l for l in open('/tmp/vep_journal_smoke.json') if l.startswith('{')]; \
+		d=json.loads(lines[-1]); c=d['chain']; o=d['overhead']; \
+		print('journal: why(%s) %d-link chain in %.1fs, %d/%d ladder transitions journaled, merge deterministic=%s, record mean %.1fus (< %.0fus), journal-off identical=%s' \
+		% (c['stream'], c['why']['links'], c['stretched_at_s'], \
+		   d['conservation']['ladder_journaled'], \
+		   d['conservation']['ladder_transitions'], \
+		   d['merge']['deterministic'], o['record_mean_us'], \
+		   o['budget_us'], d['kill_switch']['bit_identical']))"
 
 autoscale-smoke:
 	python tools/autoscale_smoke.py | tee /tmp/vep_autoscale_smoke.json

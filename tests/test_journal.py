@@ -1,0 +1,368 @@
+"""Decision-journal tests (obs/journal.py, ISSUE r23).
+
+Ring bounding + chain re-rooting, the slo burn -> ladder escalate ->
+cascade stretch why() chain through the REAL ladder state machine on
+fake time, deterministic fleet merge, REST kill-switch convention, and
+the journal=False bit-identity pin (recording is a pure side effect
+off the serving path — same idiom as the fault=False pin in
+tests/test_fault.py).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from video_edge_ai_proxy_tpu.bus.interface import FrameMeta
+from video_edge_ai_proxy_tpu.bus.memory_bus import MemoryFrameBus
+from video_edge_ai_proxy_tpu.obs.journal import (
+    DecisionJournal,
+    format_event,
+    merge_journals,
+)
+from video_edge_ai_proxy_tpu.resilience.ladder import DegradationLadder
+from video_edge_ai_proxy_tpu.uplink.queue import AnnotationQueue
+from video_edge_ai_proxy_tpu.utils.config import EngineConfig
+
+
+class _Clock:
+    def __init__(self, now=100.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+# ---------------------------------------------------------------------------
+# ring bounding / re-rooting
+
+
+class TestJournalRing:
+    def test_record_returns_monotone_seqs_and_events_filter(self):
+        j = DecisionJournal(16, clock=_Clock())
+        s1 = j.record("slo", "episode_open", subject=("slo", "lat"),
+                      trigger={"fast": 20.0})
+        s2 = j.record("ladder", "escalate", subject=("ladder", "engine"),
+                      trigger={"to": "shed"}, cause=s1)
+        assert (s1, s2) == (1, 2)
+        assert [e["seq"] for e in j.events()] == [1, 2]
+        assert [e["seq"] for e in j.events(actor="ladder")] == [2]
+        assert j.events(subject=("slo", "lat"))[0]["action"] \
+            == "episode_open"
+        assert j.events(subject_kind="ladder")[0]["seq"] == 2
+        assert j.events(since=1) == j.events()[1:]
+        assert j.latest_seq(actor="slo", action="episode_open") == 1
+
+    def test_ring_bounds_and_evicts_oldest(self):
+        # 16 is the capacity floor (max(16, capacity) in the ctor).
+        j = DecisionJournal(16, clock=_Clock())
+        for i in range(40):
+            j.record("engine", "tickmark", subject=("engine", "dispatch"),
+                     trigger={"i": i})
+        snap = j.snapshot()
+        assert snap["capacity"] == 16
+        assert snap["recorded"] == 40
+        assert snap["retained"] == 16
+        assert snap["evicted"] == 24
+        evs = j.events()
+        assert [e["seq"] for e in evs] == list(range(25, 41))
+        assert j.event(1) is None             # evicted
+        assert j.event(40)["trigger"] == {"i": 39}
+
+    def test_why_re_roots_when_cause_falls_off_ring(self):
+        j = DecisionJournal(16, clock=_Clock())
+        prev = None
+        for i in range(40):
+            prev = j.record("engine", "step", subject=("stream", "cam0"),
+                            trigger={"i": i}, cause=prev)
+        out = j.why("stream", "cam0", max_links=32)
+        # The chain walks back until the cause fell off the ring, then
+        # re-roots with the marker — it never dangles or raises.
+        assert out["found"]
+        assert out["evicted_root"]
+        assert 1 <= out["links"] <= 16
+        assert out["text"][0] == "(root evicted from journal ring)"
+        assert out["chain"][-1]["seq"] == prev
+
+    def test_why_unknown_subject_is_empty_not_error(self):
+        j = DecisionJournal(8, clock=_Clock())
+        out = j.why("stream", "nope")
+        assert out == {
+            "subject": {"kind": "stream", "id": "nope"},
+            "found": False, "links": 0, "evicted_root": False,
+            "chain": [], "text": [],
+        }
+
+    def test_format_event_renders_trigger_numbers(self):
+        j = DecisionJournal(8, clock=_Clock())
+        j.record("ladder", "escalate", subject=("ladder", "engine"),
+                 trigger={"to": "shed", "slo_burning": True})
+        line = format_event(j.events()[0])
+        assert "ladder.escalate" in line
+        assert "to=shed" in line and "slo_burning=True" in line
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chain: slo burn -> ladder escalate -> cascade stretch
+
+
+class TestWhyChain:
+    def test_slo_burn_to_cadence_stretch_chain(self):
+        """The real DegradationLadder on fake time roots its fresh
+        escalation at the slo episode_open event; a cascade_stretch
+        recorded with the transition as cause gives why() the full
+        3-link chain the acceptance demands."""
+        clk = _Clock()
+        j = DecisionJournal(64, clock=time.time)
+        slo_seq = j.record(
+            "slo", "episode_open", subject=("slo", "detect_latency_p50"),
+            trigger={"fast": 40.0, "slow": 22.0, "threshold": 1.2})
+        ladder = DegradationLadder(escalate_after_s=0.1, clock=clk,
+                                   journal=j)
+
+        def burn():
+            return ladder.observe(queue_depth=0, tick_lag_s=0.0,
+                                  tick_budget_s=0.01, slo_burning=True)
+
+        assert burn() == "normal"             # pressure timer arms
+        clk.advance(0.2)
+        assert burn() == "shed"               # sustained -> escalate
+        esc = j.events(actor="ladder", action="escalate")[-1]
+        assert esc["cause"] == slo_seq
+        assert esc["trigger"]["slo_burning"] is True
+        assert esc["trigger"]["to"] == "shed"
+        assert ladder.last_transition_seq == esc["seq"]
+
+        j.record("engine", "cascade_stretch", subject=("stream", "cam3"),
+                 trigger={"rung": "shed", "factor": 2, "every_n": 4},
+                 cause=ladder.last_transition_seq)
+        out = j.why("stream", "cam3")
+        assert out["found"] and out["links"] == 3
+        assert not out["evicted_root"]
+        actions = [(e["actor"], e["action"]) for e in out["chain"]]
+        assert actions == [("slo", "episode_open"),
+                           ("ladder", "escalate"),
+                           ("engine", "cascade_stretch")]
+        assert all(e["trigger"] for e in out["chain"])
+
+    def test_deeper_escalation_chains_to_previous_transition(self):
+        clk = _Clock()
+        j = DecisionJournal(64, clock=time.time)
+        ladder = DegradationLadder(escalate_after_s=0.1, clock=clk,
+                                   journal=j)
+        for _ in range(3):
+            ladder.observe(queue_depth=9, tick_lag_s=0.0,
+                           tick_budget_s=0.01)
+            clk.advance(0.2)
+        escs = j.events(actor="ladder", action="escalate")
+        assert len(escs) >= 2
+        # No SLO burn: the first transition roots the chain; each
+        # deeper rung links to the transition before it.
+        assert escs[0]["cause"] is None
+        assert escs[1]["cause"] == escs[0]["seq"]
+
+    def test_recovery_chains_to_the_escalation_it_undoes(self):
+        clk = _Clock()
+        j = DecisionJournal(64, clock=time.time)
+        ladder = DegradationLadder(escalate_after_s=0.1,
+                                   recover_after_s=0.1, clock=clk,
+                                   journal=j)
+        ladder.observe(queue_depth=9, tick_lag_s=0.0, tick_budget_s=0.01)
+        clk.advance(0.2)
+        ladder.observe(queue_depth=9, tick_lag_s=0.0, tick_budget_s=0.01)
+        esc = j.events(actor="ladder", action="escalate")[-1]
+        ladder.observe(queue_depth=0, tick_lag_s=0.0, tick_budget_s=0.01)
+        clk.advance(0.2)
+        ladder.observe(queue_depth=0, tick_lag_s=0.0, tick_budget_s=0.01)
+        rec = j.events(actor="ladder", action="recover")[-1]
+        assert rec["cause"] == esc["seq"]
+        assert rec["trigger"]["to"] == "normal"
+
+
+# ---------------------------------------------------------------------------
+# fleet merge
+
+
+class TestFleetMerge:
+    def _members(self):
+        ev_a = [{"seq": s, "ts": ts, "actor": "ladder",
+                 "action": "escalate", "subject": ["ladder", "engine"],
+                 "trigger": {"to": "shed"}, "cause": None}
+                for s, ts in ((1, 10.0), (2, 11.0), (3, 11.0))]
+        ev_b = [{"seq": s, "ts": ts, "actor": "router",
+                 "action": "migrate", "subject": ["stream", "cam1"],
+                 "trigger": {"reason": "member_shedding"}, "cause": None}
+                for s, ts in ((1, 10.0), (2, 11.0), (3, 12.0))]
+        return ev_a, ev_b
+
+    def test_merge_is_arrival_order_independent(self):
+        ev_a, ev_b = self._members()
+        ab = merge_journals({"a": ev_a, "b": ev_b})
+        ba = merge_journals({"b": list(reversed(ev_b)),
+                             "a": list(reversed(ev_a))})
+        assert ab == ba
+        assert len(ab) == 6
+
+    def test_merge_orders_by_ts_then_member_then_seq(self):
+        ev_a, ev_b = self._members()
+        merged = merge_journals({"b": ev_b, "a": ev_a})
+        key = [(e["ts"], e["member"], e["seq"]) for e in merged]
+        assert key == sorted(key)
+        # Wall-time ties (11.0) collapse to member then seq order.
+        assert [(e["member"], e["seq"]) for e in merged
+                if e["ts"] == 11.0] == [("a", 2), ("a", 3), ("b", 2)]
+
+    def test_merge_tags_members_without_mutating_inputs(self):
+        ev_a, ev_b = self._members()
+        merge_journals({"a": ev_a, "b": ev_b})
+        assert all("member" not in e for e in ev_a + ev_b)
+
+
+# ---------------------------------------------------------------------------
+# REST kill-switch convention
+
+
+class _PM:
+    def list(self):
+        return []
+
+
+class TestJournalEndpointConvention:
+    def test_disabled_journal_answers_400_envelope(self):
+        import urllib.error
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        bus = MemoryFrameBus()
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5,
+            journal=False))
+        assert eng.journal is None
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            for path in ("/api/v1/journal", "/api/v1/why?stream=cam0"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(base + path)
+                assert ei.value.code == 400
+                body = json.loads(ei.value.read())
+                assert set(body) == {"code", "message"}
+                assert "engine.journal" in body["message"]
+        finally:
+            srv.stop()
+            bus.close()
+
+    def test_enabled_journal_serves_events_and_why(self):
+        import urllib.request
+
+        from video_edge_ai_proxy_tpu.engine import InferenceEngine
+        from video_edge_ai_proxy_tpu.serve.rest_api import RestServer
+
+        bus = MemoryFrameBus()
+        eng = InferenceEngine(bus, EngineConfig(
+            model="tiny_mobilenet_v2", batch_buckets=(1, 2), tick_ms=5))
+        assert eng.journal is not None        # default ON
+        s1 = eng.journal.record("slo", "episode_open",
+                                subject=("slo", "lat"),
+                                trigger={"fast": 2.0})
+        eng.journal.record("ladder", "escalate",
+                           subject=("ladder", "engine"),
+                           trigger={"to": "shed"}, cause=s1)
+        srv = RestServer(_PM(), None, host="127.0.0.1", port=0, engine=eng)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            with urllib.request.urlopen(
+                    base + "/api/v1/journal?actor=ladder") as r:
+                body = json.loads(r.read())
+            assert [e["action"] for e in body["events"]] == ["escalate"]
+            assert body["next_seq"] == 3
+            with urllib.request.urlopen(
+                    base + "/api/v1/why?subject=ladder:engine") as r:
+                why = json.loads(r.read())
+            assert why["found"] and why["links"] == 2
+            assert why["chain"][0]["actor"] == "slo"
+            with urllib.request.urlopen(base + "/api/v1/stats") as r:
+                stats = json.loads(r.read())
+            assert stats["obs"]["journal"]["recorded"] == 2
+        finally:
+            srv.stop()
+            bus.close()
+
+
+# ---------------------------------------------------------------------------
+# journal=False kill-switch pin
+
+
+def _blob_frame(delta=0, key=1):
+    frame = np.full((64, 64, 3), 114, np.uint8)
+    frame[20:40, 20:40] = (64 + delta, 255, key * 32 + 16)
+    return frame
+
+
+def _meta():
+    return FrameMeta(width=64, height=64, channels=3,
+                     timestamp_ms=int(time.time() * 1000),
+                     is_keyframe=True)
+
+
+class TestJournalChecksumPin:
+    def test_journal_off_bit_identical(self):
+        """Recording is a pure side effect off the serving path: the
+        device outputs an engine emits must fold the SAME checksum with
+        the default journal=True as with journal=False (the fault-off
+        pin idiom, applied to the journal plane)."""
+        from video_edge_ai_proxy_tpu.engine.runner import InferenceEngine
+        from video_edge_ai_proxy_tpu.replay.checksum import (
+            CHECKSUM_MASK,
+            device_checksum,
+            finalize_checksum,
+        )
+
+        def run(journal):
+            b = MemoryFrameBus()
+            try:
+                b.create_stream("cam1", 64 * 64 * 3)
+                eng = InferenceEngine(
+                    b, EngineConfig(model="tiny_blob_gauge",
+                                    batch_buckets=(1, 2, 4), tick_ms=5,
+                                    prefetch=False, journal=journal),
+                    annotations=AnnotationQueue(handler=lambda batch: True))
+                eng.warmup()
+                assert (eng.journal is not None) is journal
+                if not journal:
+                    # No hooks left anywhere downstream of the switch.
+                    assert eng.ladder is None or eng.ladder.journal is None
+                    assert eng.slo is None or eng.slo.journal is None
+                eng._drain_q = queue.Queue(maxsize=8)
+                carry = 0
+                for f, key in enumerate((1, 3, 5, 7)):
+                    b.publish("cam1",
+                              _blob_frame(15 if f % 2 == 0 else -15, key),
+                              _meta())
+                    groups = eng._collector.collect()
+                    eng._dispatch(groups, time.perf_counter())
+                    inflight = eng._drain_q.get(timeout=10)
+                    part = int(np.asarray(
+                        device_checksum(inflight.outputs)))
+                    carry = (carry + part) & CHECKSUM_MASK
+                    eng._emit(inflight)
+                    eng._collector.release(inflight.group)
+                    eng._drain_q.task_done()
+                return finalize_checksum(carry)
+            finally:
+                b.close()
+
+        on, off = run(journal=True), run(journal=False)
+        assert on == off
+        assert on != 0

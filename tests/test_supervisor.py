@@ -64,7 +64,7 @@ class FakeRouter:
         }
         self.added.append((name, url))
 
-    def remove_member(self, name):
+    def remove_member(self, name, cause=None):
         if self.fail_remove:
             raise RuntimeError("drain failed")
         moved = list(self.streams.pop(name, []))

@@ -411,6 +411,44 @@ def fault_info(baseline_dir: str):
     return None
 
 
+def journal_info(baseline_dir: str):
+    """Newest committed JOURNAL_r*.json's decision-journal row, or None.
+
+    Round 23 informational carry-through: perf-gate logs show the
+    journal smoke's why()-chain depth, record() overhead, and the
+    kill-switch bit-identity verdict next to the fps verdict. NEVER
+    gated here — journal_smoke.py hard-gates its own run (chain
+    completeness, conservation, merge determinism, overhead budget,
+    journal-off bit-identity); this is trend visibility only.
+    """
+    paths = sorted(glob.glob(os.path.join(baseline_dir, "JOURNAL_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(art, dict) or "chain" not in art:
+            continue
+        chain = art.get("chain") or {}
+        why = chain.get("why") or {}
+        overhead = art.get("overhead") or {}
+        conservation = art.get("conservation") or {}
+        kill = art.get("kill_switch") or {}
+        return {
+            "artifact": os.path.basename(path),
+            "why_links": why.get("links"),
+            "stretched_at_s": chain.get("stretched_at_s"),
+            "ladder_transitions": conservation.get("ladder_transitions"),
+            "ladder_journaled": conservation.get("ladder_journaled"),
+            "record_mean_us": overhead.get("record_mean_us"),
+            "merge_deterministic": (art.get("merge") or {}).get(
+                "deterministic"),
+            "off_bit_identical": kill.get("bit_identical"),
+        }
+    return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("input", nargs="?", default="-",
@@ -458,6 +496,9 @@ def main(argv=None) -> int:
     fault = fault_info(args.baseline_dir)
     if fault is not None:
         report["fault"] = fault              # informational, never gated
+    journal = journal_info(args.baseline_dir)
+    if journal is not None:
+        report["journal"] = journal          # informational, never gated
     print(json.dumps(report, indent=2))
     return 0 if report["passed"] else 1
 

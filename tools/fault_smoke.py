@@ -296,6 +296,24 @@ def main(argv=None) -> int:
     text = metrics_registry.render()
     problems = [p for p in lint_exposition(text) if "vep_fault" in p]
 
+    # r23 decision journal: the final event log rides in the artifact,
+    # plus the conservation check — every failover the fault plane
+    # executed must have a journal event with a non-null quantitative
+    # trigger (an unexplained autonomous action is a gate failure).
+    journal_events = (eng.journal.events()
+                      if eng.journal is not None else [])
+    journaled_failovers = [
+        ev for ev in journal_events
+        if ev["actor"] == "fault" and ev["action"] == "failover"]
+    journal_conservation = {
+        "failovers": len(fails),
+        "journaled": len(journaled_failovers),
+        "with_trigger": sum(1 for ev in journaled_failovers
+                            if ev.get("trigger")),
+        "with_cause": sum(1 for ev in journaled_failovers
+                          if ev.get("cause") is not None),
+    }
+
     out = {
         "tool": "fault_smoke",
         "backend": backend,
@@ -323,6 +341,8 @@ def main(argv=None) -> int:
             "informational": True,
         },
         "ledger": ledger,
+        "journal": {"events": journal_events},
+        "journal_conservation": journal_conservation,
         "results": len(results),
         "failovers": snap["failovers"],
         "survivor_shards": snap["shards"],
@@ -385,6 +405,14 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"fault_smoke: vep_fault_* exposition not lint-clean: "
             f"{problems}")
+    if eng.journal is not None and (
+            journal_conservation["journaled"] < len(fails)
+            or journal_conservation["with_trigger"]
+            < journal_conservation["journaled"]):
+        raise SystemExit(
+            f"fault_smoke: journal conservation broken — every failover "
+            f"needs a journal event with a non-null trigger: "
+            f"{journal_conservation}")
     return 0
 
 

@@ -43,6 +43,17 @@ unhealthy list consistent with the per-stream verdicts::
 
   curl -s :8080/api/v1/quality | python tools/obs_export.py - --check
 
+``--journal`` (r23) validates a decision-journal payload instead — an
+``/api/v1/journal`` response, a fleet-merged ``/api/v1/fleet/journal``
+response, a stats/soak artifact embedding a ``journal`` section, or a
+bare event list. Checked: per-member strictly-monotone seqs, well-formed
+actor/action/subject/ts, cause links that resolve to a present event or
+point below the retained window (evicted — never dangling INSIDE the
+window), and a non-null quantitative trigger on every autonomous action
+(the conservation half of the journal-smoke gate)::
+
+  curl -s :8080/api/v1/journal | python tools/obs_export.py - --journal
+
 Clock alignment: jax.profiler timestamps are microseconds relative to
 trace start, span timestamps are wall-clock epoch. The merge estimates
 the offset from the earliest host-side *device-stage* span inside the
@@ -160,6 +171,121 @@ def validate_quality(q) -> list:
                 f"unhealthy: {sorted(unhealthy)} inconsistent with "
                 f"per-stream verdicts {expect}")
     return problems
+
+
+#: Actions that ARE autonomous control-plane decisions (vs observation
+#: events): the journal conservation contract says each carries a
+#: non-null quantitative trigger — "what number made the system act".
+JOURNAL_ACTION_EVENTS = frozenset({
+    "ladder.escalate", "ladder.recover",
+    "fault.failover", "fault.failover_skipped",
+    "engine.shed_open", "engine.shed_close",
+    "engine.cascade_stretch", "engine.cascade_unstretch",
+    "engine.roi_mode",
+    "router.place", "router.admit", "router.admission_rejected",
+    "router.migrate", "router.migrate_failed",
+    "supervisor.spawn", "supervisor.spawn_advised",
+    "supervisor.retire", "supervisor.retire_failed",
+})
+
+
+def find_journal(obj):
+    """Locate a decision-journal event list in any payload shape that
+    carries one (module docstring), or None."""
+    if isinstance(obj, list):
+        return {"events": obj}
+    if not isinstance(obj, dict):
+        return None
+    if isinstance(obj.get("soak"), dict):
+        obj = obj["soak"]
+    j = obj.get("journal", obj)
+    if isinstance(j, dict):
+        if isinstance(j.get("events"), list):
+            return j
+        if isinstance(j.get("tail"), list):
+            out = dict(j)
+            out["events"] = out.pop("tail")
+            return out
+    return None
+
+
+def validate_journal(j) -> list:
+    """Schema/causality problems in a journal payload (empty = valid)."""
+    problems = []
+    events = j.get("events")
+    if not isinstance(events, list):
+        return ["events: missing or not a list"]
+    last_seq: dict = {}     # member -> last seq seen (monotonicity)
+    seen: dict = {}         # member -> set of present seqs (cause refs)
+    floor: dict = {}        # member -> lowest seq present (evicted line)
+    for i, ev in enumerate(events):
+        where = f"events[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        member = ev.get("member")   # fleet-merged events carry this
+        seq = ev.get("seq")
+        if not isinstance(seq, int) or seq < 1:
+            problems.append(f"{where}.seq: {seq!r} not a positive int")
+            continue
+        prev = last_seq.get(member)
+        if prev is not None and seq <= prev:
+            problems.append(
+                f"{where}.seq: {seq} not monotone after {prev}"
+                + (f" (member {member})" if member else ""))
+        last_seq[member] = seq
+        seen.setdefault(member, set()).add(seq)
+        floor[member] = min(floor.get(member, seq), seq)
+        for field in ("actor", "action"):
+            if not (isinstance(ev.get(field), str) and ev[field]):
+                problems.append(
+                    f"{where}.{field}: {ev.get(field)!r} not a "
+                    "non-empty string")
+        if not isinstance(ev.get("ts"), (int, float)):
+            problems.append(f"{where}.ts: {ev.get('ts')!r} not numeric")
+        subject = ev.get("subject")
+        if subject is not None and not (
+                isinstance(subject, (list, tuple)) and len(subject) == 2
+                and all(isinstance(s, str) for s in subject)):
+            problems.append(
+                f"{where}.subject: {subject!r} not [kind, id]")
+        trigger = ev.get("trigger")
+        if trigger is not None and not isinstance(trigger, dict):
+            problems.append(f"{where}.trigger: {trigger!r} not an object")
+        key = f"{ev.get('actor')}.{ev.get('action')}"
+        if key in JOURNAL_ACTION_EVENTS and not trigger:
+            problems.append(
+                f"{where}: autonomous action {key} has no quantitative "
+                "trigger")
+        cause = ev.get("cause")
+        if cause is not None:
+            if not isinstance(cause, int) or cause < 1:
+                problems.append(
+                    f"{where}.cause: {cause!r} not a positive int")
+            elif cause >= seq:
+                problems.append(
+                    f"{where}.cause: {cause} not before seq {seq}")
+            elif (cause not in seen.get(member, ())
+                    and cause >= floor.get(member, seq)):
+                problems.append(
+                    f"{where}.cause: {cause} dangles inside the retained "
+                    "window" + (f" (member {member})" if member else ""))
+    return problems
+
+
+def _journal_summary(j) -> dict:
+    events = j.get("events") or []
+    by_actor: dict = {}
+    chained = 0
+    for ev in events:
+        if isinstance(ev, dict):
+            by_actor[ev.get("actor")] = by_actor.get(ev.get("actor"), 0) + 1
+            if ev.get("cause") is not None:
+                chained += 1
+    return {"check": "ok", "kind": "journal", "events": len(events),
+            "chained": chained,
+            "by_actor": {k: v for k, v in sorted(by_actor.items())
+                         if k is not None}}
 
 
 def _load_json_maybe_gz(path: str):
@@ -300,6 +426,12 @@ def main(argv=None) -> None:
                     help="jax perfetto/Chrome trace (.json or .json.gz) "
                          "to merge when the input is a spans file, not a "
                          "bundle dir")
+    ap.add_argument("--journal", action="store_true",
+                    help="input is a decision-journal payload "
+                         "(/api/v1/journal, /api/v1/fleet/journal, a "
+                         "stats/soak artifact, or a bare event list): "
+                         "schema+causality validate and print a summary; "
+                         "exit 1 on problems")
     ap.add_argument("--member", action="append", default=[],
                     metavar="NAME=SPANS.json",
                     help="r14 multi-engine merge: repeatable member spec; "
@@ -307,6 +439,25 @@ def main(argv=None) -> None:
                          "namespace on one timeline (requires --merge; "
                          "--device-trace still fuses device tracks)")
     args = ap.parse_args(argv)
+
+    if args.journal:
+        obj = (json.load(sys.stdin) if args.input == "-"
+               else _load_json_maybe_gz(args.input))
+        j = find_journal(obj)
+        if j is None:
+            raise SystemExit(
+                "--journal: input carries no decision-journal events "
+                "(expected /api/v1/journal shape, a 'journal' section, "
+                "or a bare event list)")
+        problems = validate_journal(j)
+        if problems:
+            for p in problems:
+                print(f"PROBLEM: {p}", file=sys.stderr)
+            raise SystemExit(
+                f"journal check FAILED: {len(problems)} problem(s) in "
+                f"{len(j.get('events') or [])} events")
+        print(json.dumps(_journal_summary(j)))
+        return
 
     if args.member:
         if not args.merge:

@@ -180,6 +180,12 @@ def main() -> None:
             break
         time.sleep(0.5)
     engine_stats = srv.engine.stats() if srv.engine else {}
+    # r23: the final decision-journal state rides in the artifact — what
+    # the control planes decided during the soak and why, with causal
+    # links (validate with tools/obs_export.py --journal).
+    journal = (srv.engine.journal.snapshot(tail=64)
+               if srv.engine is not None
+               and srv.engine.journal is not None else None)
     srv.stop()
     # Soak runs repeat; each must reclaim its tmpfs rings and registry dir.
     import shutil
@@ -208,6 +214,7 @@ def main() -> None:
         "chaos_kills": kills,
         "running_after": running,
         "healthz": health,
+        "journal": journal,
     }))
 
 

@@ -150,7 +150,7 @@ class FaultPlane:
                  hysteresis: int = 2,
                  failover_budget_ms: float = 30000.0,
                  probe_timeout_ms: float = 2000.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, journal=None):
         self.deadline_ms = float(deadline_ms)
         self.hysteresis = max(1, int(hysteresis))
         self.failover_budget_ms = float(failover_budget_ms)
@@ -158,6 +158,11 @@ class FaultPlane:
         self.shards = max(1, int(shards))
         self.ledger = FaultLedger(clock=clock)
         self._clock = clock
+        # r23 decision journal: detection and failover are audit events;
+        # last_detected_seq is the cause handle the engine links its
+        # failover (and the supervisor its device_fault spawn) to.
+        self.journal = journal
+        self.last_detected_seq: Optional[int] = None
         self._lock = threading.Lock()
         self._overruns = 0              # consecutive drain overruns
         self._suspect_since: Optional[float] = None
@@ -290,6 +295,10 @@ class FaultPlane:
                 "event": "detected", "kind": kind, "shard": shard,
                 "tick": tick, "ts": time.time(),
             })
+        if self.journal is not None:
+            self.last_detected_seq = self.journal.record(
+                "fault", "detected", subject=("shard", str(shard)),
+                trigger={"kind": kind, "tick": int(tick)})
 
     # -- failover handoff (tick thread) --
 
@@ -303,10 +312,19 @@ class FaultPlane:
         excused by a failover that never ran."""
         with self._lock:
             had = bool(self._pending)
+            pending = dict(self._pending)
             self._pending.clear()
         if had:
             self._m_failovers.labels(outcome).inc()
             self.ledger.close_window()
+            if self.journal is not None:
+                self.journal.record(
+                    "fault", "failover_skipped",
+                    subject=("shard", ",".join(
+                        str(s) for s in sorted(pending))),
+                    trigger={"outcome": outcome,
+                             "pending": len(pending)},
+                    cause=self.last_detected_seq)
 
     def note_failover(self, event: dict) -> None:
         """Record a completed failover: closes the fault window, updates
@@ -325,6 +343,19 @@ class FaultPlane:
             if n:
                 self._m_evacuated.labels(str(kind)).inc(int(n))
         self.ledger.close_window()
+        if self.journal is not None:
+            dead = event.get("shards_dead") or []
+            streams = event.get("streams") or {}
+            self.journal.record(
+                "fault", "failover",
+                subject=("shard", ",".join(str(s) for s in dead)),
+                trigger={"kinds": ",".join(
+                    str(k) for k in (event.get("kinds") or [])) or "unknown",
+                    "survivors": int(event.get("survivors", 0)),
+                    "failover_ms": round(
+                        float(event.get("failover_ms", 0.0)), 1),
+                    "repinned": int(streams.get("repinned", 0))},
+                cause=self.last_detected_seq)
 
     def note_dropped(self, n: int, reason: str) -> None:
         """Ledger + metric tap for reasoned frame drops (the lineage

@@ -949,9 +949,11 @@ class InferenceEngine:
         model_resolver=None,                 # device_id -> model name or ""
         annotation_policy_resolver=None,     # device_id -> policy or ""
         archiver=None,                       # .submit(GopSegment) duck type
+        journal=None,                        # shared DecisionJournal or None
     ):
         self._bus = bus
         self._cfg = cfg or EngineConfig()
+        self._journal_arg = journal
         self._annotations = annotations
         # Cascade event archive sink (ingest/archive.py SegmentArchiver
         # duck type): "enter" events submit the track's recent tile
@@ -1046,7 +1048,22 @@ class InferenceEngine:
         # bind their singleton child eagerly — the sample then renders (as
         # 0) from the first scrape, not from the first event. The registry
         # is process-global — /metrics renders these directly.
-        self.watchdog = Watchdog()
+        # Control-plane decision journal (obs/journal.py, r23): built
+        # FIRST so every plane below can record causally-linked audit
+        # events. cfg.journal=False leaves it None — no hooks anywhere,
+        # /api/v1/journal answers 400, replay bit-identical (test-pinned
+        # kill switch, fault convention). A journal passed to the ctor
+        # (the head process sharing one journal with router/supervisor)
+        # wins over building a fresh one.
+        self.journal = None
+        if self._cfg.journal:
+            if self._journal_arg is not None:
+                self.journal = self._journal_arg
+            else:
+                from ..obs.journal import DecisionJournal
+
+                self.journal = DecisionJournal(self._cfg.journal_capacity)
+        self.watchdog = Watchdog(journal=self.journal)
         self._m_ticks = obs_registry.counter(
             "vep_engine_ticks_total", "Engine ticks completed").labels()
         self._m_batches = obs_registry.counter(
@@ -1093,8 +1110,15 @@ class InferenceEngine:
                 escalate_after_s=self._cfg.ladder_escalate_after_s,
                 recover_after_s=self._cfg.ladder_recover_after_s,
                 watchdog=self.watchdog,
+                journal=self.journal,
             )
         self.shed_frames = 0
+        # r23 journal edge state: open shed-excursion event seq + its
+        # accumulated frame count, and the last journaled ROI mode per
+        # stream (transitions are journaled on the edge, not per tick).
+        self._shed_seq: Optional[int] = None
+        self._shed_excursion_frames = 0
+        self._roi_mode: Dict[str, str] = {}
         self._m_shed = obs_registry.counter(
             "vep_ladder_shed_frames_total",
             "Frames shed by the degradation ladder (stale at dispatch)",
@@ -1132,6 +1156,7 @@ class InferenceEngine:
                     warmup_s=self._cfg.slo_warmup_s,
                 ),
                 watchdog=self.watchdog,
+                journal=self.journal,
             )
             self._slo_latency = self.slo.get("detect_latency_p50")
             self._slo_fps = self.slo.get("aggregate_fps")
@@ -1152,6 +1177,7 @@ class InferenceEngine:
                     self._cfg.prof_trigger_min_interval_s),
                 max_ms=self._cfg.prof_max_ms,
                 tracer=tracer,
+                journal=self.journal,
                 snapshot_fn=self._prof_snapshot,
             )
         # Output-quality observability (obs/quality.py): host verdict
@@ -1309,6 +1335,7 @@ class InferenceEngine:
                 hysteresis=self._cfg.fault_hysteresis,
                 failover_budget_ms=self._cfg.fault_failover_budget_ms,
                 probe_timeout_ms=self._cfg.fault_probe_timeout_ms,
+                journal=self.journal,
             )
 
     @property
@@ -2440,6 +2467,16 @@ class InferenceEngine:
                                       and self.hbm.pressure()),
                     )
                     self._apply_rung_cap(rung)
+                if self._cascade is not None:
+                    # Cadence stretch under pressure (r23): shed
+                    # temporal-head FLOPs while the ladder is degraded.
+                    # ``inferred`` still holds last tick's stream list —
+                    # exactly the streams whose cadence is changing.
+                    self._apply_cascade_stretch(rung, inferred)
+                if rung == "normal" and self._shed_seq is not None:
+                    # Shed excursion closes when the ladder recovers
+                    # (edge-triggered journaling, never per-tick).
+                    self._close_shed_excursion()
                 # One bus enumeration per tick, threaded everywhere.
                 present, inferred = self._collector.partition()
                 if rung == "admission_pause":
@@ -2529,6 +2566,7 @@ class InferenceEngine:
                                 # stream (first frame re-gates to full).
                                 if self._roi is not None:
                                     self._roi.pop(d, None)
+                                    self._roi_mode.pop(d, None)
                                 # Cascade track state goes with the
                                 # stream: device slots free, event
                                 # machines clear without firing.
@@ -2992,6 +3030,7 @@ class InferenceEngine:
         fully-stale groups return their pooled-buffer lease here."""
         now_ms = time.time() * 1000.0
         out: List[BatchGroup] = []
+        tick_shed = 0
         for group in groups:
             kept, shed = shed_stale(
                 group, now_ms, self._cfg.shed_staleness_ms, self._buckets,
@@ -2999,12 +3038,72 @@ class InferenceEngine:
             )
             if shed:
                 self.shed_frames += shed
+                tick_shed += shed
                 self._m_shed.inc(shed)
             if kept is None:
                 self._collector.release(group)
             else:
                 out.append(kept)
+        # r23 journal: one shed excursion event per degraded episode
+        # (opened on the first frame actually dropped, closed when the
+        # ladder recovers in _run), caused by the ladder transition that
+        # engaged shedding — never one event per tick.
+        if tick_shed and self.journal is not None:
+            if self._shed_seq is None:
+                self._shed_seq = self.journal.record(
+                    "engine", "shed_open",
+                    subject=("engine", "dispatch"),
+                    trigger={"frames": tick_shed,
+                             "staleness_ms": self._cfg.shed_staleness_ms},
+                    cause=(self.ladder.last_transition_seq
+                           if self.ladder is not None else None))
+                self._shed_excursion_frames = 0
+            self._shed_excursion_frames += tick_shed
         return out
+
+    def _close_shed_excursion(self) -> None:
+        """Close the open shed excursion (ladder back at normal)."""
+        if self.journal is not None and self._shed_seq is not None:
+            self.journal.record(
+                "engine", "shed_close", subject=("engine", "dispatch"),
+                trigger={"frames": self._shed_excursion_frames},
+                cause=self._shed_seq)
+        self._shed_seq = None
+        self._shed_excursion_frames = 0
+
+    def _apply_cascade_stretch(self, rung: str, streams) -> None:
+        """Cascade cadence stretch (r23): while the degradation ladder
+        sits at shed or deeper, the temporal head dispatches every
+        ``every_n * cascade_stretch_factor`` ticks instead of every
+        ``every_n`` — head FLOPs shed before streams do. Journaled on
+        the EDGE only (engage/release), with a per-stream event so
+        ``/api/v1/why?stream=S`` resolves the stream's cadence back
+        through the ladder transition to the SLO burn that drove it."""
+        factor = (self._cfg.cascade_stretch_factor
+                  if rung != "normal" else 1)
+        if not self._cascade.set_stretch(factor):
+            return
+        action = ("cascade_stretch" if factor > 1
+                  else "cascade_unstretch")
+        seq = None
+        if self.journal is not None:
+            cause = (self.ladder.last_transition_seq
+                     if self.ladder is not None else None)
+            trigger = {"rung": rung, "factor": factor,
+                       "every_n": self._cascade.every_n}
+            seq = self.journal.record(
+                "engine", action, subject=("cascade", "head"),
+                trigger=trigger, cause=cause)
+            for sid in sorted(set(streams or [])):
+                self.journal.record(
+                    "engine", action, subject=("stream", str(sid)),
+                    trigger=dict(trigger), cause=cause)
+        log.info("cascade cadence %s: every_n %d x%d (rung %s)",
+                 "stretched" if factor > 1 else "restored",
+                 self._cascade.every_n, factor, rung,
+                 extra={"vep_actor": "engine",
+                        "vep_subject": "cascade:head",
+                        "vep_journal_seq": seq})
 
     # -- MOSAIC ROI serving (cfg.roi; ROADMAP item 1) --
 
@@ -3040,6 +3139,7 @@ class InferenceEngine:
             coast: List[tuple] = []
             reqs: List[tuple] = []    # CanvasPacker requests
             req_row: List[int] = []   # request index -> group row
+            roi_edges: List[tuple] = []   # r23 journal: mode transitions
             with self._state_lock:
                 for i, device_id in enumerate(group.device_ids):
                     t_entry = self._trackers.get(device_id)
@@ -3049,6 +3149,12 @@ class InferenceEngine:
                         else None
                     )
                     verdict = self._roi.classify(device_id, tracker, now)
+                    if (self.journal is not None
+                            and self._roi_mode.get(device_id) != verdict):
+                        roi_edges.append(
+                            (device_id,
+                             self._roi_mode.get(device_id), verdict))
+                        self._roi_mode[device_id] = verdict
                     if verdict == "idle":
                         coast.append((
                             device_id, group.metas[i],
@@ -3070,6 +3176,14 @@ class InferenceEngine:
                             req_row.append(i)
                     else:
                         full_rows.append(i)
+            # Journal ROI gate transitions outside the state lock —
+            # edge-triggered (a stream flipping full/roi/idle), so gate
+            # steady state records nothing.
+            for device_id, prev, verdict in roi_edges:
+                self.journal.record(
+                    "engine", "roi_mode",
+                    subject=("stream", str(device_id)),
+                    trigger={"mode": verdict, "prev": prev or "none"})
             if not coast and not reqs:
                 # Everything full: the group passes through untouched.
                 # Still count the verdicts — synchronized refresh ticks
@@ -4022,9 +4136,22 @@ class InferenceEngine:
         ts = (meta.timestamp_ms
               if meta is not None and getattr(meta, "timestamp_ms", 0)
               else now_ms)
+        seq = None
+        if self.journal is not None:
+            # Hysteresis already edge-triggers enter/exit — each is a
+            # decision event with the score that crossed the threshold.
+            seq = self.journal.record(
+                "engine", f"cascade_{kind}",
+                subject=("stream", str(ev["stream"])),
+                trigger={"track": str(ev["track_id"]),
+                         "score": round(float(ev["score"]), 4),
+                         "tick": int(ev["tick"])})
         log.info(
             "cascade %s stream=%s track=%s score=%.3f tick=%d",
             kind, ev["stream"], ev["track_id"], ev["score"], ev["tick"],
+            extra={"vep_actor": "engine",
+                   "vep_subject": f"stream:{ev['stream']}",
+                   "vep_journal_seq": seq},
         )
         if self._annotations is not None:
             try:

@@ -40,6 +40,12 @@ code alike. Modules:
   forecast, and SRE-style fast/slow capacity burn rates
   (``vep_capacity_*``, ``/api/v1/capacity``) — the signal
   ``StreamRouter.admit`` consumes for headroom-aware placement.
+- :mod:`journal` — the decision audit trail (ISSUE r23 tentpole): a
+  process-wide bounded ring of causally-linked control-plane decision
+  events (actor/action/subject/quantitative trigger/cause link) with
+  ``why()`` backward chain walks, fleet merge via monotone per-member
+  seqs, and ``vep_journal_*`` counters (``/api/v1/journal`` +
+  ``/api/v1/why``).
 - :mod:`hbm` — the memory mirror of :mod:`capacity` (ISSUE r21
   tentpole): static per-program footprints from ``memory_analysis()``
   at AOT-compile time, dynamic per-pool byte accounting via registered
@@ -57,6 +63,7 @@ from .prof import Profiler
 from .quality import CanaryChecker, QualityTracker
 from .slo import BurnRateSLO, SLOEngine, SLOSpec, default_slos, integrity_slo
 from .fleet import FleetAggregator
+from .journal import DecisionJournal, format_event, merge_journals
 from .spans import (
     SpanRecorder, stage_breakdown, to_chrome_trace, trace_id_for, tracer,
 )
@@ -79,6 +86,9 @@ __all__ = [
     "default_slos",
     "integrity_slo",
     "FleetAggregator",
+    "DecisionJournal",
+    "format_event",
+    "merge_journals",
     "SpanRecorder",
     "stage_breakdown",
     "to_chrome_trace",

@@ -6,10 +6,11 @@ into one pane). Every other obs module is process-local; this one makes N
 engine processes read as one system:
 
 - ``FleetAggregator`` scrapes each member's ``/metrics`` +
-  ``/api/v1/stats`` + ``/api/v1/slo`` + ``/api/v1/capacity`` over plain
-  HTTP (stdlib urllib — jax-free, dependency-free, importable from
-  control-plane code). A member without a given plane (400/older
-  version) degrades to an empty dict — mixed-version fleets merge.
+  ``/api/v1/stats`` + ``/api/v1/slo`` + ``/api/v1/capacity`` +
+  ``/api/v1/journal`` over plain HTTP (stdlib urllib — jax-free,
+  dependency-free, importable from control-plane code). A member
+  without a given plane (400/older version) degrades to an empty
+  dict — mixed-version fleets merge.
 - **Merge rules** (ISSUE r14): counters are SUMMED across members,
   log2 histograms are bucket-merged (identical ``le`` grids by
   construction — metrics.py owns the bounds), gauges are last-write per
@@ -180,6 +181,7 @@ class MemberState:
         self.slo: dict = {}
         self.capacity: dict = {}
         self.hbm: dict = {}
+        self.journal: list = []
         # r16 flap-free health (updated once per scrape pass, never at
         # read time): EMA of the instantaneous score + a hysteresis-banded
         # healthy verdict with entry timestamps.
@@ -406,12 +408,21 @@ class FleetAggregator:
                     # — merge the rest; health rows carry None and the
                     # fleet gauges render -1 sentinels.
                     hbm = {}
+                try:
+                    journal = json.loads(
+                        self._fetch(m.base_url + "/api/v1/journal")
+                    ).get("events") or []
+                except Exception:
+                    # Journal disabled (400) or a pre-r23 member (404)
+                    # — the merged journal just misses this member.
+                    journal = []
                 with self._lock:
                     m.families = parse_exposition(text)
                     m.stats = stats
                     m.slo = slo
                     m.capacity = capacity
                     m.hbm = hbm
+                    m.journal = journal
                     m.alive = True
                     m.last_ok = time.monotonic()
                     m.last_err = ""
@@ -588,6 +599,22 @@ class FleetAggregator:
                             row["count"] += int(value)
         return counters, gauges, hists
 
+    def merged_journal(self) -> dict:
+        """The ``/api/v1/fleet/journal`` body (r23): every member's
+        decision-journal events tagged ``member=<name>``, merged in
+        ``(ts, member, seq)`` order — the per-member seqs are monotone,
+        so the merge is deterministic across scrape arrival orders (the
+        r14 stitching idiom, journal edition)."""
+        from .journal import merge_journals
+
+        with self._lock:
+            per_member = {m.name: list(m.journal) for m in self._members}
+        events = merge_journals(per_member)
+        return {
+            "members": sorted(per_member),
+            "events": events,
+        }
+
     def fleet_stats(self) -> dict:
         """The ``/api/v1/fleet/stats`` body: ranked health + merged
         counters/gauges/histograms + scrape-plane accounting."""
@@ -601,6 +628,7 @@ class FleetAggregator:
             "counters": counters,
             "gauges": gauges,
             "histograms": hists,
+            "journal": {m.name: len(m.journal) for m in self._members},
         }
 
     def _fleet_families(self) -> List[str]:
@@ -768,6 +796,9 @@ def main(argv=None) -> None:
                 ctype = "text/plain; version=0.0.4"
             elif self.path.split("?")[0] == "/api/v1/fleet/stats":
                 body = json.dumps(agg.fleet_stats()).encode()
+                ctype = "application/json"
+            elif self.path.split("?")[0] == "/api/v1/fleet/journal":
+                body = json.dumps(agg.merged_journal()).encode()
                 ctype = "application/json"
             else:
                 self.send_error(404)

@@ -64,6 +64,7 @@ __all__ = ["Profiler", "find_device_trace"]
 MANIFEST = "manifest.json"
 SPANS = "spans.json"
 SNAPSHOT = "snapshot.json"
+JOURNAL = "journal.json"
 DEVICE_DIR = "device"
 
 # Span-window slack: spans stamped up to this long after stop_trace still
@@ -124,6 +125,7 @@ class Profiler:
         sleep=time.sleep,
         device_tracer: Optional[Callable[[str, int], None]] = None,
         tracer=None,
+        journal=None,
         snapshot_fn: Optional[Callable[[], dict]] = None,
         registry: Optional[metrics.Registry] = None,
         async_triggers: bool = True,
@@ -141,6 +143,10 @@ class Profiler:
         self._sleep = sleep
         self._device_tracer = device_tracer
         self._tracer = tracer
+        # Decision journal (obs/journal.py, r23): events whose wall time
+        # overlapped the capture land in the bundle as journal.json —
+        # the WHY half next to the lineage spans' WHERE.
+        self._journal = journal
         self._snapshot_fn = snapshot_fn
         self._async_triggers = bool(async_triggers)
 
@@ -298,6 +304,19 @@ class Profiler:
         with open(os.path.join(bundle, SPANS), "w") as f:
             json.dump({"events": span_events}, f)
 
+        # Overlapping decision-journal window (same slack as the spans:
+        # a decision journaled just after stop_trace still explains the
+        # capture's tail).
+        journal_events: List[dict] = []
+        if self._journal is not None:
+            try:
+                journal_events = self._journal.window(
+                    t0_wall, t1_wall + _SPAN_SLACK_S)
+            except Exception as exc:  # noqa: BLE001 — bundle best-effort
+                log.error("prof journal window failed: %s", exc)
+        with open(os.path.join(bundle, JOURNAL), "w") as f:
+            json.dump({"events": journal_events}, f)
+
         snap: dict = {}
         if self._snapshot_fn is not None:
             try:
@@ -318,6 +337,8 @@ class Profiler:
             "device_trace": find_device_trace(bundle),
             "spans": SPANS,
             "span_events": len(span_events),
+            "journal": JOURNAL,
+            "journal_events": len(journal_events),
             "snapshot": SNAPSHOT,
             "slo_episode": context.get("slo_episode"),
             "context": context,

@@ -109,7 +109,8 @@ class BurnRateSLO:
     burn, keeps episode state, and feeds ``vep_slo_*`` gauges."""
 
     def __init__(self, spec: SLOSpec, *, clock=time.monotonic,
-                 registry: Optional[metrics.Registry] = None):
+                 registry: Optional[metrics.Registry] = None,
+                 journal=None):
         if not 0.0 < spec.objective < 1.0:
             raise ValueError(
                 f"SLO {spec.name!r}: objective must be in (0, 1), "
@@ -124,6 +125,11 @@ class BurnRateSLO:
         self.firing = False
         self.episodes = 0
         self._last: dict = {"fast": None, "slow": None}
+        # r23 decision journal: episode open/close events with the burn
+        # numbers as trigger. last_open_seq is the cause handle the
+        # ladder links its slo_burn-attributed transitions to.
+        self.journal = journal
+        self.last_open_seq: Optional[int] = None
         self._g_fast = reg.gauge(
             "vep_slo_burn_rate",
             "Error-budget burn-rate multiple per window",
@@ -174,17 +180,32 @@ class BurnRateSLO:
             burning = (covered and fast is not None and slow is not None
                        and fast > spec.fire_burn_rate
                        and slow > spec.fire_burn_rate)
+            opened = closed = False
             if burning and not self.firing:
                 self.firing = True
                 self.episodes += 1
                 self._c_episodes.inc()
+                opened = True
             elif self.firing and (fast is None
                                   or fast <= spec.fire_burn_rate):
                 # Fast window clearing resolves the episode: budget is no
                 # longer burning *now*, even though the slow window still
                 # remembers the excursion.
                 self.firing = False
+                closed = True
             self._last = {"fast": fast, "slow": slow}
+        if self.journal is not None and opened:
+            self.last_open_seq = self.journal.record(
+                "slo", "episode_open", subject=("slo", spec.name),
+                trigger={"fast": fast, "slow": slow,
+                         "threshold": spec.fire_burn_rate})
+        elif self.journal is not None and closed:
+            self.journal.record(
+                "slo", "episode_close", subject=("slo", spec.name),
+                trigger={"fast": fast, "slow": slow,
+                         "threshold": spec.fire_burn_rate,
+                         "episodes": self.episodes},
+                cause=self.last_open_seq)
         if fast is not None:
             self._g_fast.set(fast)
         if slow is not None:
@@ -281,13 +302,17 @@ class SLOEngine:
     def __init__(self, specs: Iterable[SLOSpec] = (), *,
                  clock=time.monotonic,
                  registry: Optional[metrics.Registry] = None,
-                 watchdog=None):
+                 watchdog=None, journal=None):
         self._watchdog = watchdog
+        self.journal = journal
         self._slos: Dict[str, BurnRateSLO] = {}
         for spec in specs:
-            self.add(BurnRateSLO(spec, clock=clock, registry=registry))
+            self.add(BurnRateSLO(spec, clock=clock, registry=registry,
+                                 journal=journal))
 
     def add(self, slo: BurnRateSLO) -> BurnRateSLO:
+        if slo.journal is None:
+            slo.journal = self.journal
         self._slos[slo.name] = slo
         return slo
 

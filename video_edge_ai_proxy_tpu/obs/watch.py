@@ -33,13 +33,24 @@ class Watchdog:
     the first non-breaching check closes the episode (and logs INFO with
     the episode duration and peak). ``below=`` watches the other
     direction (e.g. batch occupancy collapsing).
+
+    ``journal`` (r23, optional ``obs.journal.DecisionJournal``): every
+    episode open/close is recorded as a decision event whose trigger
+    carries the excursion magnitude (value/threshold on open, peak and
+    duration on close) so downstream cause links explain HOW BAD the
+    crossing was, not just that it happened.
     """
 
-    def __init__(self):
+    def __init__(self, *, journal=None):
         self._lock = threading.Lock()
-        # name -> {since, peak, threshold, direction, detail}
+        self.journal = journal
+        # name -> {since, peak, threshold, direction, detail, seq}
         self._active: Dict[str, dict] = {}
         self._episodes: Dict[str, int] = {}
+        # name -> most recent COMPLETED episode (open ts + peak survive
+        # the close — r23 satellite: totals alone cannot tell a journal
+        # event the excursion magnitude).
+        self._last: Dict[str, dict] = {}
 
     def check(self, name: str, value: float, *,
               above: Optional[float] = None,
@@ -50,27 +61,24 @@ class Watchdog:
             raise ValueError("exactly one of above=/below= required")
         breach = value > above if above is not None else value < below
         threshold = above if above is not None else below
+        direction = "above" if above is not None else "below"
         now = time.time()
+        opened = closed = None
         with self._lock:
             ep = self._active.get(name)
             if breach:
                 if ep is None:
-                    self._active[name] = {
+                    ep = {
                         "since": now,
                         "peak": value,
                         "threshold": threshold,
-                        "direction": "above" if above is not None
-                        else "below",
+                        "direction": direction,
                         "detail": detail,
+                        "seq": None,
                     }
+                    self._active[name] = ep
                     self._episodes[name] = self._episodes.get(name, 0) + 1
-                    log.warning(
-                        "watch: %s %s threshold %g (value %g)%s",
-                        name,
-                        "above" if above is not None else "below",
-                        threshold, value,
-                        f" — {detail}" if detail else "",
-                    )
+                    opened = ep
                 else:
                     if above is not None:
                         ep["peak"] = max(ep["peak"], value)
@@ -78,11 +86,51 @@ class Watchdog:
                         ep["peak"] = min(ep["peak"], value)
             elif ep is not None:
                 del self._active[name]
-                log.info(
-                    "watch: %s recovered after %.1fs (peak %g, "
-                    "threshold %g)",
-                    name, now - ep["since"], ep["peak"], ep["threshold"],
-                )
+                self._last[name] = {
+                    "opened": ep["since"],
+                    "closed": now,
+                    "duration_s": round(now - ep["since"], 3),
+                    "peak": ep["peak"],
+                    "threshold": ep["threshold"],
+                    "direction": ep["direction"],
+                }
+                closed = ep
+        # Journal + log OUTSIDE the lock (the journal has its own).
+        if opened is not None:
+            seq = None
+            if self.journal is not None:
+                seq = self.journal.record(
+                    "watch", "episode_open", subject=("watch", name),
+                    trigger={"value": float(value),
+                             "threshold": float(threshold),
+                             "direction": direction})
+                opened["seq"] = seq
+            log.warning(
+                "watch: %s %s threshold %g (value %g)%s",
+                name, direction, threshold, value,
+                f" — {detail}" if detail else "",
+                extra={"vep_actor": "watch",
+                       "vep_subject": f"watch:{name}",
+                       "vep_journal_seq": seq},
+            )
+        elif closed is not None:
+            seq = None
+            if self.journal is not None:
+                seq = self.journal.record(
+                    "watch", "episode_close", subject=("watch", name),
+                    trigger={"peak": float(closed["peak"]),
+                             "threshold": float(closed["threshold"]),
+                             "duration_s": round(now - closed["since"], 3)},
+                    cause=closed.get("seq"))
+            log.info(
+                "watch: %s recovered after %.1fs (peak %g, "
+                "threshold %g)",
+                name, now - closed["since"], closed["peak"],
+                closed["threshold"],
+                extra={"vep_actor": "watch",
+                       "vep_subject": f"watch:{name}",
+                       "vep_journal_seq": seq},
+            )
         return breach
 
     def active(self) -> Dict[str, dict]:
@@ -103,12 +151,15 @@ class Watchdog:
                     "threshold": v["threshold"],
                     "direction": v["direction"],
                     "detail": v["detail"],
+                    "seq": v.get("seq"),
                 }
                 for k, v in self._active.items()
             }
-            return {"active": active, "episodes": dict(self._episodes)}
+            return {"active": active, "episodes": dict(self._episodes),
+                    "last": {k: dict(v) for k, v in self._last.items()}}
 
     def reset(self) -> None:
         with self._lock:
             self._active.clear()
             self._episodes.clear()
+            self._last.clear()

@@ -74,6 +74,7 @@ class DegradationLadder:
         lag_factor: float = 3.0,
         clock: Callable[[], float] = time.monotonic,
         watchdog=None,
+        journal=None,
     ):
         self.escalate_after_s = float(escalate_after_s)
         self.recover_after_s = float(recover_after_s)
@@ -81,6 +82,14 @@ class DegradationLadder:
         self.lag_factor = float(lag_factor)
         self._clock = clock
         self._watchdog = watchdog
+        # r23 decision journal: every transition is an audit event whose
+        # trigger is the pressure breakdown observe() stashed, and whose
+        # cause links back — deeper escalations chain to the previous
+        # transition; a fresh escalation under SLO burn chains to the
+        # slo episode_open event (the "SLO burn -> ladder rung" link).
+        self.journal = journal
+        self.last_transition_seq: Optional[int] = None
+        self._pressure_detail: Dict = {}
         self._lock = threading.Lock()
         self._rung = 0
         self._pressure_since: Optional[float] = None
@@ -104,9 +113,33 @@ class DegradationLadder:
 
     def _to(self, idx: int) -> None:
         # Caller holds self._lock.
+        prev = self._rung
         name = RUNGS[idx]
-        level = logging.WARNING if idx > self._rung else logging.INFO
-        log.log(level, "degradation ladder: %s -> %s", RUNGS[self._rung], name)
+        seq = None
+        if self.journal is not None:
+            trigger = dict(self._pressure_detail)
+            trigger["from"] = RUNGS[prev]
+            trigger["to"] = name
+            if idx > prev:
+                action = "escalate"
+                cause = self.last_transition_seq if prev != 0 else None
+                if cause is None and trigger.get("slo_burning"):
+                    # Fresh excursion attributed to SLO burn: root the
+                    # chain at the slo episode_open event.
+                    cause = self.journal.latest_seq(
+                        actor="slo", action="episode_open")
+            else:
+                action = "recover"
+                cause = self.last_transition_seq
+            seq = self.journal.record(
+                "ladder", action, subject=("ladder", "engine"),
+                trigger=trigger, cause=cause)
+            self.last_transition_seq = seq
+        level = logging.WARNING if idx > prev else logging.INFO
+        log.log(level, "degradation ladder: %s -> %s", RUNGS[prev], name,
+                extra={"vep_actor": "ladder",
+                       "vep_subject": "ladder:engine",
+                       "vep_journal_seq": seq})
         self._rung = idx
         self.transitions[name] = self.transitions.get(name, 0) + 1
         self._m_rung.set(idx)
@@ -168,6 +201,15 @@ class DegradationLadder:
         )
         fleet_edge: Optional[bool] = None
         with self._lock:
+            # Stash the breakdown so a transition this tick can journal
+            # WHICH signal forced it (r23 trigger attribution).
+            self._pressure_detail = {
+                "queue_depth": int(queue_depth),
+                "tick_lag_s": round(float(tick_lag_s), 4),
+                "tick_budget_s": round(float(tick_budget_s), 4),
+                "slo_burning": bool(slo_burning),
+                "hbm_pressure": bool(hbm_pressure),
+            }
             was_fleet = self._rung == _FLEET_IDX
             if pressure:
                 self._calm_since = None

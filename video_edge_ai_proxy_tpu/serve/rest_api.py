@@ -237,6 +237,11 @@ def build_app(
             # failovers/active to trigger device_fault spawns).
             "faults": engine.faults.snapshot()
             if engine is not None and engine.faults is not None else None,
+            # r23 decision journal: accounting + the newest events (the
+            # full filterable log lives at /api/v1/journal; the fleet
+            # aggregator merges members' journals from there).
+            "journal": engine.journal.snapshot(tail=32)
+            if engine is not None and engine.journal is not None else None,
         }
         return web.json_response(out)
 
@@ -321,6 +326,72 @@ def build_app(
             return _error(
                 400, "fault domain disabled (engine.fault config)")
         out = await asyncio.to_thread(engine.faults.snapshot)
+        return web.json_response(out)
+
+    async def journal(request: web.Request) -> web.Response:
+        """Control-plane decision journal (obs/journal.py): retained
+        audit events oldest→newest, filterable by
+        ``?actor=``/``?action=``/``?subject=kind:id`` (or bare
+        ``?subject=kind``)/``?since=seq``/``?limit=n``. 400 when the
+        journal is disabled (engine.journal config, same kill-switch
+        convention as /api/v1/faults)."""
+        if engine is None:
+            return _error(400, "engine not running")
+        if engine.journal is None:
+            return _error(
+                400, "decision journal disabled (engine.journal config)")
+        q = request.query
+        subject = subject_kind = None
+        raw = q.get("subject")
+        if raw:
+            kind, sep, ident = raw.partition(":")
+            if sep:
+                subject = (kind, ident)
+            else:
+                subject_kind = kind
+        try:
+            since = int(q["since"]) if "since" in q else None
+            limit = int(q["limit"]) if "limit" in q else None
+        except ValueError:
+            return _error(400, "since/limit must be integers")
+        events = await asyncio.to_thread(
+            engine.journal.events,
+            subject=subject, subject_kind=subject_kind,
+            actor=q.get("actor") or None, action=q.get("action") or None,
+            since=since, limit=limit)
+        return web.json_response({
+            "next_seq": engine.journal.next_seq,
+            "events": events,
+        })
+
+    async def why(request: web.Request) -> web.Response:
+        """Causal-chain explanation (obs/journal.py why()): the newest
+        journal event for ``?stream=S`` / ``?member=M`` (or any
+        ``?subject=kind:id``), its cause links walked backward, rendered
+        root-first with the trigger numbers inline. Answers the
+        operator question the six per-plane snapshots cannot: WHY is
+        this subject in its current state."""
+        if engine is None:
+            return _error(400, "engine not running")
+        if engine.journal is None:
+            return _error(
+                400, "decision journal disabled (engine.journal config)")
+        q = request.query
+        if "stream" in q:
+            kind, ident = "stream", q["stream"]
+        elif "member" in q:
+            kind, ident = "member", q["member"]
+        elif "subject" in q and ":" in q["subject"]:
+            kind, _, ident = q["subject"].partition(":")
+        else:
+            return _error(
+                400, "pass ?stream=S, ?member=M, or ?subject=kind:id")
+        try:
+            max_links = int(q.get("max_links", "8"))
+        except ValueError:
+            return _error(400, "max_links must be an integer")
+        out = await asyncio.to_thread(
+            engine.journal.why, kind, ident, max_links=max_links)
         return web.json_response(out)
 
     async def trace(request: web.Request) -> web.Response:
@@ -541,6 +612,8 @@ def build_app(
     app.router.add_get("/api/v1/capacity", capacity)
     app.router.add_get("/api/v1/hbm", hbm)
     app.router.add_get("/api/v1/faults", faults)
+    app.router.add_get("/api/v1/journal", journal)
+    app.router.add_get("/api/v1/why", why)
     app.router.add_get("/api/v1/trace", trace)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
@@ -573,8 +646,20 @@ def build_app(
             text=text, content_type="text/plain",
             charset="utf-8", headers={"X-Prometheus-Version": "0.0.4"})
 
+    async def fleet_journal(_request: web.Request) -> web.Response:
+        """Fleet-merged decision journal (r23): every member's
+        ``/api/v1/journal`` events tagged ``member=<name>`` and ordered
+        by ``(ts, member, seq)`` — monotone per-member seqs make the
+        merge deterministic regardless of scrape arrival order."""
+        if fleet is None:
+            return _error(
+                400, "fleet aggregation disabled (obs.fleet_members config)")
+        return web.json_response(
+            await asyncio.to_thread(fleet.merged_journal))
+
     app.router.add_get("/api/v1/fleet/stats", fleet_stats)
     app.router.add_get("/api/v1/fleet/metrics", fleet_metrics)
+    app.router.add_get("/api/v1/fleet/journal", fleet_journal)
 
     def _ladder_or_error():
         """Router surface preconditions (r16): the routes manipulate the

@@ -499,10 +499,16 @@ class StreamRouter:
         client_factory: Optional[Callable[[str, str], MemberClient]] = None,
         fleet: Optional[FleetAggregator] = None,
         name: str = "router0",
+        journal=None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ):
         self.name = name
+        # Decision journal (obs/journal.py, r23): placements, admission
+        # rejections and migrations record WHY they happened, with cause
+        # links back to the router's own dead/shedding observations. None
+        # (the default) keeps the router journal-free.
+        self.journal = journal
         self._clock = clock
         self._sleep = sleep
         self.scrape_interval_s = float(scrape_interval_s)
@@ -536,6 +542,10 @@ class StreamRouter:
         #            migrations}
         self._streams: Dict[str, dict] = {}
         self._evacuated: Dict[str, float] = {}   # member -> detect time
+        # Journal seqs of the router's own observation events, keyed by
+        # member: the cause links for the migrations they provoke.
+        self._evac_seq: Dict[str, int] = {}      # member_dead detection
+        self._shed_seq: Dict[str, int] = {}      # shedding observation
         # Members mid-drain (remove_member): excluded from the ring, from
         # _refresh_ring re-adds, and from migration targets until the
         # drain completes (member gone) or aborts (flag cleared, member
@@ -614,7 +624,8 @@ class StreamRouter:
         except Exception:  # noqa: BLE001 — member may lack a ladder
             pass
 
-    def remove_member(self, name: str) -> List[str]:
+    def remove_member(self, name: str,
+                      cause: Optional[int] = None) -> List[str]:
         """Drain and deregister a member (the supervisor's scale-in
         path). Every stream it still owns is migrated off gracefully
         (``reason="scale_in"`` — the r16 drain→cutover→resume protocol,
@@ -647,7 +658,7 @@ class StreamRouter:
                     break
                 for stream in pending:
                     if self.migrate(stream, reason="scale_in",
-                                    graceful=True) is None:
+                                    graceful=True, cause=cause) is None:
                         raise RuntimeError(
                             f"scale_in drain of {stream!r} off {name!r} "
                             "failed; member left registered for retry")
@@ -667,6 +678,8 @@ class StreamRouter:
             self.clients.pop(name, None)
             self._draining.discard(name)
             self._evacuated.pop(name, None)
+            self._evac_seq.pop(name, None)
+            self._shed_seq.pop(name, None)
             self._m_members.set(len(self.clients))
         return moved
 
@@ -743,6 +756,12 @@ class StreamRouter:
                 raise ValueError(f"stream {name!r} already routed")
             member = self.ring.place(name)
             if member is None:
+                if self.journal is not None:
+                    self.journal.record(
+                        "router", "admission_rejected",
+                        subject=("stream", name),
+                        trigger={"reason": "ring_empty",
+                                 "members": len(self.clients)})
                 raise RuntimeError(
                     "no placeable member (ring empty — all members dead, "
                     "unhealthy, or breaker-open)")
@@ -756,7 +775,11 @@ class StreamRouter:
             }
             self._m_placements.labels(member).inc()
             self._m_streams.set(len(self._streams))
-            return member
+        if self.journal is not None:
+            self.journal.record(
+                "router", "place", subject=("stream", name),
+                trigger={"member": member, "policy": "hash_ring"})
+        return member
 
     def _pick_admission(self, name: str,
                         candidates: List[dict]) -> Optional[str]:
@@ -853,9 +876,17 @@ class StreamRouter:
                 candidates.append(row)
             member = self._pick_admission(name, candidates)
             if member is None:
+                if self.journal is not None:
+                    self.journal.record(
+                        "router", "admission_rejected",
+                        subject=("stream", name),
+                        trigger={"reason": "ring_empty",
+                                 "members": len(self.clients)})
                 raise RuntimeError(
                     "no placeable member (ring empty — all members dead, "
                     "unhealthy, or breaker-open)")
+            row = next((r for r in candidates
+                        if r.get("instance") == member), None)
             self.clients[member].start_stream(
                 name, rtsp_endpoint, inference_model, annotation_policy)
             self._streams[name] = {
@@ -866,7 +897,20 @@ class StreamRouter:
             }
             self._m_placements.labels(member).inc()
             self._m_streams.set(len(self._streams))
-            return member
+        if self.journal is not None:
+            trigger = {"member": member,
+                       "policy": ("headroom" if row is not None
+                                  and row.get("headroom") is not None
+                                  else "score_ema" if row is not None
+                                  else "hash_ring")}
+            if row is not None:
+                for key in ("headroom", "time_to_saturation_s",
+                            "time_to_oom_s", "score_ema"):
+                    if row.get(key) is not None:
+                        trigger[key] = round(float(row[key]), 4)
+            self.journal.record("router", "admit",
+                                subject=("stream", name), trigger=trigger)
+        return member
 
     def remove_stream(self, name: str) -> None:
         with self._lock:
@@ -916,7 +960,8 @@ class StreamRouter:
 
     def migrate(self, stream: str, *, reason: str = "admin",
                 dst: Optional[str] = None, graceful: bool = True,
-                detected_at: Optional[float] = None) -> Optional[str]:
+                detected_at: Optional[float] = None,
+                cause: Optional[int] = None) -> Optional[str]:
         """drain→cutover→resume one stream. ``graceful=False`` is the
         dead-member path (source unreachable: no stop, no drain — the
         cursor resume re-produces the frames that died in flight).
@@ -933,9 +978,24 @@ class StreamRouter:
             if dst is not None and dst in self._draining:
                 # Ring refresh lag: never migrate ONTO a draining member.
                 dst = None
+        if cause is None:
+            # Link back to the router's own observation event for the
+            # source member: the dead-member detection or the shedding
+            # observation that provoked this move.
+            cause = (self._evac_seq.get(src) if reason == "member_dead"
+                     else self._shed_seq.get(src))
         if dst is None or dst == src:
             self._m_mig_fail.labels(reason).inc()
-            log.warning("no migration target for %s (src=%s)", stream, src)
+            if self.journal is not None:
+                self.journal.record(
+                    "router", "migrate_failed",
+                    subject=("stream", stream),
+                    trigger={"src": src, "reason": reason,
+                             "error": "no_target"}, cause=cause)
+            log.warning(
+                "no migration target for %s (src=%s)", stream, src,
+                extra={"vep_actor": "router",
+                       "vep_subject": f"stream:{stream}"})
             return None
         entry = {"stream": stream, "src": src, "dst": dst,
                  "reason": reason, "graceful": bool(graceful)}
@@ -972,6 +1032,12 @@ class StreamRouter:
             self._m_mig_fail.labels(reason).inc()
             entry.update(ok=False, error=f"{type(e).__name__}: {e}")
             self.ledger.record_migration(entry)
+            if self.journal is not None:
+                self.journal.record(
+                    "router", "migrate_failed",
+                    subject=("stream", stream),
+                    trigger={"src": src, "dst": dst, "reason": reason,
+                             "error": type(e).__name__}, cause=cause)
             return None
         t_done = self._clock()
         with self._lock:
@@ -985,8 +1051,20 @@ class StreamRouter:
         self._m_placements.labels(dst).inc()
         entry.update(ok=True, replace_s=round(replace_s, 4))
         self.ledger.record_migration(entry)
+        seq = None
+        if self.journal is not None:
+            seq = self.journal.record(
+                "router", "migrate", subject=("stream", stream),
+                trigger={"src": src, "dst": dst, "reason": reason,
+                         "replace_s": round(replace_s, 4),
+                         "graceful": bool(graceful),
+                         "cursor": -1 if cursor is None else int(cursor)},
+                cause=cause)
         log.info("migrated %s: %s -> %s (%s, %.2fs, cursor=%s)",
-                 stream, src, dst, reason, replace_s, cursor)
+                 stream, src, dst, reason, replace_s, cursor,
+                 extra={"vep_actor": "router",
+                        "vep_subject": f"stream:{stream}",
+                        "vep_journal_seq": seq})
         return dst
 
     # -- the control loop --------------------------------------------------
@@ -1006,8 +1084,17 @@ class StreamRouter:
         for member, row in sorted(by_name.items()):
             if row["up"] and not row["stale"]:
                 self._evacuated.pop(member, None)
+                self._evac_seq.pop(member, None)
                 continue
+            fresh = member not in self._evacuated
             detect = self._evacuated.setdefault(member, t_pass)
+            if fresh and self.journal is not None:
+                # Observation event: the detection itself, the cause
+                # every member_dead migration below links back to.
+                self._evac_seq[member] = self.journal.record(
+                    "router", "member_dead", subject=("member", member),
+                    trigger={"stale": bool(row["stale"]),
+                             "streams": len(self.streams_on(member))})
             for stream in self.streams_on(member):
                 dst = self.migrate(stream, reason="member_dead",
                                    graceful=False, detected_at=detect)
@@ -1029,11 +1116,22 @@ class StreamRouter:
                 or row.get("healthy") is False
             )
             if not shedding:
+                self._shed_seq.pop(member, None)
                 continue
             reason = ("slo_burn" if row.get("slo_burning")
                       else "shed_to_fleet"
                       if float(row.get("ladder_rung") or 0.0)
                       >= _FLEET_RUNG_IDX else "unhealthy")
+            if member not in self._shed_seq and self.journal is not None:
+                # Edge-triggered observation: the shedding verdict the
+                # per-stream migrations below link back to.
+                self._shed_seq[member] = self.journal.record(
+                    "router", "member_shedding",
+                    subject=("member", member),
+                    trigger={"reason": reason,
+                             "slo_burning": bool(row.get("slo_burning")),
+                             "ladder_rung": float(
+                                 row.get("ladder_rung") or 0.0)})
             for stream in self.streams_on(member)[:budget]:
                 dst = self.migrate(stream, reason=reason,
                                    detected_at=t_pass)

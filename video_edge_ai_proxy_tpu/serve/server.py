@@ -165,6 +165,16 @@ class Server:
         # supervisor only ever acts through a StreamRouter.
         self.router = None
         self.supervisor = None
+        # One decision journal per PROCESS (r23, obs/journal.py): the
+        # router, supervisor and (below) the engine all record into it,
+        # so cross-actor cause links (supervisor spawn <- fault
+        # observation <- router re-place) resolve in one ring.
+        # cfg.engine.journal=False is the process-wide kill switch.
+        self.journal = None
+        if self.cfg.engine.journal:
+            from ..obs.journal import DecisionJournal
+
+            self.journal = DecisionJournal(self.cfg.engine.journal_capacity)
         if self.cfg.supervisor.enabled:
             if not self.cfg.router.members:
                 log.warning(
@@ -187,6 +197,7 @@ class Server:
                     ema_alpha=rc.ema_alpha,
                     healthy_above=rc.healthy_above,
                     unhealthy_below=rc.unhealthy_below,
+                    journal=self.journal,
                 )
                 self.supervisor = FleetSupervisor(
                     self.router,
@@ -343,6 +354,7 @@ class Server:
                     self.process_manager.annotation_policy_of
                 ),
                 archiver=self._cascade_archiver,
+                journal=self.journal,
             )
             if self.engine.slo is not None:
                 # One boot line naming the live objectives: operators see

@@ -104,6 +104,7 @@ class FleetSupervisor:
         spawn_cooldown_s: float = 10.0,
         retire_cooldown_s: float = 30.0,
         name: str = "supervisor0",
+        journal=None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ):
@@ -113,6 +114,12 @@ class FleetSupervisor:
                 f"[{min_members}, {max_members}]")
         self.name = name
         self.router = router
+        # Decision journal (obs/journal.py, r23). Defaults to the
+        # router's journal so supervisor spawns and the router
+        # migrations they provoke land in ONE causal chain; None keeps
+        # the supervisor journal-free.
+        self.journal = (journal if journal is not None
+                        else getattr(router, "journal", None))
         self._spawner = spawner
         self._retirer = retirer
         self.min_members = int(min_members)
@@ -137,6 +144,11 @@ class FleetSupervisor:
         # then an INCREASE is one hard-fault edge — one spawn attempt,
         # not one per pass while the count stays elevated.
         self._fault_seen: dict = {}
+        # member -> journal seq of its fault observation (the cause the
+        # device_fault spawn links to); edge state for blocked events so
+        # a sustained block journals ONCE, not once per pass.
+        self._fault_obs_seq: dict = {}
+        self._last_blocked: Optional[str] = None
         self._last_decision: dict = {}
         self.events: List[dict] = []   # bounded lifecycle history
         self._m_members = obs_registry.gauge(
@@ -239,8 +251,33 @@ class FleetSupervisor:
         self.events.append(event)
         del self.events[:-64]
 
+    def _view_trigger(self, reason: str, view: dict) -> dict:
+        """Quantitative trigger for a journal event: the fleet-view
+        signals the decision was made on (None signals omitted)."""
+        trig = {"reason": reason, "members": int(view["members"])}
+        for key in ("fleet_tts_s", "fleet_tto_s", "min_headroom"):
+            if view.get(key) is not None:
+                trig[key] = round(float(view[key]), 3)
+        return trig
+
+    def _journal_blocked(self, blocked: str, wanted: str,
+                         view: dict) -> None:
+        """Edge-triggered blocked event: a wanted-but-blocked decision
+        journals once per distinct (wanted, blocked) state, not once
+        per pass while the pressure persists."""
+        key = f"{wanted}/{blocked}"
+        if self.journal is None or self._last_blocked == key:
+            self._last_blocked = key
+            return
+        self._last_blocked = key
+        trig = self._view_trigger(wanted, view)
+        trig["blocked"] = blocked
+        self.journal.record("supervisor", "blocked",
+                            subject=("fleet", self.name), trigger=trig)
+
     def _try_spawn(self, reason: str, view: dict,
-                   ignore_cooldown: bool = False) -> Optional[str]:
+                   ignore_cooldown: bool = False,
+                   cause: Optional[int] = None) -> Optional[str]:
         """Bound/cooldown-gated spawn; returns the new member name.
         ``ignore_cooldown`` (device_fault only): a chip death is a step
         LOSS of capacity, not a forecast echo — the symmetric cooldown
@@ -250,11 +287,13 @@ class FleetSupervisor:
         now = self._clock()
         if view["members"] >= self.max_members:
             self._m_blocked.labels("max_members").inc()
+            self._journal_blocked("max_members", reason, view)
             return None
         if view["warming"]:
             # A spawn is already in flight; judging pressure again
             # before it serves would double-provision every burn.
             self._m_blocked.labels("warming").inc()
+            self._journal_blocked("warming", reason, view)
             return None
         # Cooldown counts from the last lifecycle action in EITHER
         # direction: a retire's drain migrations step up the survivors'
@@ -266,12 +305,19 @@ class FleetSupervisor:
                 if stamp is not None \
                         and now - stamp < self.spawn_cooldown_s:
                     self._m_blocked.labels("cooldown").inc()
+                    self._journal_blocked("cooldown", reason, view)
                     return None
         if self._spawner is None:
             # Advisory mode: the decision is recorded (and visible in
             # the snapshot/metrics) but nothing boots.
             self._m_blocked.labels("no_spawner").inc()
             self._record({"action": "spawn_advised", "reason": reason})
+            self._last_blocked = None
+            if self.journal is not None:
+                self.journal.record(
+                    "supervisor", "spawn_advised",
+                    subject=("fleet", self.name),
+                    trigger=self._view_trigger(reason, view), cause=cause)
             return None
         try:
             spawned = self._spawner()
@@ -280,12 +326,14 @@ class FleetSupervisor:
             spawned = None
         if not spawned:
             self._m_blocked.labels("spawn_failed").inc()
+            self._journal_blocked("spawn_failed", reason, view)
             return None
         member, base_url = spawned
         self.router.add_member(member, base_url)
         self._last_spawn = now
         self._surplus_since = None   # fresh capacity: surplus restarts
         self._m_spawns.inc()
+        self._last_blocked = None
         # The decision view rides along: "scale-out beat the burn" is
         # checkable from the event alone (was headroom still positive
         # when the spawn landed?).
@@ -294,7 +342,15 @@ class FleetSupervisor:
                       "fleet_tts_s": view["fleet_tts_s"],
                       "fleet_tto_s": view.get("fleet_tto_s"),
                       "min_headroom": view["min_headroom"]})
-        log.info("spawned %s (%s): %s", member, reason, base_url)
+        seq = None
+        if self.journal is not None:
+            seq = self.journal.record(
+                "supervisor", "spawn", subject=("member", member),
+                trigger=self._view_trigger(reason, view), cause=cause)
+        log.info("spawned %s (%s): %s", member, reason, base_url,
+                 extra={"vep_actor": "supervisor",
+                        "vep_subject": f"member:{member}",
+                        "vep_journal_seq": seq})
         return member
 
     def _try_retire(self, view: dict, health: List[dict]) -> Optional[str]:
@@ -302,13 +358,17 @@ class FleetSupervisor:
         now = self._clock()
         if view["members"] <= self.min_members:
             self._m_blocked.labels("min_members").inc()
+            self._journal_blocked("min_members", "headroom_surplus", view)
             return None
         if view["warming"]:
             self._m_blocked.labels("warming").inc()
+            self._journal_blocked("warming", "headroom_surplus", view)
             return None
         for stamp in (self._last_spawn, self._last_retire):
             if stamp is not None and now - stamp < self.retire_cooldown_s:
                 self._m_blocked.labels("cooldown").inc()
+                self._journal_blocked("cooldown", "headroom_surplus",
+                                      view)
                 return None
         # Emptiest serving member; ties retire the lexically LAST name
         # (later spawns sort last under the harness's m<N> naming, so
@@ -323,11 +383,26 @@ class FleetSupervisor:
             return None
         count = candidates[0][0]
         victim = max(n for c, n in candidates if c == count)
+        # Journal the retire decision BEFORE the drain so every
+        # scale_in migration it provokes links back to it as cause;
+        # a failed drain records retire_failed in the same chain.
+        seq = None
+        if self.journal is not None:
+            trig = self._view_trigger("headroom_surplus", view)
+            trig["streams"] = count
+            seq = self.journal.record(
+                "supervisor", "retire", subject=("member", victim),
+                trigger=trig)
         try:
-            moved = self.router.remove_member(victim)
-        except Exception:  # noqa: BLE001 — drain failed; retry next pass
+            moved = self.router.remove_member(victim, cause=seq)
+        except Exception as e:  # noqa: BLE001 — drain failed; retry
             log.exception("retire drain of %s failed", victim)
             self._m_blocked.labels("retire_failed").inc()
+            if self.journal is not None:
+                self.journal.record(
+                    "supervisor", "retire_failed",
+                    subject=("member", victim),
+                    trigger={"error": type(e).__name__}, cause=seq)
             return None
         if self._retirer is not None:
             try:
@@ -337,10 +412,14 @@ class FleetSupervisor:
         self._last_retire = now
         self._surplus_since = None
         self._m_retires.inc()
+        self._last_blocked = None
         self._record({"action": "retire", "member": victim,
                       "drained_streams": moved,
                       "min_headroom": view["min_headroom"]})
-        log.info("retired %s (%d streams drained)", victim, len(moved))
+        log.info("retired %s (%d streams drained)", victim, len(moved),
+                 extra={"vep_actor": "supervisor",
+                        "vep_subject": f"member:{victim}",
+                        "vep_journal_seq": seq})
         return victim
 
     def run_pass(self) -> dict:
@@ -384,6 +463,16 @@ class FleetSupervisor:
                     self._fault_seen[inst] = int(n)
                 elif int(n) > prev:
                     faulted.append(inst)
+                    if self.journal is not None:
+                        # Observation event: the member's fault counter
+                        # stepped — the cause the device_fault spawn
+                        # links back to (member-local fault events live
+                        # in the MEMBER's journal, not this one).
+                        self._fault_obs_seq[inst] = self.journal.record(
+                            "supervisor", "fault_observed",
+                            subject=("member", inst),
+                            trigger={"failovers": int(n),
+                                     "prev": int(prev)})
             if view["members"] < self.min_members:
                 decision["reason"] = "min_bound"
                 member = self._try_spawn("min_bound", view)
@@ -395,8 +484,9 @@ class FleetSupervisor:
                 # (ignore_cooldown) — soft forecasts keep respecting it.
                 decision["reason"] = "device_fault"
                 decision["fault_members"] = faulted
-                member = self._try_spawn("device_fault", view,
-                                         ignore_cooldown=True)
+                member = self._try_spawn(
+                    "device_fault", view, ignore_cooldown=True,
+                    cause=self._fault_obs_seq.get(faulted[0]))
                 decision["action"] = "spawn" if member else "hold"
                 decision["member"] = member
                 # Edge consumed after ONE attempt, spawned or blocked:

@@ -90,6 +90,12 @@ class CascadeScheduler:
                  perf=None, history_keep: int = 0, events_keep: int = 64):
         self.model = str(model)
         self.every_n = max(1, int(every_n))
+        # Cadence stretch under pressure (r23): the effective dispatch
+        # cadence is every_n * stretch ticks. 1 (default) = the pinned
+        # bit-identical cadence; the engine raises it while the
+        # degradation ladder sits at shed or deeper, shedding temporal-
+        # head FLOPs before streams are shed to the fleet.
+        self.stretch = 1
         self._crop = int(crop)
         self._clip_len = int(clip_len)
         self.ttl_ticks = max(1, int(ttl_ticks))
@@ -268,6 +274,17 @@ class CascadeScheduler:
             self.harvested += n
         return n
 
+    def set_stretch(self, factor: int) -> bool:
+        """Set the cadence-stretch multiplier; returns True when the
+        value changed (the engine journals the edge, not the steady
+        state). Only ever called from the tick thread, but locked so a
+        concurrent snapshot reads a consistent cadence."""
+        factor = max(1, int(factor))
+        with self._lock:
+            changed = factor != self.stretch
+            self.stretch = factor
+        return changed
+
     # -- tick-thread drive ---------------------------------------------------
 
     def tick(self) -> CascadeTickResult:
@@ -309,7 +326,7 @@ class CascadeScheduler:
             for key in stale:
                 self._drop_track_locked(key)
             if (self.head is not None and self._pool is not None
-                    and tick % self.every_n == 0):
+                    and tick % (self.every_n * max(1, self.stretch)) == 0):
                 due = [k for k in self._tracks if self._pool.full(k)]
                 due = due[:BUCKETS[-1]]
                 if due:
@@ -426,6 +443,8 @@ class CascadeScheduler:
             return {
                 "model": self.model,
                 "every_n": self.every_n,
+                "stretch": self.stretch,
+                "effective_every_n": self.every_n * max(1, self.stretch),
                 "side": self.side,
                 "clip_len": self.clip_len,
                 "threshold": self._events.threshold,

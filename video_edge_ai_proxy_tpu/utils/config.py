@@ -410,6 +410,22 @@ class EngineConfig:
     # Per-shard health probe (stall attribution): a tiny device
     # round-trip per shard lead device, failed/overrun => faulted.
     fault_probe_timeout_ms: float = 2000.0
+    # Control-plane decision journal (obs/journal.py, r23): bounded ring
+    # of causally-linked audit events from every autonomous loop
+    # (ladder, shed, cascade stretch, failover, router, supervisor),
+    # served at /api/v1/journal + /api/v1/why. Default ON — recording is
+    # a pure side effect off the per-frame path; journal=False is the
+    # kill switch: no hooks, /api/v1/journal answers 400, replay
+    # bit-identical (test-pinned, fault=False convention).
+    journal: bool = True
+    journal_capacity: int = 4096       # ring slots (events retained)
+    # Cascade cadence stretch under pressure (r23): while the
+    # degradation ladder sits at shed or deeper, the temporal head's
+    # dispatch cadence multiplies by this factor (every_n * stretch
+    # ticks), shedding head FLOPs before streams are shed to the fleet.
+    # Factor 1 disables the mechanism; stretch only ever engages on a
+    # rung transition, so rung=normal serving is bit-identical.
+    cascade_stretch_factor: int = 2
 
 
 @dataclass

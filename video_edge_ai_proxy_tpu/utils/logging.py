@@ -15,6 +15,18 @@ set, so a WARNING fired three calls deep (tracker, annotate, quality)
 still says which frame it was about. ContextVar-backed: thread-safe and
 correct under asyncio handlers too, with zero cost on records logged
 outside any context.
+
+JSON bridge (r23 journal satellite): decision sites (ladder, engine,
+router, supervisor, watch) stamp their log records with
+``extra={"vep_actor": ..., "vep_subject": "kind:id",
+"vep_journal_seq": N}`` — the same identity their
+:mod:`~video_edge_ai_proxy_tpu.obs.journal` event carries. The default
+tab format ignores those attributes; ``VEP_TPU_LOG_JSON=1`` (or
+:func:`enable_json_logs`) swaps the handler's formatter for
+:class:`JsonFormatter`, one JSON object per line with
+``actor``/``subject``/``journal_seq`` fields, so a log pipeline can
+join log lines to journal events by seq. Opt-in by design: tests and
+operators reading stdout keep the human format.
 """
 
 from __future__ import annotations
@@ -67,17 +79,65 @@ class _ContextFilter(logging.Filter):
         return True
 
 
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line, carrying the decision-site journal
+    correlation attributes (``vep_actor``/``vep_subject``/
+    ``vep_journal_seq`` record attrs stamped via ``extra=``) plus the
+    per-thread stream/seq context. Keys sort for stable diffs."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        import json
+
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        ctx = getattr(record, "vep_ctx", "")
+        if ctx:
+            out["ctx"] = ctx.strip("[]\t ")
+        for attr, key in (("vep_actor", "actor"),
+                          ("vep_subject", "subject"),
+                          ("vep_journal_seq", "journal_seq")):
+            val = getattr(record, attr, None)
+            if val is not None:
+                out[key] = val
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, sort_keys=True, default=str)
+
+
+_handler: "logging.Handler | None" = None
+
+
+def _json_mode() -> bool:
+    return os.environ.get("VEP_TPU_LOG_JSON", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def enable_json_logs(enable: bool = True) -> None:
+    """Swap the process handler's formatter to/from JSON at runtime
+    (equivalent to booting with ``VEP_TPU_LOG_JSON=1``)."""
+    _configure()
+    if _handler is not None:
+        _handler.setFormatter(
+            JsonFormatter() if enable else logging.Formatter(_FORMAT))
+
+
 def _configure() -> None:
-    global _configured
+    global _configured, _handler
     if _configured:
         return
     handler = logging.StreamHandler(sys.stdout)
-    handler.setFormatter(logging.Formatter(_FORMAT))
+    handler.setFormatter(
+        JsonFormatter() if _json_mode() else logging.Formatter(_FORMAT))
     handler.addFilter(_ContextFilter())
     root = logging.getLogger("vep_tpu")
     root.addHandler(handler)
     root.setLevel(os.environ.get("VEP_TPU_LOG_LEVEL", "INFO").upper())
     root.propagate = False
+    _handler = handler
     _configured = True
 
 
